@@ -1,0 +1,24 @@
+"""qba_tpu — TPU-native framework for detectable Quantum Byzantine Agreement.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference simulator
+``Carl0sGV/TFG---Quantum-Byzantine-Agreement`` (``tfg.py``): an MPI
+process-per-party Byzantine-agreement protocol driven by simulated quantum
+resources.  Here the message-passing design inverts into array programming:
+
+* all parties' protocol state lives in fixed-shape arrays carrying a party
+  axis (replacing MPI ranks, ``tfg.py:310-314``),
+* the quantum resource generation is a batched JAX sampler / dense
+  statevector engine (replacing the qsimov native engine, ``tfg.py:68-84``),
+* voting rounds are a synchronous ``lax.scan`` over a dense mailbox tensor
+  (replacing tagged ``Isend``/``Irecv``/``Iprobe`` traffic,
+  ``tfg.py:199-263,337-348``),
+* Byzantine fault injection is a vectorized adversary model
+  (replacing ``tfg.py:101-125,169-181,271-284``),
+* Monte-Carlo trials are ``vmap``-batched and sharded over a TPU device
+  mesh via ``shard_map`` with XLA collectives.
+"""
+
+from qba_tpu.config import QBAConfig
+
+__all__ = ["QBAConfig"]
+__version__ = "0.1.0"

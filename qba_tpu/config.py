@@ -1,0 +1,119 @@
+"""Typed, validated experiment configuration.
+
+The reference's entire config surface is two positional CLI ints plus the MPI
+world size (``tfg.py:366-367``, ``README.md:3-4``): ``sizeL``, ``nDishonest``,
+and ``nParties = world_size - 1``.  Everything else is derived
+(``tfg.py:316-318``).  There is no validation in the reference (e.g.
+``nDishonest > nParties`` crashes ``np.random.choice`` at ``tfg.py:105``).
+
+Here the config is an explicit frozen dataclass with derived properties and
+validation, plus the knobs the TPU design adds (trials, seed, backend,
+mailbox slot bound, qsim path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class QBAConfig:
+    """Static (compile-time) parameters of one QBA experiment.
+
+    Attributes:
+      n_parties: number of generals including the commander (MPI world size
+        minus the QSD rank in the reference, ``tfg.py:314``).
+      size_l: security parameter — length of each party's particle list
+        (``sizeL``, ``tfg.py:366``).
+      n_dishonest: number of Byzantine parties, sampled from ranks
+        ``1..n_parties`` (the commander may be dishonest, ``tfg.py:105``).
+      trials: Monte-Carlo batch size (new axis; the reference runs a single
+        trial per mpiexec invocation).
+      seed: PRNG seed (the reference uses the global NumPy MT19937; here an
+        explicit threefry key tree).
+      qsim_path: "factorized" (closed-form sampler, any size — SURVEY §2.6)
+        or "dense" (full joint statevector, validation only, <= ~20 qubits).
+      max_accepts_per_round: static bound on mailbox slots per (sender,
+        round). A lieutenant accepts each order value at most once
+        (``v not in Vi``, ``tfg.py:294``), so ``w`` is a universal bound;
+        smaller values trade memory for a recorded overflow flag.
+    """
+
+    n_parties: int
+    size_l: int
+    n_dishonest: int = 0
+    trials: int = 1
+    seed: int = 0
+    qsim_path: str = "factorized"
+    max_accepts_per_round: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.n_parties < 2:
+            raise ValueError("n_parties must be >= 2 (commander + >=1 lieutenant)")
+        if self.size_l < 1:
+            raise ValueError("size_l must be >= 1")
+        if not 0 <= self.n_dishonest <= self.n_parties:
+            raise ValueError(
+                f"n_dishonest must be in [0, n_parties]; got {self.n_dishonest}"
+            )
+        if self.trials < 1:
+            raise ValueError("trials must be >= 1")
+        if self.qsim_path not in ("factorized", "dense"):
+            raise ValueError(f"unknown qsim_path {self.qsim_path!r}")
+        if self.qsim_path == "dense" and self.total_qubits > 20:
+            raise ValueError(
+                f"dense qsim path infeasible at {self.total_qubits} qubits; "
+                "use qsim_path='factorized'"
+            )
+        if self.max_accepts_per_round is not None and self.max_accepts_per_round < 1:
+            raise ValueError("max_accepts_per_round must be >= 1")
+
+    # Derived parameters (``tfg.py:316-318``).
+    @property
+    def n_qubits(self) -> int:
+        """Qubits per party group: ceil(log2(n_parties + 1))."""
+        return max(1, math.ceil(math.log2(self.n_parties + 1)))
+
+    @property
+    def w(self) -> int:
+        """Number of possible order values, 2**n_qubits."""
+        return 2 ** self.n_qubits
+
+    @property
+    def total_qubits(self) -> int:
+        """Joint circuit width: (n_parties + 1) * n_qubits (``tfg.py:16``)."""
+        return (self.n_parties + 1) * self.n_qubits
+
+    @property
+    def n_lieutenants(self) -> int:
+        """Ranks 2..n_parties of the reference."""
+        return self.n_parties - 1
+
+    @property
+    def n_rounds(self) -> int:
+        """Voting rounds 1..n_dishonest+1 (``tfg.py:337``)."""
+        return self.n_dishonest + 1
+
+    @property
+    def max_l(self) -> int:
+        """Static bound on |L|: len(L) == round+1 at acceptance
+        (``tfg.py:294``), round <= n_dishonest+1, so |L| <= n_dishonest+2."""
+        return self.n_dishonest + 2
+
+    @property
+    def slots(self) -> int:
+        """Mailbox slots per (sender, round)."""
+        if self.max_accepts_per_round is not None:
+            return min(self.max_accepts_per_round, self.w)
+        return self.w
+
+    @property
+    def no_decision(self) -> int:
+        """Sentinel decision for an empty accepted-set Vi.
+
+        Divergence from the reference, which raises ``ValueError`` on
+        ``min(set())`` at ``tfg.py:306``; we return ``w`` (an impossible
+        order value) and keep the trial alive.
+        """
+        return self.w

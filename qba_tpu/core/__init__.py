@@ -1,0 +1,26 @@
+"""Pure functional protocol kernel — no I/O, no communication.
+
+TPU-native re-design of the reference's pure layer (SURVEY §1 L4):
+``consistent`` (``tfg.py:87-98``), ``measure_to_ints`` (``tfg.py:128-129``),
+``decide_order`` (``tfg.py:303-306``) and the success oracle
+(``tfg.py:359-363``) — all as fixed-shape masked-array functions that are
+jit/vmap/shard_map friendly.
+"""
+
+from qba_tpu.core.types import Evidence, Packet, empty_evidence, empty_packet
+from qba_tpu.core.consistent import consistent, append_own, compact_tuple
+from qba_tpu.core.decode import measure_to_ints
+from qba_tpu.core.decide import decide_order, success_oracle
+
+__all__ = [
+    "Evidence",
+    "Packet",
+    "empty_evidence",
+    "empty_packet",
+    "consistent",
+    "append_own",
+    "compact_tuple",
+    "measure_to_ints",
+    "decide_order",
+    "success_oracle",
+]

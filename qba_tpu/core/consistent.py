@@ -1,0 +1,89 @@
+"""Vectorized consistency predicate and evidence append.
+
+Re-designs ``consistent(v, L, w)`` (``tfg.py:87-98``) and the
+``L.add(tuple(Li[j] for j in P))`` append (``tfg.py:189,291``) over the
+compacted tuple-ordered :class:`~qba_tpu.core.types.Evidence` layout:
+
+Condition 1 — all tuples in L have the same length (``tfg.py:88-92``):
+  recorded per-row lengths agree over valid rows.
+Condition 2 — every element is in ``[0, w] \\ {v}`` (``tfg.py:93-94``; the
+  reference's ``x <= w`` off-by-one is preserved — protocol values are < w
+  anyway): no in-tuple entry equals v, exceeds w, or is negative.
+Condition 3 — every pair of tuples differs at every index (``tfg.py:96-98``):
+  no pair of valid rows agrees at any jointly-in-range tuple index.  Because
+  rows are compacted in tuple order, this is elementwise comparison — the
+  exact reference semantics, for any combination of P masks.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from qba_tpu.core.types import SENTINEL, Evidence
+
+
+def consistent(v: jnp.ndarray, ev: Evidence, w: int) -> jnp.ndarray:
+    """bool scalar: is (v, L) consistent? Vacuously true for empty L
+    (the reference only ever calls ``consistent`` with |L| >= 1)."""
+    max_l = ev.vals.shape[0]
+    valid = jnp.arange(max_l) < ev.count  # bool[max_l]
+    in_tuple = ev.vals != SENTINEL  # bool[max_l, size_l]
+
+    # Cond 1: lengths agree over valid rows (row 0 is valid whenever any is).
+    cond1 = jnp.all(jnp.where(valid, ev.lens == ev.lens[0], True))
+
+    # Cond 2: tuple entries of valid rows avoid v, stay in [0, w].
+    bad = in_tuple & ((ev.vals == v) | (ev.vals > w) | (ev.vals < 0))
+    cond2 = ~jnp.any(bad & valid[:, None])
+
+    # Cond 3: no tuple index where two valid rows agree.
+    eq = (
+        (ev.vals[:, None, :] == ev.vals[None, :, :])
+        & in_tuple[:, None, :]
+        & in_tuple[None, :, :]
+    )
+    collide = jnp.any(eq, axis=-1)  # bool[max_l, max_l]
+    pair = valid[:, None] & valid[None, :] & (
+        jnp.arange(max_l)[:, None] < jnp.arange(max_l)[None, :]
+    )
+    cond3 = ~jnp.any(collide & pair)
+
+    return cond1 & cond2 & cond3
+
+
+def compact_tuple(p_mask: jnp.ndarray, li: jnp.ndarray) -> jnp.ndarray:
+    """``tuple(Li[j] for j in P)`` as a SENTINEL-padded row: the values of
+    ``li`` at True positions of ``p_mask``, left-justified in ascending
+    position order.  The reference iterates the int-set ``P`` in CPython
+    hash-table order, which need not be sorted; any single ordering shared
+    by all rows yields identical ``consistent`` verdicts, and sorted order
+    is the one we fix (docs/DIVERGENCES.md D10)."""
+    size_l = p_mask.shape[0]
+    # Stable argsort puts selected positions first, preserving position order.
+    order = jnp.argsort(~p_mask, stable=True)
+    n_sel = jnp.sum(p_mask.astype(jnp.int32))
+    gathered = li[order].astype(jnp.int32)
+    return jnp.where(jnp.arange(size_l) < n_sel, gathered, SENTINEL)
+
+
+def append_own(ev: Evidence, p_mask: jnp.ndarray, li: jnp.ndarray) -> Evidence:
+    """Add this party's sub-list ``tuple(Li[j] for j in P)`` to L
+    (``tfg.py:189,291``) with set semantics (no-op if an identical row
+    exists)."""
+    max_l = ev.vals.shape[0]
+    own_vals = compact_tuple(p_mask, li)
+    own_len = jnp.sum(p_mask.astype(jnp.int32))
+
+    valid = jnp.arange(max_l) < ev.count
+    same_vals = jnp.all(ev.vals == own_vals[None, :], axis=-1)
+    dup = jnp.any(valid & same_vals)
+
+    # Scatter the new row at index `count` (guarded against overflow, which
+    # is unreachable by the |L| <= n_dishonest+2 bound — SURVEY §7).
+    slot = jnp.minimum(ev.count, max_l - 1)
+    at = jnp.arange(max_l) == slot
+    write = (~dup) & at
+    new_vals = jnp.where(write[:, None], own_vals[None, :], ev.vals)
+    new_lens = jnp.where(write, own_len, ev.lens)
+    new_count = jnp.where(dup, ev.count, jnp.minimum(ev.count + 1, max_l))
+    return Evidence(vals=new_vals, lens=new_lens, count=new_count)

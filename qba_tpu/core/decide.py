@@ -1,0 +1,39 @@
+"""Decision rule and end-to-end success oracle.
+
+Re-designs ``decide_order`` (``tfg.py:303-306``) and the verdict computed by
+rank 0 (``tfg.py:359-363``).  Divergence: the reference crashes on an empty
+accepted-set (``min(set())``, ``tfg.py:306``); here an empty ``Vi`` decides
+the sentinel ``w`` (an impossible order value) — see docs/DIVERGENCES.md.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def decide_order(
+    vi_mask: jnp.ndarray,
+    v: jnp.ndarray,
+    is_comm: jnp.ndarray,
+    w: int,
+) -> jnp.ndarray:
+    """``tfg.py:303-306``: the commander decides its own order ``v``; a
+    lieutenant decides ``min(Vi)`` over the accepted-set mask ``[w]`` — or
+    the sentinel ``w`` when ``Vi`` is empty (divergence D2)."""
+    candidates = jnp.where(vi_mask, jnp.arange(w, dtype=jnp.int32), w)
+    lieu = jnp.min(candidates).astype(jnp.int32)
+    return jnp.where(is_comm, jnp.asarray(v, jnp.int32), lieu)
+
+
+def success_oracle(decisions: jnp.ndarray, honest: jnp.ndarray) -> jnp.ndarray:
+    """The built-in Byzantine-agreement check (``tfg.py:359-363``).
+
+    ``decisions``: int32[n_parties] — index 0 is the commander (rank 1).
+    ``honest``: bool[n_parties] — same indexing.
+    Success iff the honest parties' decisions form a singleton set; all
+    parties dishonest -> empty set -> False, as in the reference.
+    """
+    first_idx = jnp.argmax(honest)  # index of first honest party
+    ref = decisions[first_idx]
+    agree = jnp.all(jnp.where(honest, decisions == ref, True))
+    return jnp.any(honest) & agree

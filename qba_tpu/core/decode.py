@@ -1,0 +1,21 @@
+"""Measurement-bit decoding.
+
+Re-designs ``measure_to_ints`` (``tfg.py:128-129``): the reference joins
+``n_qubits`` bit characters big-endian per list position and parses base-2.
+Here: one reshape + dot with powers of two, batched over any leading axes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def measure_to_ints(raw: jnp.ndarray, size_l: int, n_qubits: int) -> jnp.ndarray:
+    """``raw``: int bits ``[..., size_l * n_qubits]`` -> ints ``[..., size_l]``.
+
+    Big-endian within each group of ``n_qubits`` bits, matching the string
+    concatenation order of ``tfg.py:129``.
+    """
+    bits = raw.reshape(raw.shape[:-1] + (size_l, n_qubits))
+    weights = 2 ** jnp.arange(n_qubits - 1, -1, -1, dtype=jnp.int32)
+    return jnp.sum(bits.astype(jnp.int32) * weights, axis=-1)

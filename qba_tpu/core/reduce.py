@@ -1,0 +1,32 @@
+"""Position-axis reductions, optionally crossing a sharded mesh axis.
+
+The security-parameter axis ``size_l`` is the structural analog of sequence
+length (SURVEY §5 "Long-context"): positions are i.i.d. and every protocol
+reduction over them is a plain any/sum.  When ``size_l`` is sharded over a
+mesh axis under ``shard_map`` (sequence parallelism), these helpers finish
+the reduction with a ``psum`` over that axis; single-device callers pass
+``axis_name=None`` and get pure ``jnp`` reductions that XLA fuses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def psum_positions(x: jnp.ndarray, axis_name: str | None) -> jnp.ndarray:
+    """Sum over the trailing (positions) axis, then over the mesh axis."""
+    s = jnp.sum(x, axis=-1)
+    if axis_name is not None:
+        s = jax.lax.psum(s, axis_name)
+    return s
+
+
+def pany_positions(x: jnp.ndarray, axis_name: str | None) -> jnp.ndarray:
+    """Logical any over the trailing (positions) axis + mesh axis."""
+    return psum_positions(x.astype(jnp.int32), axis_name) > 0
+
+
+def pall_positions(x: jnp.ndarray, axis_name: str | None) -> jnp.ndarray:
+    """Logical all over the trailing (positions) axis + mesh axis."""
+    return ~pany_positions(~x, axis_name)

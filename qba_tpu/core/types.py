@@ -1,0 +1,64 @@
+"""Fixed-shape array encodings of the reference's variable-size sets.
+
+The reference moves Python sets over the wire: a packet is
+``(P: set[int], v: int, L: set[tuple[int]])`` (``tfg.py:199-263``).  Under
+XLA everything must be static-shape, so (SURVEY §5 "Distributed communication
+backend"):
+
+* ``P``  -> bool mask ``[size_l]``
+* ``v``  -> int32 scalar
+* ``L``  -> an :class:`Evidence` matrix: up to ``max_l`` rows, each holding
+  one tuple **compacted in tuple order** — row ``i``'s entry ``t`` is the
+  ``t``-th element of that tuple, with sentinel ``-1`` past the tuple's
+  length.  This mirrors the reference's tuples exactly: condition 3 of
+  ``consistent`` compares elements *by tuple index* (``tfg.py:96-98``), and
+  tuple equality (the ``set`` dedup of ``tfg.py:189,291``) is elementwise
+  equality in this layout.  Per-row lengths are stored explicitly so the
+  length condition (``tfg.py:88-92``) survives the clear-P attack
+  (``tfg.py:281``).
+* accepted-set ``Vi`` -> bool mask ``[w]``.
+
+Tuple elements are order values in ``[0, w)``; ``-1`` never collides with a
+representable element (``docs/DIVERGENCES.md`` D4).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+SENTINEL = -1  # "past the end of this row's tuple"
+
+
+@struct.dataclass
+class Evidence:
+    """The set L of sub-list tuples carried by a packet (``tfg.py:189,291``)."""
+
+    vals: jnp.ndarray  # int32[max_l, size_l], tuple-ordered, SENTINEL-padded
+    lens: jnp.ndarray  # int32[max_l], tuple length per row
+    count: jnp.ndarray  # int32 scalar, number of valid rows
+
+
+@struct.dataclass
+class Packet:
+    """One (P, v, L) protocol message (``tfg.py:199-263``)."""
+
+    p_mask: jnp.ndarray  # bool[size_l]
+    v: jnp.ndarray  # int32 scalar
+    evidence: Evidence
+
+
+def empty_evidence(max_l: int, size_l: int) -> Evidence:
+    return Evidence(
+        vals=jnp.full((max_l, size_l), SENTINEL, dtype=jnp.int32),
+        lens=jnp.zeros((max_l,), dtype=jnp.int32),
+        count=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def empty_packet(max_l: int, size_l: int) -> Packet:
+    return Packet(
+        p_mask=jnp.zeros((size_l,), dtype=bool),
+        v=jnp.zeros((), dtype=jnp.int32),
+        evidence=empty_evidence(max_l, size_l),
+    )

@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip TPU hardware is not available in CI; sharding tests run on
+virtual CPU devices exactly as the driver's dryrun does.
+"""
+
+import os
+
+# Force CPU: the ambient environment may point JAX at a remote TPU tunnel
+# (a sitecustomize registers the backend before any conftest runs, so the
+# env var alone is not enough — the config update below is authoritative).
+# Remote per-op compiles make tests orders of magnitude slower, and the
+# sharding tests need the virtual 8-device CPU mesh anyway.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

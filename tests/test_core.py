@@ -1,0 +1,220 @@
+"""Unit tests for the pure protocol kernel against a set-based oracle.
+
+The oracle functions below re-state the reference's semantics
+(``tfg.py:87-98,128-129,303-306,359-363``) over Python sets, and the
+fixed-shape kernel is checked against them on randomized inputs.
+"""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.core import (
+    Evidence,
+    append_own,
+    consistent,
+    decide_order,
+    empty_evidence,
+    measure_to_ints,
+    success_oracle,
+)
+
+
+def oracle_consistent(v, L, w):
+    """Set-of-tuples restatement of ``consistent`` (``tfg.py:87-98``)."""
+    if not L:
+        return True
+    lens = {len(t) for t in L}
+    if len(lens) != 1:
+        return False
+    if not all(0 <= x <= w and x != v for t in L for x in t):
+        return False
+    the_len = next(iter(lens))
+    for a, b in itertools.combinations(L, 2):
+        if any(a[k] == b[k] for k in range(the_len)):
+            return False
+    return True
+
+
+def evidence_from_tuples(tuples, max_l, size_l):
+    """Build an Evidence from a list of tuples (compacted tuple-order form)."""
+    ev = empty_evidence(max_l, size_l)
+    vals = np.array(ev.vals)
+    lens = np.array(ev.lens)
+    for i, tv in enumerate(tuples):
+        vals[i, : len(tv)] = tv
+        lens[i] = len(tv)
+    return Evidence(
+        vals=jnp.asarray(vals),
+        lens=jnp.asarray(lens),
+        count=jnp.asarray(len(tuples), dtype=jnp.int32),
+    )
+
+
+class TestConsistent:
+    W, SIZE_L, MAX_L = 4, 8, 4
+
+    def check(self, v, rows):
+        """rows: list of value-tuples (as the reference's set of tuples)."""
+        ev = evidence_from_tuples(rows, self.MAX_L, self.SIZE_L)
+        got = bool(consistent(jnp.asarray(v), ev, self.W))
+        want = oracle_consistent(v, set(rows), self.W)
+        assert got == want, f"v={v} rows={rows}: got {got}, want {want}"
+
+    def test_empty_is_consistent(self):
+        self.check(2, [])
+
+    def test_single_row_ok(self):
+        self.check(1, [(2, 3, 0)])
+
+    def test_contains_v_fails(self):
+        self.check(3, [(2, 3, 0)])
+
+    def test_length_mismatch_fails(self):
+        self.check(1, [(2, 3, 0), (3, 2)])
+
+    def test_pairwise_collision_fails(self):
+        self.check(1, [(2, 3, 0), (2, 0, 3)])
+
+    def test_pairwise_distinct_ok(self):
+        self.check(1, [(2, 3, 0), (3, 0, 2)])
+
+    def test_empty_tuples_vacuous(self):
+        # clear-P attack endpoint: L = {()} is consistent (tfg.py:281 case)
+        self.check(1, [()])
+
+    def test_collision_at_tuple_index_from_different_p(self):
+        # Rows built from *different* P masks but equal length: the
+        # reference compares by tuple index (tfg.py:96-98) -> collision.
+        self.check(1, [(2, 3), (2, 0)])
+
+    def test_negative_value_fails(self):
+        # reference cond 2 lower bound: 0 <= x (tfg.py:94)
+        self.check(1, [(2, -2)])
+
+    def test_randomized_against_oracle(self):
+        rng = np.random.default_rng(0)
+        for _ in range(300):
+            n_rows = int(rng.integers(1, self.MAX_L + 1))
+            the_len = int(rng.integers(0, 5))
+            rows, seen = [], set()
+            for _ in range(n_rows):
+                # occasional length mutation + out-of-range values to hit
+                # conditions 1 and 2, not just 3
+                ln = the_len if rng.random() < 0.8 else int(rng.integers(0, 5))
+                tv = tuple(
+                    int(x) for x in rng.integers(-1 if ln else 0, self.W + 2, ln)
+                )
+                if -1 in tv:
+                    continue  # -1 not representable (docs/DIVERGENCES.md D4)
+                if tv not in seen:  # set semantics
+                    seen.add(tv)
+                    rows.append(tv)
+            self.check(int(rng.integers(0, self.W)), rows)
+
+
+class TestAppendOwn:
+    def test_append_and_dedup(self):
+        size_l, max_l = 6, 3
+        ev = empty_evidence(max_l, size_l)
+        p = jnp.asarray([True, False, True, False, False, False])
+        li = jnp.asarray([1, 9, 2, 9, 9, 9], dtype=jnp.int32)
+        ev = append_own(ev, p, li)
+        assert int(ev.count) == 1
+        assert int(ev.lens[0]) == 2
+        # compacted tuple order: values at positions {0, 2} left-justified
+        assert ev.vals[0].tolist() == [1, 2, -1, -1, -1, -1]
+        # identical append is a no-op (set semantics, tfg.py:291)
+        ev = append_own(ev, p, li)
+        assert int(ev.count) == 1
+        # different values -> second row
+        li2 = jnp.asarray([3, 9, 0, 9, 9, 9], dtype=jnp.int32)
+        ev = append_own(ev, p, li2)
+        assert int(ev.count) == 2
+        assert int(ev.lens[1]) == 2
+
+    def test_empty_p_appends_empty_tuple(self):
+        ev = empty_evidence(2, 4)
+        p = jnp.zeros(4, dtype=bool)
+        li = jnp.asarray([1, 2, 3, 0], dtype=jnp.int32)
+        ev = append_own(ev, p, li)
+        assert int(ev.count) == 1 and int(ev.lens[0]) == 0
+        ev = append_own(ev, p, li)  # () deduped
+        assert int(ev.count) == 1
+
+
+class TestDecode:
+    def test_matches_reference_semantics(self):
+        # oracle: int("".join(bits), 2) per group (tfg.py:129)
+        rng = np.random.default_rng(1)
+        size_l, n_qubits = 5, 3
+        raw = rng.integers(0, 2, size_l * n_qubits)
+        want = [
+            int("".join(str(x) for x in raw[i * n_qubits : (i + 1) * n_qubits]), 2)
+            for i in range(size_l)
+        ]
+        got = measure_to_ints(jnp.asarray(raw), size_l, n_qubits)
+        assert got.tolist() == want
+
+    def test_batched(self):
+        raw = jnp.asarray([[0, 1, 1, 0], [1, 1, 0, 1]])
+        got = measure_to_ints(raw, 2, 2)
+        assert got.tolist() == [[1, 2], [3, 1]]
+
+
+class TestDecide:
+    def test_min_of_vi(self):
+        vi = jnp.asarray([False, False, True, True])
+        assert int(decide_order(vi, jnp.asarray(0), jnp.asarray(False), 4)) == 2
+
+    def test_commander_returns_own_v(self):
+        # tfg.py:303-305: the commander decides v regardless of Vi
+        vi = jnp.asarray([False, True, False, False])
+        assert int(decide_order(vi, jnp.asarray(3), jnp.asarray(True), 4)) == 3
+
+    def test_empty_vi_sentinel(self):
+        # divergence D2: reference raises ValueError (tfg.py:306)
+        vi = jnp.zeros(4, dtype=bool)
+        assert int(decide_order(vi, jnp.asarray(0), jnp.asarray(False), 4)) == 4
+
+
+class TestOracle:
+    def test_unanimous_honest(self):
+        d = jnp.asarray([3, 3, 3])
+        h = jnp.asarray([True, True, True])
+        assert bool(success_oracle(d, h))
+
+    def test_dishonest_excluded(self):
+        d = jnp.asarray([3, 3, 0])
+        h = jnp.asarray([True, True, False])
+        assert bool(success_oracle(d, h))
+
+    def test_disagreement_fails(self):
+        d = jnp.asarray([3, 0, 3])
+        h = jnp.asarray([True, True, True])
+        assert not bool(success_oracle(d, h))
+
+    def test_all_dishonest_fails(self):
+        d = jnp.asarray([1, 1])
+        h = jnp.asarray([False, False])
+        assert not bool(success_oracle(d, h))
+
+
+class TestConfig:
+    def test_derived_params_match_logs(self):
+        # w = 4 for 3 parties (log_3.txt:2), w = 16 for 11 (log_11.txt:10)
+        assert QBAConfig(n_parties=3, size_l=4).w == 4
+        assert QBAConfig(n_parties=11, size_l=4).w == 16
+        assert QBAConfig(n_parties=11, size_l=4).n_qubits == 4
+        assert QBAConfig(n_parties=11, size_l=4).total_qubits == 48
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            QBAConfig(n_parties=3, size_l=4, n_dishonest=7)
+        with pytest.raises(ValueError):
+            QBAConfig(n_parties=1, size_l=4)
+        with pytest.raises(ValueError):
+            QBAConfig(n_parties=11, size_l=4, qsim_path="dense")  # 48 qubits
