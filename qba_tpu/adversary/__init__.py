@@ -1,0 +1,23 @@
+"""Vectorized Byzantine fault injection (SURVEY §2.9-2.10).
+
+The reference threads an ``is_biz`` flag through every broadcast
+(``tfg.py:101-125,169-181,271-284``); here the adversary is a first-class
+configurable model: a per-rank honesty mask, commander equivocation as a
+per-recipient order vector, and the 4-action lieutenant attack sampled
+independently per (broadcast, recipient) at delivery time
+(docs/DIVERGENCES.md D3).
+"""
+
+from qba_tpu.adversary.model import (
+    assign_dishonest,
+    commander_orders,
+    corrupt_at_delivery,
+    sample_attack,
+)
+
+__all__ = [
+    "assign_dishonest",
+    "commander_orders",
+    "corrupt_at_delivery",
+    "sample_attack",
+]

@@ -1,0 +1,113 @@
+"""Adversary model: honesty assignment, equivocation, message corruption.
+
+Mirrors the reference's three fault-injection sites:
+
+* ``dishonest_comm`` (``tfg.py:101-125``): rank 0 samples ``nDishonest``
+  distinct ranks from ``1..nParties`` — the commander can be dishonest.
+* dishonest-commander equivocation (``tfg.py:169-181``): two distinct
+  orders ``v1 != v2``, split across lieutenants at rank
+  ``(nParties+1)//2``.
+* the 4-action dishonest-lieutenant attack (``tfg.py:271-284``): per
+  recipient, uniformly pick (0) drop with prob 1/2, (1) replace ``v`` with
+  a uniform draw from ``[0, nParties+1)`` (the reference's range — *not*
+  ``[0, w)``), (2) clear ``P``, (3) clear ``L``.
+
+Corruption is applied at delivery time with a key derived from
+(trial, round, sender, slot, receiver) — distributionally identical to the
+reference's send-side sampling, minus its shared-object mutation accident
+(docs/DIVERGENCES.md D3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.core.types import Packet, empty_evidence
+
+
+def assign_dishonest(cfg: QBAConfig, key: jax.Array) -> jnp.ndarray:
+    """bool[n_parties + 1] honesty mask indexed by rank (rank 0 = QSD,
+    always honest).  ``nDishonest`` distinct ranks drawn from
+    ``1..n_parties`` without replacement (``tfg.py:105``)."""
+    perm = jax.random.permutation(key, jnp.arange(1, cfg.n_parties + 1))
+    dishonest_ranks = perm[: cfg.n_dishonest]
+    ranks = jnp.arange(cfg.n_parties + 1)
+    is_dishonest = jnp.any(ranks[:, None] == dishonest_ranks[None, :], axis=1)
+    return ~is_dishonest
+
+
+def commander_orders(
+    cfg: QBAConfig, key: jax.Array, commander_honest: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-lieutenant order vector and the commander's own order.
+
+    Honest: one uniform ``v`` sent to everyone (``tfg.py:329``).
+    Dishonest: ``v1 != v2`` uniform, lieutenants at rank
+    ``i <= (nParties+1)//2`` get ``v1``, the rest ``v2``
+    (``tfg.py:169-181``); the commander still *decides* its privately
+    chosen ``v`` (``tfg.py:303-305,358`` — the equivocation values are
+    local to the broadcast).
+
+    Returns ``(v_sent: int32[n_lieutenants], v_comm: int32)``.
+    """
+    k_v, k_1, k_2 = jax.random.split(key, 3)
+    w = cfg.w
+    v = jax.random.randint(k_v, (), 0, w, dtype=jnp.int32)
+    v1 = jax.random.randint(k_1, (), 0, w, dtype=jnp.int32)
+    # Uniform over the w-1 values != v1 — same law as the reference's
+    # rejection loop (tfg.py:173-175).
+    v2 = (v1 + 1 + jax.random.randint(k_2, (), 0, w - 1, dtype=jnp.int32)) % w
+    ranks = jnp.arange(2, cfg.n_parties + 1, dtype=jnp.int32)
+    equivocated = jnp.where(ranks <= (cfg.n_parties + 1) // 2, v1, v2)
+    v_sent = jnp.where(commander_honest, v, equivocated)
+    return v_sent, v
+
+
+def sample_attack(cfg: QBAConfig, key: jax.Array):
+    """Draw one (action, coin, rand_v) attack triple.
+
+    Shared by the vectorized engine and the local differential backend so
+    both consume identical randomness for a given key (the key is derived
+    from (trial, round, receiver, cell) — there is no sequential stream to
+    misalign).
+    """
+    k_action, k_coin, k_v = jax.random.split(key, 3)
+    action = jax.random.randint(k_action, (), 0, 4)
+    coin = jax.random.randint(k_coin, (), 0, 2)
+    rand_v = jax.random.randint(k_v, (), 0, cfg.n_parties + 1, dtype=jnp.int32)
+    return action, coin, rand_v
+
+
+def corrupt_at_delivery(
+    cfg: QBAConfig,
+    key: jax.Array,
+    packet: Packet,
+    sender_honest: jnp.ndarray,
+) -> tuple[Packet, jnp.ndarray]:
+    """Apply the 4-action attack to one delivered packet.
+
+    Returns ``(packet', delivered)``; no-op (and always delivered) when the
+    sender is honest.
+    """
+    action, coin, rand_v = sample_attack(cfg, key)
+    biz = ~sender_honest
+
+    # Action 0: drop with probability 1/2 (tfg.py:274).
+    delivered = ~(biz & (action == 0) & (coin == 0))
+
+    # Action 1: random order from [0, nParties+1) (tfg.py:277).
+    v = jnp.where(biz & (action == 1), rand_v, packet.v)
+
+    # Action 2: clear P (tfg.py:281).
+    p_mask = jnp.where(biz & (action == 2), False, packet.p_mask)
+
+    # Action 3: clear L (tfg.py:283).
+    empty = empty_evidence(*packet.evidence.vals.shape)
+    clear_l = biz & (action == 3)
+    evidence = jax.tree.map(
+        lambda e, z: jnp.where(clear_l, z, e), packet.evidence, empty
+    )
+
+    return Packet(p_mask=p_mask, v=v, evidence=evidence), delivered

@@ -1,0 +1,19 @@
+"""Execution backends (SURVEY §7.5 — the plugin seam).
+
+The reference's backend boundary is the MPI rank: one OS process per party
+(``tfg.py:310-314``).  Here:
+
+* ``jax`` — the production path: trials ``vmap``-batched and jitted, party
+  and position axes vectorized, shardable over a TPU mesh
+  (:mod:`qba_tpu.parallel`).
+* ``local`` — a message-level pure-Python reference path preserving the
+  per-party send/receive structure (sets of tuples, per-party mailboxes)
+  for differential testing and CPU baselining.  It consumes the *same*
+  keyed randomness as the jax engine, so per-trial outcomes must match
+  exactly — the two independent implementations check each other.
+"""
+
+from qba_tpu.backends.jax_backend import MonteCarloResult, run_trials
+from qba_tpu.backends.local_backend import run_trial_local
+
+__all__ = ["MonteCarloResult", "run_trials", "run_trial_local"]

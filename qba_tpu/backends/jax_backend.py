@@ -1,0 +1,53 @@
+"""Batched Monte-Carlo runner — backend=jax.
+
+The reference runs one trial per ``mpiexec`` invocation; here a trial is a
+pure function of its key, so a Monte-Carlo sweep is ``vmap`` + ``jit``
+(SURVEY §2 "Parallelism strategies": the trial axis replaces mpiexec
+ranks).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.rounds import TrialResult, run_trial
+
+
+@struct.dataclass
+class MonteCarloResult:
+    """Aggregate over a trial batch."""
+
+    trials: TrialResult  # all per-trial fields, leading axis = trials
+    success_rate: jnp.ndarray  # float32 scalar
+
+    @property
+    def n_trials(self) -> int:
+        return self.trials.decisions.shape[0]
+
+
+def trial_keys(cfg: QBAConfig) -> jax.Array:
+    """The batch's key tree root: one key per trial from the config seed."""
+    return jax.random.split(jax.random.key(cfg.seed), cfg.trials)
+
+
+# QBAConfig is frozen/hashable, so it can be a jit static argument — the
+# compiled batch program is cached across run_trials calls per config.
+@functools.partial(jax.jit, static_argnums=0)
+def _batched(cfg: QBAConfig, keys: jax.Array) -> TrialResult:
+    return jax.vmap(lambda k: run_trial(cfg, k))(keys)
+
+
+def run_trials(cfg: QBAConfig, keys: jax.Array | None = None) -> MonteCarloResult:
+    """Run ``cfg.trials`` independent protocol executions, batched."""
+    if keys is None:
+        keys = trial_keys(cfg)
+    trials = _batched(cfg, keys)
+    return MonteCarloResult(
+        trials=trials,
+        success_rate=jnp.mean(trials.success.astype(jnp.float32)),
+    )

@@ -1,0 +1,134 @@
+"""Message-level pure-Python reference backend — backend=local.
+
+An independent re-implementation of the protocol with the reference's data
+model — Python sets of int positions, sets of tuples, per-party mailboxes,
+explicit per-packet receive loops (``tfg.py:87-98,185-300,337-348``) —
+instead of the vectorized masked arrays of :mod:`qba_tpu.rounds`.
+
+It consumes the *identical* keyed randomness as the jax engine (same key
+tree: dishonesty, lists, orders, per-(round, receiver, cell) attack
+draws), so for any config and trial key the decisions and verdict must
+match the jax engine exactly.  ``tests/test_differential.py`` enforces
+this; the backend doubles as the CPU wall-clock baseline for ``bench.py``
+(the closest stand-in for the unavailable ``mpiexec`` reference run).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import numpy as np
+
+from qba_tpu.adversary import assign_dishonest, commander_orders, sample_attack
+from qba_tpu.config import QBAConfig
+from qba_tpu.qsim import generate_lists, generate_lists_dense
+
+
+def _consistent(v: int, L: set, w: int) -> bool:
+    """The reference predicate over sets of tuples (``tfg.py:87-98``)."""
+    if not L:
+        return True
+    lens = {len(t) for t in L}
+    if len(lens) != 1:
+        return False
+    if not all(0 <= x <= w and x != v for t in L for x in t):
+        return False
+    n = next(iter(lens))
+    return all(
+        all(a[k] != b[k] for k in range(n))
+        for a, b in itertools.combinations(L, 2)
+    )
+
+
+def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
+    """One protocol execution over Python sets; returns the rank-0 summary
+    (``tfg.py:351-363``) plus diagnostics mirroring TrialResult."""
+    k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
+
+    honest = np.asarray(assign_dishonest(cfg, k_dis))
+    gen = generate_lists if cfg.qsim_path == "factorized" else generate_lists_dense
+    lists = np.asarray(gen(cfg, k_lists)[0])
+    v_sent_arr, v_comm = commander_orders(
+        cfg, k_comm, jax.numpy.asarray(bool(honest[1]))
+    )
+    v_sent = [int(x) for x in np.asarray(v_sent_arr)]
+    v_comm = int(v_comm)
+
+    n_lieu, w, slots = cfg.n_lieutenants, cfg.w, cfg.slots
+    li = [[int(x) for x in lists[i + 2]] for i in range(n_lieu)]
+    vi: list[set] = [set() for _ in range(n_lieu)]
+    overflow = False
+
+    # Step 1b: the commander's recovered Q-correlated positions
+    # (tfg.py:325-328).
+    isq = {k for k in range(cfg.size_l) if lists[0][k] != lists[1][k]}
+
+    # Step 2 + 3a (tfg.py:166-196): per-sender packet lists; the list index
+    # is the mailbox slot (same numbering as the dense mailbox tensor).
+    mailbox: list[list] = [[] for _ in range(n_lieu)]
+    for i in range(n_lieu):
+        p = {k for k in isq if int(lists[1][k]) == v_sent[i]}
+        v = v_sent[i]
+        ell = {tuple(li[i][j] for j in sorted(p))}
+        if _consistent(v, ell, w):
+            vi[i].add(v)
+            mailbox[i].append((p, v, ell))
+
+    # Step 3b (tfg.py:337-348): synchronous rounds.
+    for rnd in range(1, cfg.n_rounds + 1):
+        k_round = jax.random.fold_in(k_rounds, rnd)
+        out: list[list] = [[] for _ in range(n_lieu)]
+        for recv in range(n_lieu):
+            k_recv = jax.random.fold_in(k_round, recv)
+            for sender in range(n_lieu):
+                for slot in range(min(slots, len(mailbox[sender]))):
+                    if sender == recv:
+                        continue
+                    p, v, ell = mailbox[sender][slot]
+                    action, coin, rand_v = (
+                        int(x)
+                        for x in sample_attack(
+                            cfg,
+                            jax.random.fold_in(k_recv, sender * slots + slot),
+                        )
+                    )
+                    p2, v2, ell2 = set(p), v, set(ell)
+                    if not honest[sender + 2]:  # tfg.py:271-284
+                        if action == 0 and coin == 0:
+                            continue
+                        if action == 1:
+                            v2 = rand_v
+                        elif action == 2:
+                            p2 = set()
+                        elif action == 3:
+                            ell2 = set()
+                    # lieu_receive (tfg.py:289-300)
+                    ell2.add(tuple(li[recv][j] for j in sorted(p2)))
+                    if (
+                        _consistent(v2, ell2, w)
+                        and v2 not in vi[recv]
+                        and len(ell2) == rnd + 1
+                    ):
+                        vi[recv].add(v2)
+                        if rnd <= cfg.n_dishonest:
+                            if len(out[recv]) < slots:
+                                out[recv].append((p2, v2, ell2))
+                            else:
+                                overflow = True
+        mailbox = out
+
+    # Decision + verdict (tfg.py:303-306,351-363; empty-Vi sentinel is D2).
+    decisions = [v_comm] + [
+        min(vi[i]) if vi[i] else cfg.no_decision for i in range(n_lieu)
+    ]
+    honest_parties = [bool(h) for h in honest[1:]]
+    filtered = {d for d, h in zip(decisions, honest_parties) if h}
+    return {
+        "success": len(filtered) == 1,
+        "decisions": decisions,
+        "honest": honest_parties,
+        "v_comm": v_comm,
+        "vi": [set(s) for s in vi],
+        "overflow": overflow,
+    }

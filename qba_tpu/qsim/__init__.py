@@ -1,0 +1,36 @@
+"""TPU quantum engine — replaces the reference's external qsimov/doki stack.
+
+The reference simulates every list position with a joint
+``(nParties+1)*nQubits``-qubit circuit through the native qsimov engine
+(``tfg.py:4,43-84``).  Here (SURVEY §7.2):
+
+* :mod:`qba_tpu.qsim.statevector` — dense statevector kernels in
+  ``jax.numpy``: gate application by axis algebra, measurement by Born
+  sampling.  General path, feasible to ~20 qubits; used for validation.
+* :mod:`qba_tpu.qsim.circuit` — a circuit/gate builder covering the qsimov
+  API surface the reference uses (H, X, controlled-X, full measurement),
+  compiled to one jitted statevector program.
+* :mod:`qba_tpu.qsim.protocol_circuits` — the protocol's two circuit
+  families (``notQCorrelated``/``qCorrelated``, ``tfg.py:15-65``) on the
+  dense engine.
+* :mod:`qba_tpu.qsim.sampler` — the factorized closed-form sampler
+  (SURVEY §2.6): the exact output distribution of those Clifford circuits,
+  sampled directly; scales to any ``nParties`` and is the production path.
+"""
+
+from qba_tpu.qsim.circuit import Circuit, Gate
+from qba_tpu.qsim.sampler import generate_lists
+from qba_tpu.qsim.protocol_circuits import (
+    generate_lists_dense,
+    not_q_correlated,
+    q_correlated,
+)
+
+__all__ = [
+    "Circuit",
+    "Gate",
+    "generate_lists",
+    "generate_lists_dense",
+    "not_q_correlated",
+    "q_correlated",
+]

@@ -1,0 +1,109 @@
+"""The protocol's two circuit families on the dense engine.
+
+Builds the reference's gates/circuits (``notQCorrelated`` ``tfg.py:15-22``,
+``qCorrelated`` ``tfg.py:25-40``, assemblers ``tfg.py:43-65``) and the
+dense-path list generation (``generacionListas``, ``tfg.py:68-84``) —
+``vmap``-batched over list positions instead of the reference's serial
+per-position loop.
+
+Qubit layout: ``(nParties+1)`` groups of ``nQubits``; group 0 is the QSD's
+extra copy, group 1 the commander's particles (``tfg.py:142-158``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.core.decode import measure_to_ints
+from qba_tpu.qsim.circuit import Circuit, Gate
+
+
+def not_q_correlated(n_parties: int, n_qubits: int) -> Gate:
+    """H on every qubit of groups 1..nParties, then CNOT copying group 1
+    onto group 0 (``tfg.py:15-22``)."""
+    size = (n_parties + 1) * n_qubits
+    gate = Gate(size, "not Q-Correlated")
+    for i in range(n_qubits, size):
+        gate.add_operation("H", targets=i)
+    for i in range(n_qubits):
+        gate.add_operation("X", targets=i, controls=i + n_qubits)
+    return gate
+
+
+def q_correlated(n_parties: int, n_qubits: int) -> Gate:
+    """H on group 0; X-encode a permutation value into each party group
+    (as parameterized XPOW ops reading the permutation's bits at runtime —
+    the reference bakes fresh ``rands`` into a new circuit per position,
+    ``tfg.py:25-40``); CNOT group 0 onto every other group."""
+    size = (n_parties + 1) * n_qubits
+    gate = Gate(size, "Q-Correlated")
+    for i in range(n_qubits):
+        gate.add_operation("H", targets=i)
+    for i in range(1, n_parties + 1):
+        for j in range(n_qubits):
+            # param vector layout: bit j (big-endian) of rands[i-1]
+            gate.add_operation(
+                "XPOW", targets=i * n_qubits + j, param=(i - 1) * n_qubits + j
+            )
+    for i in range(n_qubits, size):
+        gate.add_operation("X", targets=i, controls=i % n_qubits)
+    return gate
+
+
+def gen_q_corr_circuit(n_parties: int, n_qubits: int) -> Circuit:
+    """``genQCorrCircuit`` (``tfg.py:43-52``)."""
+    size = (n_parties + 1) * n_qubits
+    return Circuit(size, "Q-Correlated Circuit").add_operation(
+        q_correlated(n_parties, n_qubits)
+    )
+
+
+def gen_nq_corr_circuit(n_parties: int, n_qubits: int) -> Circuit:
+    """``genNQCorrCircuit`` (``tfg.py:56-65``)."""
+    size = (n_parties + 1) * n_qubits
+    return Circuit(size, "Not Q-Correlated Circuit").add_operation(
+        not_q_correlated(n_parties, n_qubits)
+    )
+
+
+def _perm_bits(perm: jnp.ndarray, n_qubits: int) -> jnp.ndarray:
+    """Big-endian bits of each permutation entry: [n] -> [n * n_qubits]."""
+    shifts = jnp.arange(n_qubits - 1, -1, -1, dtype=jnp.int32)
+    return ((perm[:, None] >> shifts) & 1).reshape(-1).astype(jnp.int32)
+
+
+def generate_lists_dense(cfg: QBAConfig, key: jax.Array):
+    """Dense-path ``generacionListas`` (``tfg.py:68-84``), one Born sample
+    per list position, all positions batched with ``vmap``.
+
+    Returns ``(lists, qcorr)``: int32 ``[n_parties+1, size_l]`` decoded
+    order values per party (row 0 = QSD extra copy, row 1 = commander),
+    and the ground-truth Q-correlated position mask ``[size_l]``.
+    """
+    n, nq = cfg.n_parties, cfg.n_qubits
+    run_q = gen_q_corr_circuit(n, nq).compile()
+    run_nq = gen_nq_corr_circuit(n, nq).compile()
+
+    k_qcorr, k_perm, k_meas = jax.random.split(key, 3)
+    qcorr = jax.random.bernoulli(k_qcorr, 0.5, (cfg.size_l,))
+
+    def one_position(k_p, k_m, is_q):
+        perm = jax.random.permutation(k_p, jnp.arange(1, n + 1, dtype=jnp.int32))
+        params = _perm_bits(perm, nq)
+        # Both branches cost one small statevector each at validation sizes;
+        # select keeps the program branch-free under vmap.
+        bits_q = run_q(k_m, params)
+        bits_nq = run_nq(k_m)
+        return jnp.where(is_q, bits_q, bits_nq)
+
+    perm_keys = jax.random.split(k_perm, cfg.size_l)
+    meas_keys = jax.random.split(k_meas, cfg.size_l)
+    bits = jax.vmap(one_position)(perm_keys, meas_keys, qcorr)  # [size_l, total_qubits]
+
+    # Regroup to the reference's raw layout: party i's bits across positions
+    # (tfg.py:81-82), then decode (tfg.py:128-129).
+    per_party = bits.reshape(cfg.size_l, n + 1, nq).transpose(1, 0, 2)
+    lists = measure_to_ints(per_party.reshape(n + 1, -1), cfg.size_l, nq)
+    return lists, qcorr

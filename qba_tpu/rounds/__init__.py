@@ -1,0 +1,12 @@
+"""Synchronous round engine (SURVEY §7.4).
+
+Replaces the reference's MPI voting loop — ``Iprobe`` mailbox drains,
+per-field tagged ``Isend``/``Irecv`` packets, and inter-round barriers
+(``tfg.py:199-263,335-348``) — with a dense mailbox tensor delivered
+deterministically once per round under ``lax.scan``.
+"""
+
+from qba_tpu.rounds.mailbox import Mailbox, empty_mailbox
+from qba_tpu.rounds.engine import run_trial, TrialResult
+
+__all__ = ["Mailbox", "empty_mailbox", "run_trial", "TrialResult"]

@@ -1,0 +1,191 @@
+"""End-to-end single-trial protocol engine.
+
+The reference's orchestrator ``QBA`` (``tfg.py:309-363``) branches on MPI
+rank; here every phase is an array op over the party axis:
+
+* dishonesty assignment  -> honesty mask          (``tfg.py:101-125``)
+* particle distribution  -> qsim generation        (``tfg.py:132-163``)
+* step 1b + step 2       -> per-recipient P, v     (``tfg.py:166-184,325-329``)
+* step 3a                -> vmapped first receive  (``tfg.py:185-196``)
+* step 3b round loop     -> ``lax.scan`` over a dense mailbox
+                            (``tfg.py:289-300,337-348``)
+* decision + oracle      -> masked min + singleton check
+                            (``tfg.py:303-306,351-363``)
+
+Rounds are synchronous by construction (docs/DIVERGENCES.md D1); packet
+processing order within a round is (sender, slot) lexicographic (D5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from qba_tpu.adversary import assign_dishonest, commander_orders, corrupt_at_delivery
+from qba_tpu.config import QBAConfig
+from qba_tpu.core import append_own, consistent, decide_order, success_oracle
+from qba_tpu.core.types import SENTINEL, Evidence, Packet, empty_evidence
+from qba_tpu.qsim import generate_lists, generate_lists_dense
+from qba_tpu.rounds.mailbox import Mailbox, empty_mailbox
+
+
+@struct.dataclass
+class TrialResult:
+    """Everything rank 0 prints at the end of a run (``tfg.py:351-363``),
+    plus TPU-design diagnostics."""
+
+    success: jnp.ndarray  # bool
+    decisions: jnp.ndarray  # int32[n_parties], index 0 = commander (rank 1)
+    honest: jnp.ndarray  # bool[n_parties], same indexing
+    v_comm: jnp.ndarray  # int32 — the commander's privately chosen order
+    vi: jnp.ndarray  # bool[n_lieutenants, w] accepted-sets
+    overflow: jnp.ndarray  # bool — a rebroadcast exceeded the slot bound
+
+
+def _empty_out_cells(cfg: QBAConfig):
+    """One sender's row of the next round's mailbox."""
+    slots, max_l, s = cfg.slots, cfg.max_l, cfg.size_l
+    return (
+        jnp.full((slots, max_l, s), SENTINEL, dtype=jnp.int32),
+        jnp.zeros((slots, max_l), dtype=jnp.int32),
+        jnp.zeros((slots,), dtype=jnp.int32),
+        jnp.zeros((slots, s), dtype=bool),
+        jnp.zeros((slots,), dtype=jnp.int32),
+        jnp.zeros((slots,), dtype=bool),
+    )
+
+
+def _write_cell(cfg: QBAConfig, out, slot, write, p_mask, v, ev):
+    """Scatter one packet into a sender row at ``slot`` where ``write``."""
+    o_vals, o_lens, o_count, o_p, o_v, o_sent = out
+    at = (jnp.arange(cfg.slots) == slot) & write
+    return (
+        jnp.where(at[:, None, None], ev.vals[None], o_vals),
+        jnp.where(at[:, None], ev.lens[None], o_lens),
+        jnp.where(at, ev.count, o_count),
+        jnp.where(at[:, None], p_mask[None], o_p),
+        jnp.where(at, v, o_v),
+        o_sent | at,
+    )
+
+
+def _step3a_one(cfg: QBAConfig, p_row, v, li):
+    """One lieutenant's step 3a (``tfg.py:185-196``): receive the
+    commander's packet, append own sub-list, accept + rebroadcast if
+    consistent."""
+    ev = append_own(empty_evidence(cfg.max_l, cfg.size_l), p_row, li)
+    ok = consistent(v, ev, cfg.w)
+    vi_row = (jnp.arange(cfg.w) == v) & ok
+    out = _empty_out_cells(cfg)
+    out = _write_cell(cfg, out, jnp.asarray(0), ok, p_row, v, ev)
+    return vi_row, out
+
+
+def _receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb, honest):
+    """One lieutenant's inbox drain for one voting round
+    (``tfg.py:337-348`` + ``lieu_receive``, ``tfg.py:289-300``)."""
+    n_s, slots = cfg.n_lieutenants, cfg.slots
+    n_pk = n_s * slots
+
+    def flat(x):
+        return x.reshape((n_pk,) + x.shape[2:])
+
+    vals_f, lens_f, count_f = flat(mb.vals), flat(mb.lens), flat(mb.count)
+    p_f, v_f, sent_f = flat(mb.p_mask), flat(mb.v), flat(mb.sent)
+
+    def body(carry, idx):
+        vi, counter, overflow, out = carry
+        pk = Packet(
+            p_mask=p_f[idx],
+            v=v_f[idx],
+            evidence=Evidence(vals=vals_f[idx], lens=lens_f[idx], count=count_f[idx]),
+        )
+        sender_idx = idx // slots
+        pk, delivered = corrupt_at_delivery(
+            cfg, jax.random.fold_in(key, idx), pk, honest[sender_idx + 2]
+        )
+        delivered &= sent_f[idx] & (sender_idx != receiver_idx)
+
+        # Step 3 b i-ii (tfg.py:291-299)
+        ev = append_own(pk.evidence, pk.p_mask, li)
+        acc = (
+            delivered
+            & consistent(pk.v, ev, cfg.w)
+            & ~vi[pk.v]
+            & (ev.count == round_idx + 1)
+        )
+        vi = vi.at[pk.v].set(vi[pk.v] | acc)
+        rebroadcast = acc & (round_idx <= cfg.n_dishonest)
+        can_write = counter < slots
+        out = _write_cell(
+            cfg, out, counter, rebroadcast & can_write, pk.p_mask, pk.v, ev
+        )
+        overflow |= rebroadcast & ~can_write
+        counter = counter + rebroadcast.astype(jnp.int32)
+        return (vi, counter, overflow, out), None
+
+    init = (
+        vi_row,
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((), bool),
+        _empty_out_cells(cfg),
+    )
+    (vi_row, _, overflow, out), _ = jax.lax.scan(body, init, jnp.arange(n_pk))
+    return vi_row, out, overflow
+
+
+def run_trial(cfg: QBAConfig, key: jax.Array) -> TrialResult:
+    """One full protocol execution — jit-compilable, vmap-batchable."""
+    k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
+
+    # Dishonesty assignment (tfg.py:101-125) and particle distribution
+    # (tfg.py:132-163): rank-indexed honesty mask + all parties' lists.
+    honest = assign_dishonest(cfg, k_dis)
+    gen = generate_lists if cfg.qsim_path == "factorized" else generate_lists_dense
+    lists, _qcorr = gen(cfg, k_lists)
+
+    # Step 1b (tfg.py:325-329): the commander recovers the Q-correlated
+    # positions from its two copies; step 2 (tfg.py:166-184): per-recipient
+    # orders and P sets.
+    is_qcorr = lists[0] != lists[1]
+    v_sent, v_comm = commander_orders(cfg, k_comm, honest[1])
+    p_rows = is_qcorr[None, :] & (lists[1][None, :] == v_sent[:, None])
+    lieu_lists = lists[2:]
+
+    # Step 3a (tfg.py:185-196), vmapped over lieutenants.
+    vi, out_cells = jax.vmap(lambda p, v, li: _step3a_one(cfg, p, v, li))(
+        p_rows, v_sent, lieu_lists
+    )
+    mb = Mailbox(*out_cells)
+
+    # Step 3b (tfg.py:337-348): synchronous rounds 1..n_dishonest+1.
+    receiver_ids = jnp.arange(cfg.n_lieutenants)
+
+    def round_body(carry, round_idx):
+        vi, mb = carry
+        k_round = jax.random.fold_in(k_rounds, round_idx)
+        keys = jax.vmap(lambda i: jax.random.fold_in(k_round, i))(receiver_ids)
+        vi, out_cells, ovf = jax.vmap(
+            lambda k, r, vrow, li: _receiver_round(cfg, round_idx, k, r, vrow, li, mb, honest)
+        )(keys, receiver_ids, vi, lieu_lists)
+        return (vi, Mailbox(*out_cells)), jnp.any(ovf)
+
+    (vi, _), overflows = jax.lax.scan(
+        round_body, (vi, mb), jnp.arange(1, cfg.n_rounds + 1)
+    )
+
+    # Decision + verdict (tfg.py:303-306,351-363).
+    lieu_decisions = jax.vmap(
+        lambda row: decide_order(row, v_comm, jnp.asarray(False), cfg.w)
+    )(vi)
+    decisions = jnp.concatenate([v_comm[None], lieu_decisions])
+    success = success_oracle(decisions, honest[1:])
+    return TrialResult(
+        success=success,
+        decisions=decisions,
+        honest=honest[1:],
+        v_comm=v_comm,
+        vi=vi,
+        overflow=jnp.any(overflows),
+    )

@@ -1,0 +1,47 @@
+"""The dense mailbox tensor.
+
+In the reference every accepted packet triggers ``nParties-2`` tagged
+point-to-point sends (``lieu_broadcast``, ``tfg.py:266-286``), and each
+lieutenant drains its MPI queue with ``Iprobe`` (``tfg.py:337-348``).
+Here a round's entire traffic is one fixed-shape pytree: per sending
+lieutenant, up to ``slots`` broadcast packets.  Delivery is a gather — every
+receiver reads every (sender, slot) cell; per-recipient corruption happens
+at read time with per-(sender, slot, receiver) keys, so the sender-side
+packet is stored once, not once per recipient.
+
+A cell is addressed ``[sender_lieu_idx, slot]`` where ``sender_lieu_idx =
+rank - 2``.  ``sent`` marks occupied cells.  ``slots = w`` is lossless
+(docs/DIVERGENCES.md D9); smaller configured bounds record overflow.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.core.types import SENTINEL
+
+
+@struct.dataclass
+class Mailbox:
+    """All packets broadcast by lieutenants in one round."""
+
+    vals: jnp.ndarray  # int32[senders, slots, max_l, size_l]
+    lens: jnp.ndarray  # int32[senders, slots, max_l]
+    count: jnp.ndarray  # int32[senders, slots]
+    p_mask: jnp.ndarray  # bool[senders, slots, size_l]
+    v: jnp.ndarray  # int32[senders, slots]
+    sent: jnp.ndarray  # bool[senders, slots]
+
+
+def empty_mailbox(cfg: QBAConfig) -> Mailbox:
+    n_s, slots, max_l, s = cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l
+    return Mailbox(
+        vals=jnp.full((n_s, slots, max_l, s), SENTINEL, dtype=jnp.int32),
+        lens=jnp.zeros((n_s, slots, max_l), dtype=jnp.int32),
+        count=jnp.zeros((n_s, slots), dtype=jnp.int32),
+        p_mask=jnp.zeros((n_s, slots, s), dtype=bool),
+        v=jnp.zeros((n_s, slots), dtype=jnp.int32),
+        sent=jnp.zeros((n_s, slots), dtype=bool),
+    )

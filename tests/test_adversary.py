@@ -1,0 +1,136 @@
+"""Adversary model unit tests (``tfg.py:101-125,169-181,271-284``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qba_tpu.adversary import assign_dishonest, commander_orders, corrupt_at_delivery
+from qba_tpu.config import QBAConfig
+from qba_tpu.core import append_own
+from qba_tpu.core.types import Packet, empty_evidence
+
+
+class TestAssignDishonest:
+    def test_counts_and_rank0_honest(self):
+        cfg = QBAConfig(n_parties=11, size_l=4, n_dishonest=5)
+        keys = jax.random.split(jax.random.key(0), 50)
+        masks = jax.vmap(lambda k: assign_dishonest(cfg, k))(keys)
+        assert masks.shape == (50, 12)
+        assert bool(jnp.all(masks[:, 0]))  # QSD never dishonest
+        np.testing.assert_array_equal(
+            np.asarray(jnp.sum(~masks, axis=1)), np.full(50, 5)
+        )
+
+    def test_commander_can_be_dishonest(self):
+        # tfg.py:105 draws from 1..nParties inclusive of the commander
+        cfg = QBAConfig(n_parties=3, size_l=4, n_dishonest=1)
+        keys = jax.random.split(jax.random.key(1), 200)
+        masks = jax.vmap(lambda k: assign_dishonest(cfg, k))(keys)
+        frac_comm_dishonest = float(jnp.mean(~masks[:, 1]))
+        assert 0.15 < frac_comm_dishonest < 0.55  # ~1/3
+
+    def test_zero_dishonest(self):
+        cfg = QBAConfig(n_parties=3, size_l=4, n_dishonest=0)
+        assert bool(jnp.all(assign_dishonest(cfg, jax.random.key(2))))
+
+
+class TestCommanderOrders:
+    def test_honest_sends_same_v(self):
+        cfg = QBAConfig(n_parties=11, size_l=4)
+        v_sent, v = commander_orders(cfg, jax.random.key(0), jnp.asarray(True))
+        assert bool(jnp.all(v_sent == v))
+        assert 0 <= int(v) < cfg.w
+
+    def test_dishonest_equivocates_at_split(self):
+        cfg = QBAConfig(n_parties=11, size_l=4)
+        found_split = False
+        for i in range(20):
+            v_sent, _ = commander_orders(
+                cfg, jax.random.key(i), jnp.asarray(False)
+            )
+            vs = np.asarray(v_sent)
+            # ranks 2..6 get v1, ranks 7..11 get v2, v1 != v2 (tfg.py:176-181)
+            assert len(set(vs[:5])) == 1 and len(set(vs[5:])) == 1
+            assert vs[0] != vs[5]
+            found_split = True
+        assert found_split
+
+    def test_v2_uniform_over_not_v1(self):
+        cfg = QBAConfig(n_parties=3, size_l=4)  # w = 4
+        vs = []
+        for i in range(600):
+            v_sent, _ = commander_orders(cfg, jax.random.key(i), jnp.asarray(False))
+            vs.append((int(v_sent[0]), int(v_sent[-1])))
+        v2_given_v1 = {}
+        for v1, v2 in vs:
+            assert v1 != v2
+            v2_given_v1.setdefault(v1, []).append(v2)
+        for v1, v2s in v2_given_v1.items():
+            counts = np.bincount(v2s, minlength=4)
+            assert counts[v1] == 0
+            assert (counts[[i for i in range(4) if i != v1]] > 10).all()
+
+
+class TestCorruptAtDelivery:
+    def _packet(self, cfg):
+        ev = append_own(
+            empty_evidence(cfg.max_l, cfg.size_l),
+            jnp.asarray([True, True, False, False]),
+            jnp.asarray([2, 3, 0, 0], dtype=jnp.int32),
+        )
+        return Packet(
+            p_mask=jnp.asarray([True, True, False, False]),
+            v=jnp.asarray(1, jnp.int32),
+            evidence=ev,
+        )
+
+    def test_honest_sender_untouched(self):
+        cfg = QBAConfig(n_parties=3, size_l=4, n_dishonest=1)
+        pk = self._packet(cfg)
+        for i in range(10):
+            out, delivered = corrupt_at_delivery(
+                cfg, jax.random.key(i), pk, jnp.asarray(True)
+            )
+            assert bool(delivered)
+            assert int(out.v) == 1
+            assert out.p_mask.tolist() == pk.p_mask.tolist()
+            assert int(out.evidence.count) == 1
+
+    def test_dishonest_actions_all_occur(self):
+        cfg = QBAConfig(n_parties=3, size_l=4, n_dishonest=1)
+        pk = self._packet(cfg)
+        seen = {"drop": 0, "v": 0, "p": 0, "l": 0, "clean": 0}
+        for i in range(400):
+            out, delivered = corrupt_at_delivery(
+                cfg, jax.random.key(i), pk, jnp.asarray(False)
+            )
+            if not bool(delivered):
+                seen["drop"] += 1
+            elif int(out.v) != 1:
+                seen["v"] += 1
+            elif not bool(jnp.any(out.p_mask)):
+                seen["p"] += 1
+            elif int(out.evidence.count) == 0:
+                seen["l"] += 1
+            else:
+                seen["clean"] += 1
+        # actions are ~25% each; drop additionally flips a fair coin
+        # (tfg.py:274), so ~12.5% of deliveries vanish; corrupt-v draws
+        # from [0, nParties+1) and can coincide with the original v
+        assert seen["drop"] > 25
+        assert seen["v"] > 60
+        assert seen["p"] > 60
+        assert seen["l"] > 60
+
+    def test_corrupt_v_range(self):
+        # tfg.py:277: random order from [0, nParties+1), NOT [0, w)
+        cfg = QBAConfig(n_parties=3, size_l=4, n_dishonest=1)
+        pk = self._packet(cfg)
+        vs = set()
+        for i in range(600):
+            out, delivered = corrupt_at_delivery(
+                cfg, jax.random.key(i), pk, jnp.asarray(False)
+            )
+            if bool(delivered):
+                vs.add(int(out.v))
+        assert vs <= set(range(cfg.n_parties + 1)) | {1}

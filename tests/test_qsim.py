@@ -1,0 +1,149 @@
+"""qsim engine tests: dense statevector correctness, closed-form properties
+of both generation paths (SURVEY §2.6), and dense-vs-factorized
+cross-validation.
+
+Properties are checked against what the captured reference logs verify
+(SURVEY §2.6): pairwise-distinct party values at Q-correlated positions
+(``log_11.txt:13-24``) and ``L1 == Lc`` at non-correlated positions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.qsim import generate_lists, generate_lists_dense
+from qba_tpu.qsim import statevector as sv
+from qba_tpu.qsim.circuit import Circuit, Gate
+
+
+class TestStatevector:
+    def test_x_flips(self):
+        state = sv.init_state(2)
+        state = sv.apply_1q(state, sv.X, 0)
+        bits = sv.measure_all(state, jax.random.key(0))
+        assert bits.tolist() == [1, 0]
+
+    def test_h_uniform(self):
+        state = sv.apply_1q(sv.init_state(1), sv.H, 0)
+        keys = jax.random.split(jax.random.key(1), 2000)
+        bits = jax.vmap(lambda k: sv.measure_all(state, k))(keys)
+        frac = float(jnp.mean(bits[:, 0]))
+        assert 0.45 < frac < 0.55
+
+    def test_cnot_copies(self):
+        # |+>|0> -> Bell pair: measurements always agree
+        state = sv.apply_1q(sv.init_state(2), sv.H, 0)
+        state = sv.apply_controlled_1q(state, sv.X, 1, (0,))
+        keys = jax.random.split(jax.random.key(2), 500)
+        bits = jax.vmap(lambda k: sv.measure_all(state, k))(keys)
+        assert bool(jnp.all(bits[:, 0] == bits[:, 1]))
+        assert 0.4 < float(jnp.mean(bits[:, 0])) < 0.6
+
+    def test_controlled_requires_all_controls(self):
+        # |10> with controls (1,): control qubit is 0 -> no flip of target 0
+        state = sv.apply_1q(sv.init_state(2), sv.X, 0)
+        state = sv.apply_controlled_1q(state, sv.X, 0, (1,))
+        assert sv.measure_all(state, jax.random.key(0)).tolist() == [1, 0]
+
+    def test_xpow(self):
+        state = sv.init_state(1)
+        s0 = sv.apply_1q(state, sv.xpow_matrix(jnp.asarray(0)), 0)
+        s1 = sv.apply_1q(state, sv.xpow_matrix(jnp.asarray(1)), 0)
+        assert sv.measure_all(s0, jax.random.key(0)).tolist() == [0]
+        assert sv.measure_all(s1, jax.random.key(0)).tolist() == [1]
+
+
+class TestCircuitBuilder:
+    def test_validation(self):
+        g = Gate(2)
+        for bad in (lambda: g.add_operation("Z", targets=0),
+                    lambda: g.add_operation("X", targets=5),
+                    lambda: g.add_operation("X", targets=0, controls=0),
+                    lambda: g.add_operation("XPOW", targets=0)):
+            try:
+                bad()
+                raise AssertionError("expected ValueError")
+            except ValueError:
+                pass
+        c = Circuit(3)
+        try:
+            c.add_operation(Gate(2))
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+
+    def test_compiled_circuit_is_vmappable(self):
+        g = Gate(2).add_operation("H", targets=0).add_operation(
+            "X", targets=1, controls=0
+        )
+        run = Circuit(2).add_operation(g).compile()
+        keys = jax.random.split(jax.random.key(3), 100)
+        bits = jax.jit(jax.vmap(run))(keys)
+        assert bool(jnp.all(bits[:, 0] == bits[:, 1]))
+
+
+def check_closed_form_properties(lists, qcorr, w):
+    """The §2.6 invariants every generation path must satisfy."""
+    lists, qcorr = np.asarray(lists), np.asarray(qcorr)
+    n_rows = lists.shape[0]
+    assert lists.min() >= 0 and lists.max() < w
+    # Non-correlated positions: QSD copy equals commander's list.
+    nq = ~qcorr
+    np.testing.assert_array_equal(lists[0, nq], lists[1, nq])
+    # Q-correlated positions: all rows pairwise distinct, and
+    # {row_i XOR row_0 : i >= 1} is exactly {1..nParties}.
+    q = qcorr
+    xors = lists[1:, q] ^ lists[0:1, q]
+    for k in range(q.sum()):
+        got = sorted(xors[:, k].tolist())
+        assert got == list(range(1, n_rows)), got
+
+
+class TestFactorizedSampler:
+    def test_closed_form_properties(self):
+        cfg = QBAConfig(n_parties=11, size_l=256)
+        lists, qcorr = generate_lists(cfg, jax.random.key(0))
+        assert lists.shape == (12, 256)
+        check_closed_form_properties(lists, qcorr, cfg.w)
+
+    def test_commander_recovers_qcorr_exactly(self):
+        # isQCorr = {k : L1[k] != Lc[k]} (tfg.py:327) must equal the mask
+        cfg = QBAConfig(n_parties=5, size_l=512)
+        lists, qcorr = generate_lists(cfg, jax.random.key(1))
+        np.testing.assert_array_equal(np.asarray(lists[0] != lists[1]),
+                                      np.asarray(qcorr))
+
+    def test_r_uniformity(self):
+        cfg = QBAConfig(n_parties=3, size_l=4096)
+        lists, qcorr = generate_lists(cfg, jax.random.key(2))
+        r = np.asarray(lists[0])[np.asarray(qcorr)]
+        counts = np.bincount(r, minlength=cfg.w)
+        expected = len(r) / cfg.w
+        chi2 = ((counts - expected) ** 2 / expected).sum()
+        assert chi2 < 30, chi2  # 3 dof; extremely loose to avoid flakes
+
+
+class TestDensePath:
+    def test_closed_form_properties(self):
+        cfg = QBAConfig(n_parties=3, size_l=64, qsim_path="dense")
+        lists, qcorr = generate_lists_dense(cfg, jax.random.key(3))
+        assert lists.shape == (4, 64)
+        check_closed_form_properties(lists, qcorr, cfg.w)
+
+    def test_cross_validates_factorized(self):
+        # Same marginal stats from both engines at nParties=3.
+        cfg = QBAConfig(n_parties=3, size_l=1024)
+        ld, qd = generate_lists_dense(cfg, jax.random.key(4))
+        lf, qf = generate_lists(cfg, jax.random.key(5))
+        for lists, qcorr in ((ld, qd), (lf, qf)):
+            check_closed_form_properties(lists, qcorr, cfg.w)
+        # qcorr rate ~ 1/2 on both paths
+        assert abs(float(jnp.mean(qd)) - 0.5) < 0.06
+        assert abs(float(jnp.mean(qf)) - 0.5) < 0.06
+        # commander-value distribution uniform on both paths (chi2, 3 dof)
+        for lists in (ld, lf):
+            counts = np.bincount(np.asarray(lists[1]), minlength=cfg.w)
+            expected = cfg.size_l / cfg.w
+            chi2 = ((counts - expected) ** 2 / expected).sum()
+            assert chi2 < 30, chi2
