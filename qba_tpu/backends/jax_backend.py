@@ -42,12 +42,17 @@ def _batched(cfg: QBAConfig, keys: jax.Array) -> TrialResult:
     return jax.vmap(lambda k: run_trial(cfg, k))(keys)
 
 
-def run_trials(cfg: QBAConfig, keys: jax.Array | None = None) -> MonteCarloResult:
-    """Run ``cfg.trials`` independent protocol executions, batched."""
-    if keys is None:
-        keys = trial_keys(cfg)
-    trials = _batched(cfg, keys)
+def aggregate(trials: TrialResult) -> MonteCarloResult:
+    """Fold a trial batch into the Monte-Carlo summary (shared by every
+    runner: local vmap, dp/sp-sharded, party-sharded spmd)."""
     return MonteCarloResult(
         trials=trials,
         success_rate=jnp.mean(trials.success.astype(jnp.float32)),
     )
+
+
+def run_trials(cfg: QBAConfig, keys: jax.Array | None = None) -> MonteCarloResult:
+    """Run ``cfg.trials`` independent protocol executions, batched."""
+    if keys is None:
+        keys = trial_keys(cfg)
+    return aggregate(_batched(cfg, keys))

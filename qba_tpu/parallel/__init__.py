@@ -1,0 +1,18 @@
+"""Device-mesh parallelism (SURVEY §7.5).
+
+Replaces the reference's mpiexec process-parallelism (``tfg.py:310-314``)
+with a named `jax.sharding.Mesh`: trials over ``dp``, lieutenants over
+``tp`` (mailbox exchange = ``all_gather`` riding ICI), list positions
+over ``sp``.
+"""
+
+from qba_tpu.parallel.mesh import default_mesh_shape, make_mesh
+from qba_tpu.parallel.montecarlo import run_trials_sharded
+from qba_tpu.parallel.spmd import run_trials_spmd
+
+__all__ = [
+    "default_mesh_shape",
+    "make_mesh",
+    "run_trials_sharded",
+    "run_trials_spmd",
+]

@@ -1,0 +1,79 @@
+"""Device-mesh construction for QBA Monte-Carlo sweeps.
+
+The reference's only parallelism is one MPI process per protocol party
+(``tfg.py:310-314``; launch line ``README.md:4``).  On TPU the axes invert
+into a `jax.sharding.Mesh` whose names map protocol dimensions onto
+hardware:
+
+* ``dp`` — Monte-Carlo trials (the axis that replaces ``mpiexec`` ranks);
+  embarrassingly parallel, no collectives beyond the final statistics
+  reduction.
+* ``tp`` — protocol parties (lieutenants): the round-engine analog of
+  tensor parallelism; each device owns a contiguous block of lieutenants
+  and the per-round mailbox exchange is an ``all_gather`` over this axis
+  (see :mod:`qba_tpu.parallel.spmd`) — the collective that replaces the
+  reference's point-to-point ``Isend``/``Irecv`` traffic
+  (``tfg.py:199-263``).
+* ``sp`` — list positions (``sizeL``, the protocol's sequence axis,
+  SURVEY §5 "Long-context"): i.i.d. positions shard cleanly; XLA inserts
+  the reductions the consistency predicate needs.
+
+Pipeline/expert parallelism have no analog here (no layer or expert
+structure exists in the protocol); their absence is deliberate
+(SURVEY §2 "Parallelism strategies").
+"""
+
+from __future__ import annotations
+
+import math
+from collections.abc import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named device mesh.
+
+    Args:
+      axes: ordered ``{axis_name: size}``.  Sizes must multiply to the
+        device count used.  ``None`` means a 1-D ``{"dp": n_devices}``
+        mesh.
+      devices: devices to lay out (default: all of ``jax.devices()``).
+
+    The axis order is ICI-friendly by convention: put the
+    highest-traffic axis (``tp``) last so it maps to the
+    fastest-varying / nearest-neighbor device dimension.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"dp": len(devices)}
+    sizes = list(axes.values())
+    total = math.prod(sizes)
+    if total != len(devices):
+        raise ValueError(
+            f"mesh axes {dict(axes)} need {total} devices; got {len(devices)}"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def default_mesh_shape(n_devices: int, *, want_tp: bool = False) -> dict[str, int]:
+    """A reasonable 2-D factorization of ``n_devices``.
+
+    ``want_tp=False`` → ``{"dp": d, "sp": s}`` (Monte-Carlo + position
+    sharding); ``want_tp=True`` → ``{"dp": d, "tp": s}`` (party-sharded
+    round engine).  The second axis gets the largest power-of-two factor
+    ≤ ``sqrt(n_devices)`` so both axes stay useful.
+    """
+    second = 1
+    while second * 2 <= math.isqrt(n_devices) and n_devices % (second * 2) == 0:
+        second *= 2
+    name = "tp" if want_tp else "sp"
+    return {"dp": n_devices // second, name: second}

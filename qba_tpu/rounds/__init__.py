@@ -7,6 +7,6 @@ deterministically once per round under ``lax.scan``.
 """
 
 from qba_tpu.rounds.mailbox import Mailbox, empty_mailbox
-from qba_tpu.rounds.engine import run_trial, TrialResult
+from qba_tpu.rounds.engine import PartitionHints, run_trial, TrialResult
 
-__all__ = ["Mailbox", "empty_mailbox", "run_trial", "TrialResult"]
+__all__ = ["Mailbox", "empty_mailbox", "PartitionHints", "run_trial", "TrialResult"]

@@ -18,6 +18,8 @@ processing order within a round is (sender, slot) lexicographic (D5).
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -28,6 +30,20 @@ from qba_tpu.core import append_own, consistent, decide_order, success_oracle
 from qba_tpu.core.types import SENTINEL, Evidence, Packet, empty_evidence
 from qba_tpu.qsim import generate_lists, generate_lists_dense
 from qba_tpu.rounds.mailbox import Mailbox, empty_mailbox
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionHints:
+    """Optional internal sharding constraints for :func:`run_trial`.
+
+    Hashable (usable as a jit static argument).  ``lists`` is applied to
+    the generated party-lists tensor ``[n_parties+1, size_l]`` — e.g.
+    ``NamedSharding(mesh, P(None, "sp"))`` shards the position axis (the
+    protocol's sequence axis, SURVEY §5) and lets XLA partition every
+    positionwise op and insert the reductions ``consistent`` needs.
+    """
+
+    lists: jax.sharding.NamedSharding | None = None
 
 
 @struct.dataclass
@@ -135,23 +151,53 @@ def _receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb
     return vi_row, out, overflow
 
 
-def run_trial(cfg: QBAConfig, key: jax.Array) -> TrialResult:
-    """One full protocol execution — jit-compilable, vmap-batchable."""
-    k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
+def setup_trial(cfg: QBAConfig, key: jax.Array, hints: PartitionHints | None = None):
+    """Protocol phases before the round loop, shared by every engine.
 
-    # Dishonesty assignment (tfg.py:101-125) and particle distribution
-    # (tfg.py:132-163): rank-indexed honesty mask + all parties' lists.
+    Dishonesty assignment (``tfg.py:101-125``), particle distribution
+    (``tfg.py:132-163``), step 1b Q-correlated recovery + order choice
+    (``tfg.py:325-329``), step 2 per-recipient packets (``tfg.py:166-184``).
+
+    Returns ``(honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds)``.
+    """
+    k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
     honest = assign_dishonest(cfg, k_dis)
     gen = generate_lists if cfg.qsim_path == "factorized" else generate_lists_dense
     lists, _qcorr = gen(cfg, k_lists)
+    if hints is not None and hints.lists is not None:
+        lists = jax.lax.with_sharding_constraint(lists, hints.lists)
 
-    # Step 1b (tfg.py:325-329): the commander recovers the Q-correlated
-    # positions from its two copies; step 2 (tfg.py:166-184): per-recipient
-    # orders and P sets.
     is_qcorr = lists[0] != lists[1]
     v_sent, v_comm = commander_orders(cfg, k_comm, honest[1])
     p_rows = is_qcorr[None, :] & (lists[1][None, :] == v_sent[:, None])
-    lieu_lists = lists[2:]
+    return honest, lists[2:], p_rows, v_sent, v_comm, k_rounds
+
+
+def finish_trial(cfg: QBAConfig, vi, v_comm, honest, overflow) -> TrialResult:
+    """Decision + verdict (``tfg.py:303-306,351-363``), shared by every
+    engine: masked-min decisions, success oracle, result assembly."""
+    lieu_decisions = jax.vmap(
+        lambda row: decide_order(row, v_comm, jnp.asarray(False), cfg.w)
+    )(vi)
+    decisions = jnp.concatenate([v_comm[None], lieu_decisions])
+    success = success_oracle(decisions, honest[1:])
+    return TrialResult(
+        success=success,
+        decisions=decisions,
+        honest=honest[1:],
+        v_comm=v_comm,
+        vi=vi,
+        overflow=overflow,
+    )
+
+
+def run_trial(
+    cfg: QBAConfig, key: jax.Array, hints: PartitionHints | None = None
+) -> TrialResult:
+    """One full protocol execution — jit-compilable, vmap-batchable."""
+    honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = setup_trial(
+        cfg, key, hints
+    )
 
     # Step 3a (tfg.py:185-196), vmapped over lieutenants.
     vi, out_cells = jax.vmap(lambda p, v, li: _step3a_one(cfg, p, v, li))(
@@ -174,18 +220,4 @@ def run_trial(cfg: QBAConfig, key: jax.Array) -> TrialResult:
     (vi, _), overflows = jax.lax.scan(
         round_body, (vi, mb), jnp.arange(1, cfg.n_rounds + 1)
     )
-
-    # Decision + verdict (tfg.py:303-306,351-363).
-    lieu_decisions = jax.vmap(
-        lambda row: decide_order(row, v_comm, jnp.asarray(False), cfg.w)
-    )(vi)
-    decisions = jnp.concatenate([v_comm[None], lieu_decisions])
-    success = success_oracle(decisions, honest[1:])
-    return TrialResult(
-        success=success,
-        decisions=decisions,
-        honest=honest[1:],
-        v_comm=v_comm,
-        vi=vi,
-        overflow=jnp.any(overflows),
-    )
+    return finish_trial(cfg, vi, v_comm, honest, jnp.any(overflows))
