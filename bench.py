@@ -1,0 +1,120 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Headline (from BASELINE.json): protocol rounds/sec at nParties=11,
+sizeL=64, 1000 trials (nDishonest=3 → 4 voting rounds/trial) on the jax
+backend.  ``vs_baseline`` is the speedup over the message-level
+pure-Python reference backend (:mod:`qba_tpu.backends.local_backend`) run
+on host CPU — the in-repo stand-in for the reference's ``mpiexec`` run
+(the reference itself publishes no numbers and needs MPI + qsimov,
+neither available here; BASELINE.md).
+
+Usage: ``python bench.py`` (env ``QBA_BENCH_QUICK=1`` for a small dev run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _measure_jax(cfg, reps: int = 3) -> float:
+    """Best wall-clock seconds for one full Monte-Carlo batch.
+
+    Each rep uses fresh trial keys so a result-caching backend (the axon
+    tunnel dedupes identical computations) cannot fake a 0-second run.
+    """
+    import jax
+
+    from qba_tpu.backends.jax_backend import run_trials, trial_keys
+
+    jax.block_until_ready(run_trials(cfg, trial_keys(cfg)).trials)  # compile
+    best = float("inf")
+    for r in range(reps):
+        keys = jax.random.split(jax.random.key(cfg.seed + 1 + r), cfg.trials)
+        keys.block_until_ready()
+        t0 = time.perf_counter()
+        res = run_trials(cfg, keys)
+        jax.block_until_ready(res.trials)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _measure_local(cfg, n_trials: int) -> float:
+    """Per-trial seconds for the pure-Python reference backend.
+
+    Runs in a CPU-platform subprocess: the backend issues thousands of
+    tiny per-packet jax dispatches, which must not ride the TPU tunnel
+    (and mirrors the reference's host-CPU execution, BASELINE.md).
+    """
+    import subprocess
+
+    code = f"""
+import time, jax
+jax.config.update("jax_platforms", "cpu")
+from qba_tpu.backends.jax_backend import trial_keys
+from qba_tpu.backends.local_backend import run_trial_local
+from qba_tpu.config import QBAConfig
+cfg = QBAConfig(n_parties={cfg.n_parties}, size_l={cfg.size_l},
+                n_dishonest={cfg.n_dishonest}, trials={cfg.trials},
+                seed={cfg.seed})
+keys = trial_keys(cfg)
+run_trial_local(cfg, keys[0])
+t0 = time.perf_counter()
+for i in range({n_trials}):
+    run_trial_local(cfg, keys[i % cfg.trials])
+print((time.perf_counter() - t0) / {n_trials})
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"baseline subprocess failed: {proc.stderr[-500:]}")
+    return float(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> None:
+    from qba_tpu.config import QBAConfig
+
+    quick = os.environ.get("QBA_BENCH_QUICK") == "1"
+    cfg = QBAConfig(
+        n_parties=11,
+        size_l=64,
+        n_dishonest=3,
+        trials=64 if quick else 1000,
+        seed=0,
+    )
+    rounds_per_trial = cfg.n_rounds
+
+    dt = _measure_jax(cfg, reps=2 if quick else 3)
+    rps = cfg.trials * rounds_per_trial / dt
+    print(f"jax: {cfg.trials} trials in {dt:.3f}s -> {rps:.1f} rounds/s", file=sys.stderr)
+
+    baseline_trials = 2 if quick else 4
+    try:
+        per_trial = _measure_local(cfg, baseline_trials)
+        baseline_rps = rounds_per_trial / per_trial
+        print(
+            f"local baseline: {per_trial:.3f}s/trial -> {baseline_rps:.2f} rounds/s",
+            file=sys.stderr,
+        )
+    except Exception as e:  # keep the JSON line flowing even if baseline dies
+        print(f"baseline measurement failed: {e!r}", file=sys.stderr)
+        baseline_rps = None
+
+    out = {
+        "metric": "protocol_rounds_per_sec_n11_l64_t1000",
+        "value": round(rps, 2),
+        "unit": "rounds/s",
+        "vs_baseline": round(rps / baseline_rps, 2) if baseline_rps else None,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

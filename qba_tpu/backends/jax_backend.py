@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from qba_tpu.config import QBAConfig
-from qba_tpu.rounds import TrialResult, run_trial
+from qba_tpu.rounds import PartitionHints, TrialResult, run_trial
 
 
 @struct.dataclass
@@ -35,11 +35,15 @@ def trial_keys(cfg: QBAConfig) -> jax.Array:
     return jax.random.split(jax.random.key(cfg.seed), cfg.trials)
 
 
-# QBAConfig is frozen/hashable, so it can be a jit static argument — the
-# compiled batch program is cached across run_trials calls per config.
-@functools.partial(jax.jit, static_argnums=0)
-def _batched(cfg: QBAConfig, keys: jax.Array) -> TrialResult:
-    return jax.vmap(lambda k: run_trial(cfg, k))(keys)
+# QBAConfig and PartitionHints are frozen/hashable, so they can be jit
+# static arguments — the compiled batch program is cached per (config,
+# hints).  This is the single jit entry point for both the local and the
+# mesh-sharded (dp/sp) Monte-Carlo runners.
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def batched_trials(
+    cfg: QBAConfig, keys: jax.Array, hints: PartitionHints | None = None
+) -> TrialResult:
+    return jax.vmap(lambda k: run_trial(cfg, k, hints))(keys)
 
 
 def aggregate(trials: TrialResult) -> MonteCarloResult:
@@ -55,4 +59,4 @@ def run_trials(cfg: QBAConfig, keys: jax.Array | None = None) -> MonteCarloResul
     """Run ``cfg.trials`` independent protocol executions, batched."""
     if keys is None:
         keys = trial_keys(cfg)
-    return aggregate(_batched(cfg, keys))
+    return aggregate(batched_trials(cfg, keys))

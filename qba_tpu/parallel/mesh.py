@@ -64,6 +64,17 @@ def make_mesh(
     return Mesh(dev_array, tuple(axes.keys()))
 
 
+def axis_sizes(mesh: Mesh) -> dict[str, int]:
+    """``{axis_name: size}`` for a mesh (shared by every runner)."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def require_divisible(total: int, divisor: int, what: str, axis: str) -> None:
+    """Raise the runners' standard sharding-divisibility error."""
+    if total % divisor != 0:
+        raise ValueError(f"{what}={total} not divisible by {axis}={divisor}")
+
+
 def default_mesh_shape(n_devices: int, *, want_tp: bool = False) -> dict[str, int]:
     """A reasonable 2-D factorization of ``n_devices``.
 

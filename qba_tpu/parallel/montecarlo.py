@@ -12,21 +12,18 @@ collectives.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from qba_tpu.backends.jax_backend import MonteCarloResult, aggregate, trial_keys
+from qba_tpu.backends.jax_backend import (
+    MonteCarloResult,
+    aggregate,
+    batched_trials,
+    trial_keys,
+)
 from qba_tpu.config import QBAConfig
-from qba_tpu.rounds import PartitionHints, TrialResult, run_trial
-
-
-@functools.partial(jax.jit, static_argnums=(0, 2))
-def _batched_hinted(
-    cfg: QBAConfig, keys: jax.Array, hints: PartitionHints | None
-) -> TrialResult:
-    return jax.vmap(lambda k: run_trial(cfg, k, hints))(keys)
+from qba_tpu.parallel.mesh import axis_sizes, require_divisible
+from qba_tpu.rounds import PartitionHints
 
 
 def run_trials_sharded(
@@ -47,17 +44,15 @@ def run_trials_sharded(
     """
     if keys is None:
         keys = trial_keys(cfg)
-    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axes = axis_sizes(mesh)
     dp = axes.get("dp", 1)
     sp = axes.get("sp", 1)
-    if keys.shape[0] % dp != 0:
-        raise ValueError(f"trials={keys.shape[0]} not divisible by dp={dp}")
-    if cfg.size_l % sp != 0:
-        raise ValueError(f"size_l={cfg.size_l} not divisible by sp={sp}")
+    require_divisible(keys.shape[0], dp, "trials", "dp")
+    require_divisible(cfg.size_l, sp, "size_l", "sp")
 
     key_spec = P("dp") if "dp" in axes else P()
     keys = jax.device_put(keys, NamedSharding(mesh, key_spec))
     hints = (
         PartitionHints(lists=NamedSharding(mesh, P(None, "sp"))) if sp > 1 else None
     )
-    return aggregate(_batched_hinted(cfg, keys, hints))
+    return aggregate(batched_trials(cfg, keys, hints))

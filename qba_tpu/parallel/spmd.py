@@ -27,12 +27,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from qba_tpu.backends.jax_backend import MonteCarloResult, aggregate, trial_keys
 from qba_tpu.config import QBAConfig
+from qba_tpu.parallel.mesh import axis_sizes, require_divisible
 from qba_tpu.rounds import Mailbox, TrialResult
 from qba_tpu.rounds.engine import (
-    _receiver_round,
-    _step3a_one,
     finish_trial,
+    receiver_round,
     setup_trial,
+    step3a_one,
 )
 
 
@@ -42,7 +43,11 @@ def _trial_party_sharded(cfg: QBAConfig, n_tp: int, key: jax.Array) -> TrialResu
     Runs inside ``shard_map`` (and under ``vmap`` over local trials).
     Phase structure mirrors :func:`qba_tpu.rounds.engine.run_trial`; the
     setup phases are replicated per device (same key → same values), the
-    round loop is genuinely distributed.
+    round loop is genuinely distributed.  Replicating setup is deliberate:
+    the factorized sampler is O(n_parties * size_l) integer work —
+    negligible next to the round loop — and identical keys keep the spmd
+    path bit-identical to the single-device engine (the property
+    tests/test_parallel.py pins).
     """
     n_local = cfg.n_lieutenants // n_tp
     honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = setup_trial(cfg, key)
@@ -55,7 +60,7 @@ def _trial_party_sharded(cfg: QBAConfig, n_tp: int, key: jax.Array) -> TrialResu
     my_li = jax.lax.dynamic_slice_in_dim(lieu_lists, start, n_local, 0)
 
     # Step 3a (tfg.py:185-196) for the local block.
-    vi_l, out_cells = jax.vmap(lambda p, v, li: _step3a_one(cfg, p, v, li))(
+    vi_l, out_cells = jax.vmap(lambda p, v, li: step3a_one(cfg, p, v, li))(
         my_p, my_v, my_li
     )
     mb_local = Mailbox(*out_cells)
@@ -72,7 +77,7 @@ def _trial_party_sharded(cfg: QBAConfig, n_tp: int, key: jax.Array) -> TrialResu
         k_round = jax.random.fold_in(k_rounds, round_idx)
         keys = jax.vmap(lambda i: jax.random.fold_in(k_round, i))(my_ids)
         vi_l, out_cells, ovf = jax.vmap(
-            lambda k, r, vrow, li: _receiver_round(
+            lambda k, r, vrow, li: receiver_round(
                 cfg, round_idx, k, r, vrow, li, mb_full, honest
             )
         )(keys, my_ids, vi_l, my_li)
@@ -117,17 +122,13 @@ def run_trials_spmd(
     """
     if keys is None:
         keys = trial_keys(cfg)
-    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axes = axis_sizes(mesh)
     if "tp" not in axes:
         raise ValueError(
             f"run_trials_spmd needs a 'tp' mesh axis; got axes {tuple(axes)}. "
             "For trial-only sharding use run_trials_sharded."
         )
     dp, tp = axes.get("dp", 1), axes["tp"]
-    if keys.shape[0] % dp != 0:
-        raise ValueError(f"trials={keys.shape[0]} not divisible by dp={dp}")
-    if cfg.n_lieutenants % tp != 0:
-        raise ValueError(
-            f"n_lieutenants={cfg.n_lieutenants} not divisible by tp={tp}"
-        )
+    require_divisible(keys.shape[0], dp, "trials", "dp")
+    require_divisible(cfg.n_lieutenants, tp, "n_lieutenants", "tp")
     return aggregate(_spmd_batch(cfg, mesh, keys))

@@ -15,11 +15,19 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-# Single-qubit gate matrices.
-H = jnp.asarray([[1.0, 1.0], [1.0, -1.0]], dtype=jnp.complex64) / jnp.sqrt(2.0)
-X = jnp.asarray([[0.0, 1.0], [1.0, 0.0]], dtype=jnp.complex64)
-I2 = jnp.eye(2, dtype=jnp.complex64)
+# Single-qubit gate matrices — host (numpy) constants on purpose: a
+# module-level ``jnp`` constant would be materialized on the default
+# device at import time, and complex64 eager ops are unimplemented on
+# some TPU runtimes (the axon tunnel), poisoning the async queue for the
+# whole process.  As numpy values they are baked into jitted programs as
+# literals and only touch the device inside compiled (validation-path)
+# code.
+_SQRT2 = np.sqrt(2.0).astype(np.float32)
+H = np.asarray([[1.0, 1.0], [1.0, -1.0]], dtype=np.complex64) / _SQRT2
+X = np.asarray([[0.0, 1.0], [1.0, 0.0]], dtype=np.complex64)
+I2 = np.eye(2, dtype=np.complex64)
 
 GATES = {"H": H, "X": X, "I": I2}
 

@@ -86,7 +86,7 @@ def _write_cell(cfg: QBAConfig, out, slot, write, p_mask, v, ev):
     )
 
 
-def _step3a_one(cfg: QBAConfig, p_row, v, li):
+def step3a_one(cfg: QBAConfig, p_row, v, li):
     """One lieutenant's step 3a (``tfg.py:185-196``): receive the
     commander's packet, append own sub-list, accept + rebroadcast if
     consistent."""
@@ -98,7 +98,7 @@ def _step3a_one(cfg: QBAConfig, p_row, v, li):
     return vi_row, out
 
 
-def _receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb, honest):
+def receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb, honest):
     """One lieutenant's inbox drain for one voting round
     (``tfg.py:337-348`` + ``lieu_receive``, ``tfg.py:289-300``)."""
     n_s, slots = cfg.n_lieutenants, cfg.slots
@@ -200,7 +200,7 @@ def run_trial(
     )
 
     # Step 3a (tfg.py:185-196), vmapped over lieutenants.
-    vi, out_cells = jax.vmap(lambda p, v, li: _step3a_one(cfg, p, v, li))(
+    vi, out_cells = jax.vmap(lambda p, v, li: step3a_one(cfg, p, v, li))(
         p_rows, v_sent, lieu_lists
     )
     mb = Mailbox(*out_cells)
@@ -213,7 +213,7 @@ def run_trial(
         k_round = jax.random.fold_in(k_rounds, round_idx)
         keys = jax.vmap(lambda i: jax.random.fold_in(k_round, i))(receiver_ids)
         vi, out_cells, ovf = jax.vmap(
-            lambda k, r, vrow, li: _receiver_round(cfg, round_idx, k, r, vrow, li, mb, honest)
+            lambda k, r, vrow, li: receiver_round(cfg, round_idx, k, r, vrow, li, mb, honest)
         )(keys, receiver_ids, vi, lieu_lists)
         return (vi, Mailbox(*out_cells)), jnp.any(ovf)
 
