@@ -108,7 +108,7 @@ def main() -> None:
         baseline_rps = None
 
     out = {
-        "metric": "protocol_rounds_per_sec_n11_l64_t1000",
+        "metric": f"protocol_rounds_per_sec_n11_l64_t{cfg.trials}",
         "value": round(rps, 2),
         "unit": "rounds/s",
         "vs_baseline": round(rps / baseline_rps, 2) if baseline_rps else None,

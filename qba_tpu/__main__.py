@@ -1,0 +1,8 @@
+"""``python -m qba_tpu`` — see :mod:`qba_tpu.cli`."""
+
+import sys
+
+from qba_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+"""Command-line interface: ``python -m qba_tpu {run,bench,sweep}``.
+
+The reference's CLI is ``mpiexec -n <nParties+1> python tfg.py <sizeL>
+<nDishonest>`` (``README.md:3-4``, ``tfg.py:366-367``) — the party count
+is implied by the MPI world size and there is no validation.  Here the
+config is explicit and validated (:class:`qba_tpu.config.QBAConfig`):
+
+* ``run``   — execute trials and print per-trial verdicts in the
+  reference's ``Decisions / Dishonests / Success`` format
+  (``tfg.py:360-363``) plus the Monte-Carlo aggregate.
+* ``bench`` — time the jitted batch and print the throughput line.
+* ``sweep`` — chunked, checkpoint-resumable Monte-Carlo sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Sequence
+
+from qba_tpu.config import QBAConfig
+
+
+def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
+    p.add_argument(
+        "--n-parties", type=int, required=True,
+        help="number of generals incl. the commander (reference: mpiexec -n = n_parties+1)",
+    )
+    p.add_argument(
+        "--size-l", type=int, required=True,
+        help="security parameter: particle-list length (reference argv[1])",
+    )
+    p.add_argument(
+        "--n-dishonest", type=int, default=0,
+        help="Byzantine party count (reference argv[2])",
+    )
+    p.add_argument("--trials", type=int, default=trials_default)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--qsim-path", choices=("factorized", "dense"), default="factorized",
+        help="quantum engine path (dense = joint statevector, validation only)",
+    )
+
+
+def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
+    return QBAConfig(
+        n_parties=args.n_parties,
+        size_l=args.size_l,
+        n_dishonest=args.n_dishonest,
+        trials=trials if trials is not None else args.trials,
+        seed=args.seed,
+        qsim_path=args.qsim_path,
+    )
+
+
+def _parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="qba_tpu",
+        description="TPU-native detectable Quantum Byzantine Agreement framework",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="run trials, print verdicts")
+    _add_config_args(run, trials_default=1)
+    run.add_argument(
+        "--backend", choices=("jax", "local"), default="jax",
+        help="jax = vectorized TPU path; local = message-level differential path",
+    )
+    run.add_argument(
+        "-v", "--verbose", action="store_true", help="debug-level event log"
+    )
+    run.add_argument(
+        "--jsonl", metavar="PATH", default=None, help="write event log as JSONL"
+    )
+    run.add_argument(
+        "--profile-dir", default=None, help="write a JAX profiler trace"
+    )
+    run.add_argument(
+        "--max-verdicts", type=int, default=8,
+        help="print at most this many per-trial verdict blocks",
+    )
+
+    bench = sub.add_parser("bench", help="time the jitted Monte-Carlo batch")
+    _add_config_args(bench, trials_default=256)
+    bench.add_argument("--reps", type=int, default=3)
+    bench.add_argument("--profile-dir", default=None)
+
+    sweep = sub.add_parser("sweep", help="chunked checkpoint-resumable sweep")
+    _add_config_args(sweep, trials_default=256)
+    sweep.add_argument("--n-chunks", type=int, required=True)
+    sweep.add_argument(
+        "--checkpoint", metavar="PATH", default=None,
+        help="JSON checkpoint; completed chunks are skipped on re-run",
+    )
+    return parser
+
+
+def _cmd_run(args: argparse.Namespace, out) -> int:
+    import jax
+    import numpy as np
+
+    from qba_tpu.obs import EventLog, Level, PhaseTimers, profile_trace, render_sweep, render_verdict
+
+    cfg = _config(args)
+    log = EventLog(
+        min_level=Level.DEBUG if args.verbose else Level.INFO, stream=out
+    )
+    timers = PhaseTimers()
+    log.info("config", "experiment", n_parties=cfg.n_parties, size_l=cfg.size_l,
+             n_dishonest=cfg.n_dishonest, w=cfg.w, trials=cfg.trials,
+             backend=args.backend, qsim_path=cfg.qsim_path)
+
+    if args.backend == "local":
+        from qba_tpu.backends.jax_backend import trial_keys
+        from qba_tpu.backends.local_backend import run_trial_local
+
+        keys = trial_keys(cfg)
+        successes = 0
+        t0 = time.perf_counter()
+        with timers.time("trials"):
+            for i in range(cfg.trials):
+                r = run_trial_local(cfg, keys[i])
+                successes += int(r["success"])
+                if i < args.max_verdicts:
+                    decisions = [
+                        d if d != cfg.no_decision else None for d in r["decisions"]
+                    ]
+                    print(f"trial {i}:", file=out)
+                    print(f"Decisions:  {decisions}", file=out)
+                    dis = [j + 1 for j, h in enumerate(r["honest"]) if not h]
+                    print(f"Dishonests: {dis}", file=out)
+                    print(f"Success:    {r['success']}", file=out)
+        dt = time.perf_counter() - t0
+        print(render_sweep(cfg, successes / cfg.trials, cfg.trials, dt), file=out)
+        return 0
+
+    from qba_tpu.backends.jax_backend import run_trials, trial_keys
+
+    with profile_trace(args.profile_dir):
+        with timers.time("trials"):
+            t0 = time.perf_counter()
+            res = jax.block_until_ready(run_trials(cfg, trial_keys(cfg)))
+            dt = time.perf_counter() - t0
+    for i in range(min(cfg.trials, args.max_verdicts)):
+        one = jax.tree.map(lambda x: np.asarray(x)[i], res.trials)
+        print(render_verdict(cfg, one, index=i), file=out)
+    if bool(np.any(np.asarray(res.trials.overflow))):
+        log.warning("round", "mailbox slot overflow in some trials")
+    print(render_sweep(cfg, float(res.success_rate), cfg.trials, dt), file=out)
+    if args.jsonl:
+        log.write_jsonl(args.jsonl)
+    return 0
+
+
+def _cmd_bench(args: argparse.Namespace, out) -> int:
+    import json
+
+    import jax
+
+    from qba_tpu.backends.jax_backend import run_trials, trial_keys
+    from qba_tpu.obs import profile_trace, throughput
+
+    cfg = _config(args)
+    jax.block_until_ready(run_trials(cfg, trial_keys(cfg)).trials)  # compile
+    best = float("inf")
+    with profile_trace(args.profile_dir):
+        for rep in range(args.reps):
+            keys = jax.random.split(jax.random.key(cfg.seed + 1 + rep), cfg.trials)
+            keys.block_until_ready()
+            t0 = time.perf_counter()
+            jax.block_until_ready(run_trials(cfg, keys).trials)
+            best = min(best, time.perf_counter() - t0)
+    th = throughput(cfg, cfg.trials, best)
+    print(
+        json.dumps(
+            {
+                "metric": "protocol_rounds_per_sec",
+                "value": round(th["rounds_per_sec"], 2),
+                "unit": "rounds/s",
+                "trials_per_sec": round(th["trials_per_sec"], 2),
+                "best_s": round(best, 4),
+                "config": {
+                    "n_parties": cfg.n_parties,
+                    "size_l": cfg.size_l,
+                    "n_dishonest": cfg.n_dishonest,
+                    "trials": cfg.trials,
+                },
+            }
+        ),
+        file=out,
+    )
+    return 0
+
+
+def _cmd_sweep(args: argparse.Namespace, out) -> int:
+    from qba_tpu.obs import EventLog, PhaseTimers, render_sweep
+    from qba_tpu.sweep import run_sweep
+
+    cfg = _config(args)
+    log = EventLog(stream=out)
+    timers = PhaseTimers()
+    res = run_sweep(
+        cfg,
+        n_chunks=args.n_chunks,
+        chunk_trials=cfg.trials,
+        checkpoint=args.checkpoint,
+        log=log,
+        timers=timers,
+    )
+    seconds = timers.total("chunk") or None
+    print(render_sweep(cfg, res.success_rate, res.n_trials, seconds), file=out)
+    if res.any_overflow:
+        print("(mailbox slot overflow occurred in some chunks)", file=out)
+    return 0
+
+
+def main(argv: Sequence[str] | None = None, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    args = _parser().parse_args(argv)
+    try:
+        if args.command == "run":
+            return _cmd_run(args, out)
+        if args.command == "bench":
+            return _cmd_bench(args, out)
+        if args.command == "sweep":
+            return _cmd_sweep(args, out)
+    except ValueError as e:  # config validation errors -> clean CLI failure
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    raise AssertionError(f"unhandled command {args.command}")

@@ -1,0 +1,35 @@
+"""Observability: structured events, phase timers, reports, profiling.
+
+The reference's entire observability surface is ``mpi_print`` — an
+unconditional ``print`` + flush (``tfg.py:10-12``) called at every protocol
+event, with commented-out calls as the de-facto verbosity knob
+(``tfg.py:32,70,183,208-225,236-262,292,300``) and a final summary triple
+``Decisions / Dishonests / Success`` (``tfg.py:360-363``).  SURVEY §5 lists
+tracing/profiling as absent.
+
+Here observability is a first-class subsystem:
+
+* :mod:`qba_tpu.obs.events` — leveled, structured event log (JSONL-able)
+  replacing ``mpi_print``.
+* :mod:`qba_tpu.obs.timers` — per-phase wall-clock timers and throughput
+  metrics (the BASELINE.json "protocol rounds/sec" headline).
+* :mod:`qba_tpu.obs.report` — human-readable run reports, including the
+  reference's closing ``Decisions / Dishonests / Success`` triple.
+* :mod:`qba_tpu.obs.profiling` — optional JAX profiler trace hook.
+"""
+
+from qba_tpu.obs.events import Event, EventLog, Level
+from qba_tpu.obs.profiling import profile_trace
+from qba_tpu.obs.report import render_sweep, render_verdict
+from qba_tpu.obs.timers import PhaseTimers, throughput
+
+__all__ = [
+    "Event",
+    "EventLog",
+    "Level",
+    "PhaseTimers",
+    "profile_trace",
+    "render_sweep",
+    "render_verdict",
+    "throughput",
+]

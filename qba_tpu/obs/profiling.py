@@ -1,0 +1,22 @@
+"""Optional JAX profiler hook (SURVEY §5: absent in the reference).
+
+``profile_trace(dir)`` wraps a block in ``jax.profiler.trace`` when a
+directory is given, and is a no-op otherwise — so runners can thread a
+``--profile-dir`` flag through unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None) -> Iterator[None]:
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

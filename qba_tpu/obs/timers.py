@@ -1,0 +1,72 @@
+"""Per-phase wall-clock timers and throughput metrics.
+
+The reference contains no timers at all (SURVEY §5 "Tracing/profiling:
+Absent"); benchmarking it means re-measuring from scratch (SURVEY §6).
+Here every runner can time its phases and report the headline
+"protocol rounds/sec" throughput (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Callable, Iterator
+
+from qba_tpu.config import QBAConfig
+
+
+class PhaseTimers:
+    """Accumulating named wall-clock timers.
+
+    ``with timers.time("rounds"): ...`` accumulates into ``total("rounds")``;
+    a phase may be entered repeatedly (per chunk / per rep).
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter) -> None:
+        self._clock = clock
+        self._totals: defaultdict[str, float] = defaultdict(float)
+        self._counts: defaultdict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def time(self, phase: str) -> Iterator[None]:
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self._totals[phase] += self._clock() - t0
+            self._counts[phase] += 1
+
+    def total(self, phase: str) -> float:
+        return self._totals[phase]
+
+    def count(self, phase: str) -> int:
+        return self._counts[phase]
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {
+            phase: {"total_s": self._totals[phase], "count": self._counts[phase]}
+            for phase in self._totals
+        }
+
+    def render(self) -> str:
+        rows = [
+            f"  {phase:<16} {d['total_s']:.4f}s  (x{int(d['count'])})"
+            for phase, d in sorted(self.summary().items())
+        ]
+        return "phase timings:\n" + "\n".join(rows) if rows else "phase timings: none"
+
+
+def throughput(cfg: QBAConfig, n_trials: int, seconds: float) -> dict[str, float]:
+    """Throughput triple for a completed batch.
+
+    ``rounds_per_sec`` counts protocol voting rounds (``n_rounds`` per
+    trial, ``tfg.py:337``) — the BASELINE.json headline metric.
+    """
+    if seconds <= 0:
+        raise ValueError("seconds must be > 0")
+    return {
+        "trials_per_sec": n_trials / seconds,
+        "rounds_per_sec": n_trials * cfg.n_rounds / seconds,
+        "positions_per_sec": n_trials * cfg.size_l / seconds,
+    }

@@ -96,7 +96,7 @@ def _trial_party_sharded(cfg: QBAConfig, n_tp: int, key: jax.Array) -> TrialResu
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _spmd_batch(cfg: QBAConfig, mesh: Mesh, keys: jax.Array) -> TrialResult:
-    n_tp = dict(zip(mesh.axis_names, mesh.devices.shape))["tp"]
+    n_tp = axis_sizes(mesh)["tp"]
     key_spec = P("dp") if "dp" in mesh.axis_names else P()
 
     def body(local_keys):

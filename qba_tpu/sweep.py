@@ -1,0 +1,172 @@
+"""Chunked, checkpoint-resumable Monte-Carlo sweeps.
+
+SURVEY §5 (checkpoint/resume: absent in the reference — runs are one trial
+per ``mpiexec`` invocation, state in in-memory Python sets): the TPU
+framework's sweeps can run millions of trials, so progress is chunked and
+checkpointed — serialize the config fingerprint plus per-chunk aggregates;
+resume skips completed chunks and reproduces identical results because each
+chunk's key tree is a pure function of ``(seed, chunk_index)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Any
+
+import jax
+import numpy as np
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.obs.events import EventLog
+from qba_tpu.obs.timers import PhaseTimers
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkResult:
+    chunk: int
+    trials: int
+    successes: int
+    overflow: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepResult:
+    cfg: QBAConfig
+    chunks: tuple[ChunkResult, ...]
+    resumed_chunks: int  # how many chunks came from the checkpoint
+
+    @property
+    def n_trials(self) -> int:
+        return sum(c.trials for c in self.chunks)
+
+    @property
+    def successes(self) -> int:
+        return sum(c.successes for c in self.chunks)
+
+    @property
+    def success_rate(self) -> float:
+        return self.successes / self.n_trials if self.n_trials else float("nan")
+
+    @property
+    def any_overflow(self) -> bool:
+        return any(c.overflow for c in self.chunks)
+
+
+def chunk_keys(cfg: QBAConfig, chunk: int, chunk_trials: int) -> jax.Array:
+    """The chunk's trial keys — pure function of (seed, chunk), so a resumed
+    sweep consumes randomness identical to an uninterrupted one."""
+    root = jax.random.fold_in(jax.random.key(cfg.seed), chunk)
+    return jax.random.split(root, chunk_trials)
+
+
+def _config_fingerprint(cfg: QBAConfig) -> dict[str, Any]:
+    return dataclasses.asdict(cfg)
+
+
+def _atomic_write_json(path: str, payload: dict[str, Any]) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(path: str, cfg: QBAConfig, chunk_trials: int) -> list[ChunkResult]:
+    """Completed chunks from ``path``; [] if absent.  Raises on a config or
+    chunk-size mismatch (a checkpoint is only valid for the exact sweep)."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("config") != _config_fingerprint(cfg):
+        raise ValueError(
+            f"checkpoint {path} was written for a different config: "
+            f"{payload.get('config')} != {_config_fingerprint(cfg)}"
+        )
+    if payload.get("chunk_trials") != chunk_trials:
+        raise ValueError(
+            f"checkpoint {path} used chunk_trials={payload.get('chunk_trials')}, "
+            f"requested {chunk_trials}"
+        )
+    return [ChunkResult(**c) for c in payload["chunks"]]
+
+
+def save_checkpoint(
+    path: str, cfg: QBAConfig, chunk_trials: int, chunks: list[ChunkResult]
+) -> None:
+    _atomic_write_json(
+        path,
+        {
+            "config": _config_fingerprint(cfg),
+            "chunk_trials": chunk_trials,
+            "chunks": [dataclasses.asdict(c) for c in chunks],
+        },
+    )
+
+
+def run_sweep(
+    cfg: QBAConfig,
+    n_chunks: int,
+    chunk_trials: int | None = None,
+    checkpoint: str | None = None,
+    log: EventLog | None = None,
+    timers: PhaseTimers | None = None,
+    runner=None,
+) -> SweepResult:
+    """Run ``n_chunks`` batches of ``chunk_trials`` trials each.
+
+    ``runner(cfg, keys) -> TrialResult`` defaults to the jitted vmap batch
+    (:func:`qba_tpu.backends.jax_backend.batched_trials`); the mesh-sharded
+    runners in :mod:`qba_tpu.parallel` can be partial-applied in.  With
+    ``checkpoint``, completed chunks are persisted after each chunk and
+    skipped on re-run.
+    """
+    from qba_tpu.backends.jax_backend import batched_trials
+
+    if chunk_trials is None:
+        chunk_trials = cfg.trials
+    if runner is None:
+        runner = batched_trials
+
+    chunks = load_checkpoint(checkpoint, cfg, chunk_trials) if checkpoint else []
+    done = {c.chunk for c in chunks}
+    resumed = len(chunks)
+    if log and resumed:
+        log.info("sweep", "resumed from checkpoint", chunks=resumed, path=checkpoint)
+
+    timers = timers or PhaseTimers()
+    for chunk in range(n_chunks):
+        if chunk in done:
+            continue
+        keys = chunk_keys(cfg, chunk, chunk_trials)
+        with timers.time("chunk"):
+            res = runner(cfg, keys)
+            res = jax.block_until_ready(res)
+        cr = ChunkResult(
+            chunk=chunk,
+            trials=chunk_trials,
+            successes=int(np.sum(np.asarray(res.success))),
+            overflow=bool(np.any(np.asarray(res.overflow))),
+        )
+        chunks.append(cr)
+        if checkpoint:
+            save_checkpoint(checkpoint, cfg, chunk_trials, chunks)
+        if log:
+            log.info(
+                "sweep",
+                "chunk done",
+                chunk=chunk,
+                successes=cr.successes,
+                trials=cr.trials,
+            )
+
+    chunks.sort(key=lambda c: c.chunk)
+    return SweepResult(cfg=cfg, chunks=tuple(chunks), resumed_chunks=resumed)

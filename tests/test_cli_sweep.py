@@ -1,0 +1,106 @@
+"""CLI and checkpoint-resumable sweep tests."""
+
+import io
+import json
+
+import pytest
+
+from qba_tpu.cli import main
+from qba_tpu.config import QBAConfig
+from qba_tpu.sweep import chunk_keys, load_checkpoint, run_sweep
+
+
+class TestSweep:
+    def test_aggregates_honest(self):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=0, trials=4)
+        res = run_sweep(cfg, n_chunks=3, chunk_trials=4)
+        assert res.n_trials == 12
+        assert res.success_rate == 1.0
+        assert res.resumed_chunks == 0
+
+    def test_checkpoint_resume_identical(self, tmp_path):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=1, trials=4, seed=3)
+        ckpt = str(tmp_path / "sweep.json")
+
+        full = run_sweep(cfg, n_chunks=4, chunk_trials=4)
+
+        # Partial run writes the checkpoint...
+        part = run_sweep(cfg, n_chunks=2, chunk_trials=4, checkpoint=ckpt)
+        assert len(load_checkpoint(ckpt, cfg, 4)) == 2
+        # ...resume completes the remaining chunks only.
+        res = run_sweep(cfg, n_chunks=4, chunk_trials=4, checkpoint=ckpt)
+        assert res.resumed_chunks == 2
+        assert [c.successes for c in res.chunks] == [
+            c.successes for c in full.chunks
+        ]
+        assert part.chunks == full.chunks[:2]
+
+    def test_checkpoint_rejects_config_mismatch(self, tmp_path):
+        ckpt = str(tmp_path / "sweep.json")
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=0, trials=2)
+        run_sweep(cfg, n_chunks=1, chunk_trials=2, checkpoint=ckpt)
+        other = QBAConfig(n_parties=3, size_l=8, n_dishonest=1, trials=2)
+        with pytest.raises(ValueError, match="different config"):
+            load_checkpoint(ckpt, other, 2)
+        with pytest.raises(ValueError, match="chunk_trials"):
+            load_checkpoint(ckpt, cfg, 3)
+
+    def test_chunk_keys_deterministic(self):
+        cfg = QBAConfig(n_parties=3, size_l=4, seed=9)
+        a = chunk_keys(cfg, 5, 3)
+        b = chunk_keys(cfg, 5, 3)
+        assert (a == b).all()
+
+
+class TestCLI:
+    def test_run_honest_verdicts(self):
+        out = io.StringIO()
+        rc = main(
+            ["run", "--n-parties", "3", "--size-l", "8", "--trials", "2"],
+            out=out,
+        )
+        text = out.getvalue()
+        assert rc == 0
+        assert text.count("Success:    True") == 2
+        assert "success rate: 1.0000" in text
+
+    def test_run_local_backend(self):
+        out = io.StringIO()
+        rc = main(
+            ["run", "--n-parties", "3", "--size-l", "8", "--trials", "1",
+             "--backend", "local"],
+            out=out,
+        )
+        assert rc == 0
+        assert "Success:    True" in out.getvalue()
+
+    def test_bench_json(self):
+        out = io.StringIO()
+        rc = main(
+            ["bench", "--n-parties", "3", "--size-l", "4", "--trials", "8",
+             "--reps", "1"],
+            out=out,
+        )
+        assert rc == 0
+        rec = json.loads(out.getvalue())
+        assert rec["metric"] == "protocol_rounds_per_sec"
+        assert rec["value"] > 0
+
+    def test_sweep_with_checkpoint(self, tmp_path):
+        ckpt = str(tmp_path / "c.json")
+        args = ["sweep", "--n-parties", "3", "--size-l", "4", "--trials", "4",
+                "--n-chunks", "2", "--checkpoint", ckpt]
+        out = io.StringIO()
+        assert main(args, out=out) == 0
+        assert "trials: 8" in out.getvalue()
+        # second invocation resumes fully from the checkpoint
+        out2 = io.StringIO()
+        assert main(args, out=out2) == 0
+        assert "resumed from checkpoint" in out2.getvalue()
+
+    def test_invalid_config_clean_error(self):
+        rc = main(
+            ["run", "--n-parties", "3", "--size-l", "8", "--n-dishonest", "9"],
+            out=io.StringIO(),
+        )
+        assert rc == 2

@@ -1,0 +1,94 @@
+"""Observability subsystem tests: events, timers, reports."""
+
+import io
+import json
+
+import jax
+import numpy as np
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.obs import EventLog, Level, PhaseTimers, render_sweep, render_verdict, throughput
+from qba_tpu.rounds import run_trial
+
+
+class TestEventLog:
+    def test_levels_filter(self):
+        log = EventLog(min_level=Level.INFO)
+        log.debug("round", "dropped")
+        log.info("round", "kept", round=1)
+        assert len(log.events) == 1
+        assert log.events[0].fields == {"round": 1}
+
+    def test_stream_renders(self):
+        buf = io.StringIO()
+        log = EventLog(stream=buf)
+        log.info("particles", "distributed", n=3)
+        assert buf.getvalue() == "[particles] distributed n=3\n"
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        log = EventLog()
+        log.info("decision", "verdict", success=True)
+        log.warning("round", "overflow")
+        path = tmp_path / "events.jsonl"
+        log.write_jsonl(str(path))
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 2
+        rec = json.loads(lines[0])
+        assert rec["phase"] == "decision" and rec["success"] is True
+        assert json.loads(lines[1])["level"] == "WARNING"
+
+
+class TestTimers:
+    def test_accumulates(self):
+        t = {"now": 0.0}
+
+        def clock():
+            return t["now"]
+
+        timers = PhaseTimers(clock=clock)
+        for _ in range(2):
+            with timers.time("rounds"):
+                t["now"] += 1.5
+        assert timers.total("rounds") == 3.0
+        assert timers.count("rounds") == 2
+        assert timers.summary()["rounds"] == {"total_s": 3.0, "count": 2}
+        assert "rounds" in timers.render()
+
+    def test_throughput(self):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=1)
+        th = throughput(cfg, n_trials=10, seconds=2.0)
+        assert th["trials_per_sec"] == 5.0
+        # n_rounds = n_dishonest + 1 = 2 (tfg.py:337)
+        assert th["rounds_per_sec"] == 10.0
+
+
+class TestReports:
+    def test_verdict_matches_trial(self):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=0)
+        res = jax.jit(lambda k: run_trial(cfg, k))(jax.random.key(0))
+        text = render_verdict(cfg, res)
+        v = int(np.asarray(res.v_comm))
+        assert f"Decisions:  [{v}, {v}, {v}]" in text
+        assert "Dishonests: []" in text
+        assert "Success:    True" in text
+
+    def test_verdict_no_decision_sentinel(self):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=1)
+
+        class T:
+            decisions = np.array([2, cfg.no_decision, 1])
+            honest = np.array([False, True, True])
+            success = np.array(False)
+            overflow = np.array(False)
+
+        text = render_verdict(cfg, T(), index=7)
+        assert "trial 7:" in text
+        assert "[2, None, 1]" in text
+        assert "Dishonests: [1]" in text  # commander rank 1 dishonest
+
+    def test_sweep_summary(self):
+        cfg = QBAConfig(n_parties=11, size_l=16, n_dishonest=3)
+        text = render_sweep(cfg, success_rate=0.975, n_trials=400, seconds=2.0)
+        assert "success rate: 0.9750" in text
+        # 400 trials * 4 rounds / 2 s = 800 rounds/s
+        assert "800.0 protocol rounds/s" in text
