@@ -11,6 +11,10 @@ The reference's backend boundary is the MPI rank: one OS process per party
   for differential testing and CPU baselining.  It consumes the *same*
   keyed randomness as the jax engine, so per-trial outcomes must match
   exactly — the two independent implementations check each other.
+* ``native`` — the C++ host runtime (:mod:`qba_tpu.native`): the same
+  message-level semantics with every packet passing through the PvL wire
+  codec, closing a three-way differential triangle with the other two.
+  Imported lazily (needs the native toolchain at first use).
 """
 
 from qba_tpu.backends.jax_backend import MonteCarloResult, run_trials

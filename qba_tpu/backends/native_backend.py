@@ -1,0 +1,123 @@
+"""C++ message-level backend — backend=native.
+
+A third, independent implementation of the protocol (after the JAX array
+engine and the pure-Python local backend): the C++ host runtime in
+:mod:`qba_tpu.native` executes a full trial over per-party mailboxes, with
+every packet passing through the PvL wire codec — the in-process analog of
+the reference's tagged-MPI transport (``tfg.py:199-263``).
+
+Randomness is pre-sampled here with the *identical* key tree the other
+two backends consume (dishonesty, lists, orders, per-(round, receiver,
+cell) attack triples), so for any config and trial key all three
+implementations must produce identical decisions and verdicts —
+``tests/test_native.py`` enforces the three-way match.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qba_tpu.adversary import assign_dishonest, commander_orders, sample_attack
+from qba_tpu.config import QBAConfig
+from qba_tpu.native import load
+from qba_tpu.qsim import generate_lists, generate_lists_dense
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _i32(a: np.ndarray):
+    a = np.ascontiguousarray(a, dtype=np.int32)
+    return a, a.ctypes.data_as(_i32p)
+
+
+def _u8(a: np.ndarray):
+    a = np.ascontiguousarray(a, dtype=np.uint8)
+    return a, a.ctypes.data_as(_u8p)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _attack_triples(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
+    """int32[n_rounds, n_lieu, n_lieu*slots, 3] — the (action, coin,
+    rand_v) draw for every delivery cell, with the shared key derivation
+    (round -> receiver -> cell, matching the local backend's fold_in
+    chain)."""
+    rounds = jnp.arange(1, cfg.n_rounds + 1)
+    recvs = jnp.arange(cfg.n_lieutenants)
+    cells = jnp.arange(cfg.n_lieutenants * cfg.slots)
+
+    def one(r, recv, cell):
+        k = jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(k_rounds, r), recv), cell
+        )
+        return jnp.stack(
+            [x.astype(jnp.int32) for x in sample_attack(cfg, k)]
+        )
+
+    f = jax.vmap(
+        jax.vmap(jax.vmap(one, in_axes=(None, None, 0)), in_axes=(None, 0, None)),
+        in_axes=(0, None, None),
+    )
+    return f(rounds, recvs, cells)
+
+
+def run_trial_native(cfg: QBAConfig, key: jax.Array) -> dict:
+    """One protocol execution in the C++ runtime; returns the rank-0
+    summary dict (same shape as
+    :func:`qba_tpu.backends.local_backend.run_trial_local`)."""
+    lib = load()
+    k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
+
+    honest = np.asarray(assign_dishonest(cfg, k_dis))
+    gen = generate_lists if cfg.qsim_path == "factorized" else generate_lists_dense
+    lists = np.asarray(gen(cfg, k_lists)[0])
+    v_sent_arr, v_comm = commander_orders(
+        cfg, k_comm, jnp.asarray(bool(honest[1]))
+    )
+    attacks = np.asarray(_attack_triples(cfg, k_rounds))
+
+    n_lieu, w = cfg.n_lieutenants, cfg.w
+    honest_a, honest_p = _u8(honest)
+    lists_a, lists_p = _i32(lists)
+    vs_a, vs_p = _i32(np.asarray(v_sent_arr))
+    at_a, at_p = _i32(attacks)
+    decisions = np.zeros(cfg.n_parties, dtype=np.int32)
+    vi = np.zeros((n_lieu, w), dtype=np.uint8)
+    flags = np.zeros(2, dtype=np.int32)
+    _, dec_p = decisions, decisions.ctypes.data_as(_i32p)
+    _, vi_p = vi, vi.ctypes.data_as(_u8p)
+    _, fl_p = flags, flags.ctypes.data_as(_i32p)
+
+    rc = lib.qba_run_trial(
+        cfg.n_parties,
+        cfg.size_l,
+        cfg.n_dishonest,
+        w,
+        cfg.slots,
+        honest_p,
+        lists_p,
+        vs_p,
+        int(v_comm),
+        at_p,
+        dec_p,
+        vi_p,
+        fl_p,
+    )
+    if rc != 0:
+        raise RuntimeError(f"qba_run_trial failed with rc={rc}")
+
+    return {
+        "success": bool(flags[0]),
+        "decisions": [int(x) for x in decisions],
+        "honest": [bool(h) for h in honest[1:]],
+        "v_comm": int(v_comm),
+        "vi": [
+            {int(x) for x in range(w) if vi[i, x]} for i in range(n_lieu)
+        ],
+        "overflow": bool(flags[1]),
+    }

@@ -64,8 +64,9 @@ def _parser() -> argparse.ArgumentParser:
     run = sub.add_parser("run", help="run trials, print verdicts")
     _add_config_args(run, trials_default=1)
     run.add_argument(
-        "--backend", choices=("jax", "local"), default="jax",
-        help="jax = vectorized TPU path; local = message-level differential path",
+        "--backend", choices=("jax", "local", "native"), default="jax",
+        help="jax = vectorized TPU path; local = message-level pure-Python "
+        "path; native = C++ host runtime (qba_tpu/native)",
     )
     run.add_argument(
         "-v", "--verbose", action="store_true", help="debug-level event log"
@@ -97,6 +98,8 @@ def _parser() -> argparse.ArgumentParser:
 
 
 def _cmd_run(args: argparse.Namespace, out) -> int:
+    import types
+
     import jax
     import numpy as np
 
@@ -111,43 +114,49 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
              n_dishonest=cfg.n_dishonest, w=cfg.w, trials=cfg.trials,
              backend=args.backend, qsim_path=cfg.qsim_path)
 
-    if args.backend == "local":
-        from qba_tpu.backends.jax_backend import trial_keys
-        from qba_tpu.backends.local_backend import run_trial_local
-
-        keys = trial_keys(cfg)
-        successes = 0
-        t0 = time.perf_counter()
-        with timers.time("trials"):
-            for i in range(cfg.trials):
-                r = run_trial_local(cfg, keys[i])
-                successes += int(r["success"])
-                if i < args.max_verdicts:
-                    decisions = [
-                        d if d != cfg.no_decision else None for d in r["decisions"]
-                    ]
-                    print(f"trial {i}:", file=out)
-                    print(f"Decisions:  {decisions}", file=out)
-                    dis = [j + 1 for j, h in enumerate(r["honest"]) if not h]
-                    print(f"Dishonests: {dis}", file=out)
-                    print(f"Success:    {r['success']}", file=out)
-        dt = time.perf_counter() - t0
-        print(render_sweep(cfg, successes / cfg.trials, cfg.trials, dt), file=out)
-        return 0
-
-    from qba_tpu.backends.jax_backend import run_trials, trial_keys
-
     with profile_trace(args.profile_dir):
-        with timers.time("trials"):
-            t0 = time.perf_counter()
-            res = jax.block_until_ready(run_trials(cfg, trial_keys(cfg)))
-            dt = time.perf_counter() - t0
-    for i in range(min(cfg.trials, args.max_verdicts)):
-        one = jax.tree.map(lambda x: np.asarray(x)[i], res.trials)
-        print(render_verdict(cfg, one, index=i), file=out)
-    if bool(np.any(np.asarray(res.trials.overflow))):
+        if args.backend in ("local", "native"):
+            from qba_tpu.backends.jax_backend import trial_keys
+
+            if args.backend == "native":
+                from qba_tpu.backends.native_backend import run_trial_native as run_one
+            else:
+                from qba_tpu.backends.local_backend import run_trial_local as run_one
+
+            keys = trial_keys(cfg)
+            successes = 0
+            any_overflow = False
+            with timers.time("trials"):
+                for i in range(cfg.trials):
+                    r = run_one(cfg, keys[i])
+                    successes += int(r["success"])
+                    any_overflow |= r["overflow"]
+                    if i < args.max_verdicts:
+                        trial = types.SimpleNamespace(
+                            decisions=np.asarray(r["decisions"]),
+                            honest=np.asarray(r["honest"]),
+                            success=np.asarray(r["success"]),
+                            overflow=np.asarray(r["overflow"]),
+                        )
+                        print(render_verdict(cfg, trial, index=i), file=out)
+            success_rate = successes / cfg.trials
+        else:
+            from qba_tpu.backends.jax_backend import run_trials, trial_keys
+
+            with timers.time("trials"):
+                res = jax.block_until_ready(run_trials(cfg, trial_keys(cfg)))
+            for i in range(min(cfg.trials, args.max_verdicts)):
+                one = jax.tree.map(lambda x: np.asarray(x)[i], res.trials)
+                print(render_verdict(cfg, one, index=i), file=out)
+            any_overflow = bool(np.any(np.asarray(res.trials.overflow)))
+            success_rate = float(res.success_rate)
+
+    if any_overflow:
         log.warning("round", "mailbox slot overflow in some trials")
-    print(render_sweep(cfg, float(res.success_rate), cfg.trials, dt), file=out)
+    print(
+        render_sweep(cfg, success_rate, cfg.trials, timers.total("trials")),
+        file=out,
+    )
     if args.jsonl:
         log.write_jsonl(args.jsonl)
     return 0

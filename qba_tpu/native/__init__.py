@@ -1,0 +1,83 @@
+"""Native host runtime: ctypes bindings to ``libqba_native.so``.
+
+The reference's host runtime is native by dependency — an MPI C library
+for transport and qsimov's C core for simulation (SURVEY §2.15-2.16).
+Here TPU compute stays in XLA; the native layer provides the host-side
+message-level engine + PvL wire codec (``src/qba_native.cc``), built on
+demand with ``make`` (g++, no dependencies) and cached by source mtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libqba_native.so")
+_SRC = os.path.join(_DIR, "src", "qba_native.cc")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _build() -> None:
+    proc = subprocess.run(
+        ["make", "-C", _DIR],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+
+
+def load() -> ctypes.CDLL:
+    """Build (if stale) and load the native library; thread-safe, cached."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+            _SRC
+        ):
+            _build()
+        lib = ctypes.CDLL(_SO)
+
+        lib.qba_consistent.restype = ctypes.c_int
+        lib.qba_consistent.argtypes = [
+            ctypes.c_int32, _i32p, _i32p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int32,
+        ]
+        lib.qba_encode_pvl.restype = ctypes.c_int
+        lib.qba_encode_pvl.argtypes = [
+            _i32p, ctypes.c_int, ctypes.c_int32, _i32p, _i32p, ctypes.c_int,
+            ctypes.c_int, _i32p, ctypes.c_int,
+        ]
+        lib.qba_decode_pvl.restype = ctypes.c_int
+        lib.qba_decode_pvl.argtypes = [
+            _i32p, ctypes.c_int, _i32p, ctypes.c_int, _i32p, _i32p,
+            ctypes.c_int, ctypes.c_int, _i32p,
+        ]
+        lib.qba_run_trial.restype = ctypes.c_int
+        lib.qba_run_trial.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int32,
+            ctypes.c_int, _u8p, _i32p, _i32p, ctypes.c_int32, _i32p, _i32p,
+            _u8p, _i32p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library can be built/loaded on this host."""
+    try:
+        load()
+        return True
+    except (RuntimeError, OSError):
+        return False

@@ -1,0 +1,289 @@
+// qba_native — C++ host runtime for the QBA protocol.
+//
+// The reference delegates its entire host runtime to native dependencies:
+// an MPI C library for transport (tfg.py:199-263,310-363) and qsimov's C
+// core for circuit simulation (tfg.py:68-84).  This framework keeps TPU
+// compute in XLA (qba_tpu/qsim, qba_tpu/rounds) and provides the native
+// host-side runtime here: a tagged PvL wire codec (the send_pvl/recv_pvl
+// format, tfg.py:199-263) and a message-level protocol engine that runs a
+// full trial over per-party mailboxes (tfg.py:166-363).
+//
+// Randomness is pre-sampled by the caller (honesty mask, particle lists,
+// commander orders, per-cell attack triples) so the engine is a
+// deterministic function — bit-compatible with both Python backends for
+// the same key tree; tests/test_native.py enforces the three-way match.
+//
+// Build: make -C qba_tpu/native  (g++ -O2 -shared; no dependencies).
+
+#include <algorithm>
+#include <cstdint>
+#include <set>
+#include <vector>
+
+namespace {
+
+using Tuple = std::vector<int32_t>;
+
+// ---------------------------------------------------------------------------
+// Consistency predicate (tfg.py:87-98): (1) all tuples the same length,
+// (2) every element in [0, w] and != v, (3) every pair of tuples differs
+// at every index.  Empty L is consistent.
+bool consistent(int32_t v, const std::set<Tuple>& L, int32_t w) {
+  if (L.empty()) return true;
+  const size_t n = L.begin()->size();
+  for (const Tuple& t : L) {
+    if (t.size() != n) return false;
+    for (int32_t x : t) {
+      if (x < 0 || x > w || x == v) return false;
+    }
+  }
+  for (auto a = L.begin(); a != L.end(); ++a) {
+    for (auto b = std::next(a); b != L.end(); ++b) {
+      for (size_t k = 0; k < n; ++k) {
+        if ((*a)[k] == (*b)[k]) return false;
+      }
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// PvL wire codec.  Flat int32 layout mirroring the reference's tag
+// sequence (tfg.py:199-263): |P|, P..., v, |L|, then per tuple: len,
+// elements.  Returns the number of int32 words written, or -1 on
+// insufficient capacity.
+int encode_pvl(const std::vector<int32_t>& p, int32_t v,
+               const std::set<Tuple>& L, int32_t* out, int cap) {
+  std::vector<int32_t> buf;
+  buf.push_back(static_cast<int32_t>(p.size()));
+  buf.insert(buf.end(), p.begin(), p.end());
+  buf.push_back(v);
+  buf.push_back(static_cast<int32_t>(L.size()));
+  for (const Tuple& t : L) {
+    buf.push_back(static_cast<int32_t>(t.size()));
+    buf.insert(buf.end(), t.begin(), t.end());
+  }
+  if (static_cast<int>(buf.size()) > cap) return -1;
+  std::copy(buf.begin(), buf.end(), out);
+  return static_cast<int>(buf.size());
+}
+
+// Returns words consumed, or -1 on a malformed buffer.
+int decode_pvl(const int32_t* buf, int len, std::vector<int32_t>* p,
+               int32_t* v, std::set<Tuple>* L) {
+  int i = 0;
+  if (i >= len) return -1;
+  int32_t np = buf[i++];
+  if (np < 0 || i + np > len) return -1;
+  p->assign(buf + i, buf + i + np);
+  i += np;
+  if (i >= len) return -1;
+  *v = buf[i++];
+  if (i >= len) return -1;
+  int32_t nt = buf[i++];
+  if (nt < 0) return -1;
+  L->clear();
+  for (int32_t t = 0; t < nt; ++t) {
+    if (i >= len) return -1;
+    int32_t tl = buf[i++];
+    if (tl < 0 || i + tl > len) return -1;
+    L->insert(Tuple(buf + i, buf + i + tl));
+    i += tl;
+  }
+  return i;
+}
+
+struct Packet {
+  std::vector<int32_t> p;
+  int32_t v;
+  std::set<Tuple> L;
+};
+
+}  // namespace
+
+extern "C" {
+
+// consistent() over a flat [n_tuples, max_len] tuple matrix with per-tuple
+// lengths; exposed for differential tests against the Python/JAX versions.
+int qba_consistent(int32_t v, const int32_t* tuples, const int32_t* lens,
+                   int n_tuples, int max_len, int32_t w) {
+  std::set<Tuple> L;
+  for (int t = 0; t < n_tuples; ++t) {
+    L.insert(Tuple(tuples + t * max_len, tuples + t * max_len + lens[t]));
+  }
+  return consistent(v, L, w) ? 1 : 0;
+}
+
+int qba_encode_pvl(const int32_t* p, int np, int32_t v, const int32_t* tuples,
+                   const int32_t* lens, int n_tuples, int max_len,
+                   int32_t* out, int cap) {
+  std::vector<int32_t> pv(p, p + np);
+  std::set<Tuple> L;
+  for (int t = 0; t < n_tuples; ++t) {
+    L.insert(Tuple(tuples + t * max_len, tuples + t * max_len + lens[t]));
+  }
+  return encode_pvl(pv, v, L, out, cap);
+}
+
+// Decode into flat buffers: p_out (cap np_cap), tuple matrix
+// [nt_cap, max_len] + lens.  Writes (np, v, nt) into header_out[0..2].
+// Returns words consumed or -1.
+int qba_decode_pvl(const int32_t* buf, int len, int32_t* p_out, int np_cap,
+                   int32_t* tuples_out, int32_t* lens_out, int nt_cap,
+                   int max_len, int32_t* header_out) {
+  std::vector<int32_t> p;
+  int32_t v;
+  std::set<Tuple> L;
+  int used = decode_pvl(buf, len, &p, &v, &L);
+  if (used < 0) return -1;
+  if (static_cast<int>(p.size()) > np_cap ||
+      static_cast<int>(L.size()) > nt_cap)
+    return -1;
+  std::copy(p.begin(), p.end(), p_out);
+  int t = 0;
+  for (const Tuple& tup : L) {
+    if (static_cast<int>(tup.size()) > max_len) return -1;
+    lens_out[t] = static_cast<int32_t>(tup.size());
+    std::copy(tup.begin(), tup.end(), tuples_out + t * max_len);
+    ++t;
+  }
+  header_out[0] = static_cast<int32_t>(p.size());
+  header_out[1] = v;
+  header_out[2] = static_cast<int32_t>(L.size());
+  return used;
+}
+
+// Full message-level trial (tfg.py:166-363) over pre-sampled randomness.
+//
+//   honest   : uint8[n_parties+1], rank-indexed (rank 0 = QSD)
+//   lists    : int32[(n_parties+1) * size_l], row-major
+//   v_sent   : int32[n_lieu] per-lieutenant commander order (equivocation
+//              already applied, tfg.py:169-181)
+//   attacks  : int32[n_rounds * n_lieu * n_lieu * slots * 3] — per
+//              (round-1, receiver, sender*slots+slot) triples
+//              (action, coin, rand_v), the sample_attack layout
+//   decisions_out : int32[n_parties] (index 0 = commander)
+//   vi_out   : uint8[n_lieu * w] accepted-set masks
+//   flags_out: int32[2] = {success, overflow}
+//
+// Packets move between parties through the PvL codec (encode on send,
+// decode on delivery) — the in-process analog of the reference's tagged
+// MPI transport.  Returns 0, or -1 on a codec capacity/format error.
+int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
+                  int slots, const uint8_t* honest, const int32_t* lists,
+                  const int32_t* v_sent, int32_t v_comm,
+                  const int32_t* attacks, int32_t* decisions_out,
+                  uint8_t* vi_out, int32_t* flags_out) {
+  const int n_lieu = n_parties - 1;
+  const int n_rounds = n_dishonest + 1;
+  const int max_l = n_dishonest + 2;
+  const int cap = 3 + size_l + max_l * (1 + size_l);
+
+  auto list_row = [&](int rank) { return lists + rank * size_l; };
+
+  // Step 1b (tfg.py:325-328): positions where the QSD copy differs from
+  // the commander's own list are exactly the Q-correlated ones.
+  std::vector<int32_t> isq;
+  for (int k = 0; k < size_l; ++k) {
+    if (list_row(0)[k] != list_row(1)[k]) isq.push_back(k);
+  }
+
+  std::vector<std::set<int32_t>> vi(n_lieu);
+  bool overflow = false;
+
+  // Mailboxes hold encoded packets; slot index = append order (the dense
+  // mailbox tensor numbering shared with the JAX engine).
+  using Wire = std::vector<int32_t>;
+  std::vector<std::vector<Wire>> mailbox(n_lieu);
+
+  auto own_sublist = [&](int lieu, const std::vector<int32_t>& p) {
+    Tuple t;
+    t.reserve(p.size());
+    for (int32_t j : p) t.push_back(list_row(lieu + 2)[j]);
+    return t;
+  };
+
+  auto push = [&](std::vector<Wire>* box, const Packet& pk) -> int {
+    Wire wire(cap);
+    int n = encode_pvl(pk.p, pk.v, pk.L, wire.data(), cap);
+    if (n < 0) return -1;
+    wire.resize(n);
+    box->push_back(std::move(wire));
+    return 0;
+  };
+
+  // Step 2 + 3a (tfg.py:166-196).
+  for (int i = 0; i < n_lieu; ++i) {
+    Packet pk;
+    pk.v = v_sent[i];
+    for (int32_t k : isq) {
+      if (list_row(1)[k] == pk.v) pk.p.push_back(k);
+    }
+    pk.L.insert(own_sublist(i, pk.p));
+    if (consistent(pk.v, pk.L, w)) {
+      vi[i].insert(pk.v);
+      if (push(&mailbox[i], pk) < 0) return -1;
+    }
+  }
+
+  // Step 3b (tfg.py:337-348): synchronous rounds.
+  for (int rnd = 1; rnd <= n_rounds; ++rnd) {
+    std::vector<std::vector<Wire>> out(n_lieu);
+    for (int recv = 0; recv < n_lieu; ++recv) {
+      for (int sender = 0; sender < n_lieu; ++sender) {
+        int n_slots = std::min<int>(slots, mailbox[sender].size());
+        for (int slot = 0; slot < n_slots; ++slot) {
+          if (sender == recv) continue;
+          const Wire& wire = mailbox[sender][slot];
+          Packet pk;
+          if (decode_pvl(wire.data(), static_cast<int>(wire.size()), &pk.p,
+                         &pk.v, &pk.L) < 0)
+            return -1;
+          const int32_t* a =
+              attacks + (((rnd - 1) * n_lieu + recv) * n_lieu * slots +
+                         sender * slots + slot) *
+                            3;
+          if (!honest[sender + 2]) {  // tfg.py:271-284
+            if (a[0] == 0 && a[1] == 0) continue;  // drop
+            if (a[0] == 1) pk.v = a[2];            // corrupt v
+            else if (a[0] == 2) pk.p.clear();      // clear P
+            else if (a[0] == 3) pk.L.clear();      // clear L
+          }
+          // lieu_receive (tfg.py:289-300)
+          pk.L.insert(own_sublist(recv, pk.p));
+          if (consistent(pk.v, pk.L, w) && !vi[recv].count(pk.v) &&
+              static_cast<int>(pk.L.size()) == rnd + 1) {
+            vi[recv].insert(pk.v);
+            if (rnd <= n_dishonest) {
+              if (static_cast<int>(out[recv].size()) < slots) {
+                if (push(&out[recv], pk) < 0) return -1;
+              } else {
+                overflow = true;
+              }
+            }
+          }
+        }
+      }
+    }
+    mailbox = std::move(out);
+  }
+
+  // Decision + verdict (tfg.py:303-306,351-363; empty-Vi sentinel = w,
+  // docs/DIVERGENCES.md D2).
+  decisions_out[0] = v_comm;
+  for (int i = 0; i < n_lieu; ++i) {
+    decisions_out[i + 1] = vi[i].empty() ? w : *vi[i].begin();
+    for (int32_t x = 0; x < w; ++x) {
+      vi_out[i * w + x] = vi[i].count(x) ? 1 : 0;
+    }
+  }
+  std::set<int32_t> filtered;
+  for (int i = 0; i < n_parties; ++i) {
+    if (honest[i + 1]) filtered.insert(decisions_out[i]);
+  }
+  flags_out[0] = filtered.size() == 1 ? 1 : 0;
+  flags_out[1] = overflow ? 1 : 0;
+  return 0;
+}
+
+}  // extern "C"

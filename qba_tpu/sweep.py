@@ -136,7 +136,11 @@ def run_sweep(
     if runner is None:
         runner = batched_trials
 
-    chunks = load_checkpoint(checkpoint, cfg, chunk_trials) if checkpoint else []
+    loaded = load_checkpoint(checkpoint, cfg, chunk_trials) if checkpoint else []
+    # A checkpoint may hold more chunks than this invocation asks for;
+    # aggregate only the requested range (the file keeps the full set).
+    chunks = [c for c in loaded if c.chunk < n_chunks]
+    extra = [c for c in loaded if c.chunk >= n_chunks]
     done = {c.chunk for c in chunks}
     resumed = len(chunks)
     if log and resumed:
@@ -158,7 +162,7 @@ def run_sweep(
         )
         chunks.append(cr)
         if checkpoint:
-            save_checkpoint(checkpoint, cfg, chunk_trials, chunks)
+            save_checkpoint(checkpoint, cfg, chunk_trials, chunks + extra)
         if log:
             log.info(
                 "sweep",

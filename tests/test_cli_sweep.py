@@ -45,6 +45,16 @@ class TestSweep:
         with pytest.raises(ValueError, match="chunk_trials"):
             load_checkpoint(ckpt, cfg, 3)
 
+    def test_resume_with_fewer_chunks_aggregates_subset(self, tmp_path):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=0, trials=2)
+        ckpt = str(tmp_path / "sweep.json")
+        run_sweep(cfg, n_chunks=4, chunk_trials=2, checkpoint=ckpt)
+        res = run_sweep(cfg, n_chunks=2, chunk_trials=2, checkpoint=ckpt)
+        assert res.n_trials == 4  # only the requested 2 chunks
+        assert res.resumed_chunks == 2
+        # the checkpoint file still holds all 4 chunks
+        assert len(load_checkpoint(ckpt, cfg, 2)) == 4
+
     def test_chunk_keys_deterministic(self):
         cfg = QBAConfig(n_parties=3, size_l=4, seed=9)
         a = chunk_keys(cfg, 5, 3)
@@ -64,15 +74,18 @@ class TestCLI:
         assert text.count("Success:    True") == 2
         assert "success rate: 1.0000" in text
 
-    def test_run_local_backend(self):
+    def test_run_local_backend(self, tmp_path):
         out = io.StringIO()
+        jsonl = tmp_path / "events.jsonl"
         rc = main(
             ["run", "--n-parties", "3", "--size-l", "8", "--trials", "1",
-             "--backend", "local"],
+             "--backend", "local", "--jsonl", str(jsonl)],
             out=out,
         )
         assert rc == 0
         assert "Success:    True" in out.getvalue()
+        # --jsonl must be honored on every backend
+        assert json.loads(jsonl.read_text().splitlines()[0])["phase"] == "config"
 
     def test_bench_json(self):
         out = io.StringIO()

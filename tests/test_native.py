@@ -1,0 +1,140 @@
+"""C++ host runtime tests: codec round-trip, consistent parity, and the
+three-way differential (native == local == jax) on identical randomness."""
+
+import ctypes
+
+import jax
+import numpy as np
+import pytest
+
+from qba_tpu.backends.local_backend import _consistent, run_trial_local
+from qba_tpu.config import QBAConfig
+
+native = pytest.importorskip("qba_tpu.native")
+if not native.available():  # pragma: no cover - g++ is expected in CI
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+from qba_tpu.backends.native_backend import run_trial_native  # noqa: E402
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+
+
+def _as_i32(a):
+    a = np.ascontiguousarray(a, dtype=np.int32)
+    return a, a.ctypes.data_as(_i32p)
+
+
+class TestCodec:
+    def _roundtrip(self, p, v, tuples):
+        lib = native.load()
+        max_len = max((len(t) for t in tuples), default=1) or 1
+        nt = len(tuples)
+        tm = np.zeros((max(nt, 1), max_len), dtype=np.int32)
+        lens = np.zeros(max(nt, 1), dtype=np.int32)
+        for i, t in enumerate(tuples):
+            lens[i] = len(t)
+            tm[i, : len(t)] = t
+        cap = 3 + len(p) + nt * (1 + max_len)
+        buf = np.zeros(cap, dtype=np.int32)
+        p_a, p_p = _as_i32(np.asarray(p, dtype=np.int32))
+        tm_a, tm_p = _as_i32(tm)
+        lens_a, lens_p = _as_i32(lens)
+        buf_p = buf.ctypes.data_as(_i32p)
+        n = lib.qba_encode_pvl(p_p, len(p), v, tm_p, lens_p, nt, max_len, buf_p, cap)
+        assert n > 0
+
+        p_out = np.zeros(max(len(p), 1), dtype=np.int32)
+        t_out = np.zeros((max(nt, 1), max_len), dtype=np.int32)
+        l_out = np.zeros(max(nt, 1), dtype=np.int32)
+        hdr = np.zeros(3, dtype=np.int32)
+        used = lib.qba_decode_pvl(
+            buf_p, n, p_out.ctypes.data_as(_i32p), len(p),
+            t_out.ctypes.data_as(_i32p), l_out.ctypes.data_as(_i32p),
+            nt, max_len, hdr.ctypes.data_as(_i32p),
+        )
+        assert used == n
+        assert hdr[1] == v and hdr[0] == len(p) and hdr[2] == nt
+        assert p_out[: len(p)].tolist() == list(p)
+        got = {tuple(t_out[i, : l_out[i]].tolist()) for i in range(nt)}
+        assert got == {tuple(t) for t in tuples}
+
+    def test_roundtrip(self):
+        self._roundtrip([1, 4, 9], 3, [(2, 5), (7, 1)])
+
+    def test_roundtrip_empty(self):
+        self._roundtrip([], 0, [])
+
+    def test_malformed_rejected(self):
+        lib = native.load()
+        # |P| = 100 but only 2 words follow
+        bad = np.array([100, 1, 2], dtype=np.int32)
+        out = np.zeros(8, dtype=np.int32)
+        hdr = np.zeros(3, dtype=np.int32)
+        rc = lib.qba_decode_pvl(
+            bad.ctypes.data_as(_i32p), 3, out.ctypes.data_as(_i32p), 8,
+            out.ctypes.data_as(_i32p), out.ctypes.data_as(_i32p), 2, 4,
+            hdr.ctypes.data_as(_i32p),
+        )
+        assert rc == -1
+
+
+class TestConsistentParity:
+    def test_random_cases_match_python(self):
+        lib = native.load()
+        rng = np.random.default_rng(0)
+        w = 4
+        for _ in range(300):
+            nt = int(rng.integers(0, 4))
+            n = int(rng.integers(1, 4))
+            same_len = rng.random() < 0.7
+            tuples = []
+            for _t in range(nt):
+                ln = n if same_len else int(rng.integers(1, 4))
+                tuples.append(tuple(int(x) for x in rng.integers(0, w + 1, ln)))
+            v = int(rng.integers(0, w))
+            expected = _consistent(v, set(tuples), w)
+
+            uniq = sorted(set(tuples))
+            max_len = max((len(t) for t in uniq), default=1) or 1
+            tm = np.zeros((max(len(uniq), 1), max_len), dtype=np.int32)
+            lens = np.zeros(max(len(uniq), 1), dtype=np.int32)
+            for i, t in enumerate(uniq):
+                lens[i] = len(t)
+                tm[i, : len(t)] = t
+            got = lib.qba_consistent(
+                v, tm.ctypes.data_as(_i32p), lens.ctypes.data_as(_i32p),
+                len(uniq), max_len, w,
+            )
+            assert bool(got) == expected, (v, tuples)
+
+
+CONFIGS = [
+    QBAConfig(n_parties=3, size_l=8, n_dishonest=0),
+    QBAConfig(n_parties=3, size_l=8, n_dishonest=1),
+    QBAConfig(n_parties=3, size_l=8, n_dishonest=3),
+    QBAConfig(n_parties=5, size_l=16, n_dishonest=2),
+    QBAConfig(n_parties=11, size_l=16, n_dishonest=5),
+]
+
+
+class TestDifferentialNativeVsLocal:
+    @pytest.mark.parametrize("cfg", CONFIGS, ids=lambda c: f"p{c.n_parties}d{c.n_dishonest}")
+    def test_matches_local(self, cfg):
+        keys = jax.random.split(jax.random.key(11), 6)
+        for k in keys:
+            a = run_trial_native(cfg, k)
+            b = run_trial_local(cfg, k)
+            assert a == b
+
+    def test_matches_jax_engine(self):
+        # local == jax is covered by test_differential; close the triangle
+        # native == jax directly on one adversarial config.
+        from qba_tpu.rounds import run_trial
+
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=2)
+        for k in jax.random.split(jax.random.key(5), 4):
+            a = run_trial_native(cfg, k)
+            r = jax.jit(lambda kk: run_trial(cfg, kk))(k)
+            assert a["decisions"] == [int(x) for x in np.asarray(r.decisions)]
+            assert a["success"] == bool(np.asarray(r.success))
+            assert a["honest"] == [bool(h) for h in np.asarray(r.honest)]
