@@ -12,6 +12,7 @@ from qba_tpu.adversary.model import (
     assign_dishonest,
     commander_orders,
     corrupt_at_delivery,
+    late_drop,
     sample_attack,
 )
 
@@ -19,5 +20,6 @@ __all__ = [
     "assign_dishonest",
     "commander_orders",
     "corrupt_at_delivery",
+    "late_drop",
     "sample_attack",
 ]

@@ -80,6 +80,28 @@ def sample_attack(cfg: QBAConfig, key: jax.Array):
     return action, coin, rand_v
 
 
+_LATE_TAG = 0x17A7E  # disjoint from round/receiver/cell fold_in indices
+
+
+def late_drop(cfg: QBAConfig, cell_key: jax.Array) -> jnp.ndarray:
+    """Race-class modeling (docs/DIVERGENCES.md D1).
+
+    The reference's ``comm.Barrier`` does not flush point-to-point traffic,
+    so a packet can miss its round's ``Iprobe`` drain (``tfg.py:341``) and
+    arrive one round late, where ``len(L) == round+1`` (``tfg.py:294``)
+    silently discards it — lateness IS loss.  With ``delivery="racy"``
+    each (packet, receiver) delivery independently suffers that fate with
+    probability ``p_late``; ``delivery="sync"`` (default) is the race-free
+    idealization.  Keyed off the cell key with a disjoint tag, so sync and
+    racy-with-p_late=0 runs are bit-identical.
+    """
+    if cfg.delivery != "racy":
+        return jnp.asarray(False)
+    return jax.random.bernoulli(
+        jax.random.fold_in(cell_key, _LATE_TAG), cfg.p_late
+    )
+
+
 def corrupt_at_delivery(
     cfg: QBAConfig,
     key: jax.Array,

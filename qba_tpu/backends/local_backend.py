@@ -20,9 +20,14 @@ import itertools
 import jax
 import numpy as np
 
-from qba_tpu.adversary import assign_dishonest, commander_orders, sample_attack
+from qba_tpu.adversary import (
+    assign_dishonest,
+    commander_orders,
+    late_drop,
+    sample_attack,
+)
 from qba_tpu.config import QBAConfig
-from qba_tpu.qsim import generate_lists, generate_lists_dense
+from qba_tpu.qsim import generate_lists_for
 
 
 def _consistent(v: int, L: set, w: int) -> bool:
@@ -47,8 +52,7 @@ def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
     k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
 
     honest = np.asarray(assign_dishonest(cfg, k_dis))
-    gen = generate_lists if cfg.qsim_path == "factorized" else generate_lists_dense
-    lists = np.asarray(gen(cfg, k_lists)[0])
+    lists = np.asarray(generate_lists_for(cfg, k_lists)[0])
     v_sent_arr, v_comm = commander_orders(
         cfg, k_comm, jax.numpy.asarray(bool(honest[1]))
     )
@@ -86,12 +90,11 @@ def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
                     if sender == recv:
                         continue
                     p, v, ell = mailbox[sender][slot]
+                    k_cell = jax.random.fold_in(k_recv, sender * slots + slot)
+                    if bool(late_drop(cfg, k_cell)):  # D1 race modeling
+                        continue
                     action, coin, rand_v = (
-                        int(x)
-                        for x in sample_attack(
-                            cfg,
-                            jax.random.fold_in(k_recv, sender * slots + slot),
-                        )
+                        int(x) for x in sample_attack(cfg, k_cell)
                     )
                     p2, v2, ell2 = set(p), v, set(ell)
                     if not honest[sender + 2]:  # tfg.py:271-284

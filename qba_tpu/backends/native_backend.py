@@ -8,8 +8,8 @@ the reference's tagged-MPI transport (``tfg.py:199-263``).
 
 Randomness is pre-sampled here with the *identical* key tree the other
 two backends consume (dishonesty, lists, orders, per-(round, receiver,
-cell) attack triples), so for any config and trial key all three
-implementations must produce identical decisions and verdicts —
+cell) attack + late-loss quads), so for any config and trial key all
+three implementations must produce identical decisions and verdicts —
 ``tests/test_native.py`` enforces the three-way match.
 """
 
@@ -22,10 +22,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from qba_tpu.adversary import assign_dishonest, commander_orders, sample_attack
+from qba_tpu.adversary import (
+    assign_dishonest,
+    commander_orders,
+    late_drop,
+    sample_attack,
+)
 from qba_tpu.config import QBAConfig
 from qba_tpu.native import load
-from qba_tpu.qsim import generate_lists, generate_lists_dense
+from qba_tpu.qsim import generate_lists_for
 
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -42,11 +47,12 @@ def _u8(a: np.ndarray):
 
 
 @functools.partial(jax.jit, static_argnums=0)
-def _attack_triples(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
-    """int32[n_rounds, n_lieu, n_lieu*slots, 3] — the (action, coin,
-    rand_v) draw for every delivery cell, with the shared key derivation
-    (round -> receiver -> cell, matching the local backend's fold_in
-    chain)."""
+def _attack_quads(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
+    """int32[n_rounds, n_lieu, n_lieu*slots, 4] — the (action, coin,
+    rand_v, late) draw for every delivery cell, with the shared key
+    derivation (round -> receiver -> cell, matching the local backend's
+    fold_in chain).  ``late`` is the racy-delivery loss flag
+    (docs/DIVERGENCES.md D1), all-zero under ``delivery="sync"``."""
     rounds = jnp.arange(1, cfg.n_rounds + 1)
     recvs = jnp.arange(cfg.n_lieutenants)
     cells = jnp.arange(cfg.n_lieutenants * cfg.slots)
@@ -55,9 +61,8 @@ def _attack_triples(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
         k = jax.random.fold_in(
             jax.random.fold_in(jax.random.fold_in(k_rounds, r), recv), cell
         )
-        return jnp.stack(
-            [x.astype(jnp.int32) for x in sample_attack(cfg, k)]
-        )
+        draws = (*sample_attack(cfg, k), late_drop(cfg, k))
+        return jnp.stack([x.astype(jnp.int32) for x in draws])
 
     f = jax.vmap(
         jax.vmap(jax.vmap(one, in_axes=(None, None, 0)), in_axes=(None, 0, None)),
@@ -74,12 +79,11 @@ def run_trial_native(cfg: QBAConfig, key: jax.Array) -> dict:
     k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
 
     honest = np.asarray(assign_dishonest(cfg, k_dis))
-    gen = generate_lists if cfg.qsim_path == "factorized" else generate_lists_dense
-    lists = np.asarray(gen(cfg, k_lists)[0])
+    lists = np.asarray(generate_lists_for(cfg, k_lists)[0])
     v_sent_arr, v_comm = commander_orders(
         cfg, k_comm, jnp.asarray(bool(honest[1]))
     )
-    attacks = np.asarray(_attack_triples(cfg, k_rounds))
+    attacks = np.asarray(_attack_quads(cfg, k_rounds))
 
     n_lieu, w = cfg.n_lieutenants, cfg.w
     honest_a, honest_p = _u8(honest)

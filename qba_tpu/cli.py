@@ -9,7 +9,10 @@ config is explicit and validated (:class:`qba_tpu.config.QBAConfig`):
   reference's ``Decisions / Dishonests / Success`` format
   (``tfg.py:360-363``) plus the Monte-Carlo aggregate.
 * ``bench`` — time the jitted batch and print the throughput line.
-* ``sweep`` — chunked, checkpoint-resumable Monte-Carlo sweep.
+* ``sweep`` — chunked, checkpoint-resumable Monte-Carlo sweep (optional
+  convergence plot).
+* ``study`` — success-rate curve over a swept parameter (e.g. the
+  security-parameter study in ``size_l``), optional plot.
 """
 
 from __future__ import annotations
@@ -38,9 +41,17 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
     p.add_argument("--trials", type=int, default=trials_default)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
-        "--qsim-path", choices=("factorized", "dense"), default="factorized",
-        help="quantum engine path (dense = joint statevector, validation only)",
+        "--qsim-path", choices=("factorized", "dense", "dense_pallas"),
+        default="factorized",
+        help="quantum engine path (dense = joint statevector, validation "
+        "only; dense_pallas = same on the fused Pallas kernel)",
     )
+    p.add_argument(
+        "--delivery", choices=("sync", "racy"), default="sync",
+        help="racy = model the reference's barrier race as per-delivery "
+        "loss with prob --p-late (docs/DIVERGENCES.md D1)",
+    )
+    p.add_argument("--p-late", type=float, default=0.0)
 
 
 def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
@@ -51,6 +62,8 @@ def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
         trials=trials if trials is not None else args.trials,
         seed=args.seed,
         qsim_path=args.qsim_path,
+        delivery=args.delivery,
+        p_late=args.p_late,
     )
 
 
@@ -93,6 +106,28 @@ def _parser() -> argparse.ArgumentParser:
     sweep.add_argument(
         "--checkpoint", metavar="PATH", default=None,
         help="JSON checkpoint; completed chunks are skipped on re-run",
+    )
+    sweep.add_argument(
+        "--plot", metavar="PNG", default=None,
+        help="write a Monte-Carlo convergence plot (requires matplotlib)",
+    )
+
+    study = sub.add_parser(
+        "study", help="success-rate curve over a swept parameter"
+    )
+    _add_config_args(study, trials_default=256)
+    study.add_argument(
+        "--param", required=True,
+        choices=("size_l", "n_dishonest", "n_parties", "p_late"),
+        help="config field to sweep (size_l is the security parameter)",
+    )
+    study.add_argument(
+        "--values", required=True,
+        help="comma-separated values, e.g. 1,2,4,8,16,32",
+    )
+    study.add_argument(
+        "--plot", metavar="PNG", default=None,
+        help="write the success-rate curve (requires matplotlib)",
     )
     return parser
 
@@ -221,6 +256,40 @@ def _cmd_sweep(args: argparse.Namespace, out) -> int:
     print(render_sweep(cfg, res.success_rate, res.n_trials, seconds), file=out)
     if res.any_overflow:
         print("(mailbox slot overflow occurred in some chunks)", file=out)
+    if args.plot:
+        from qba_tpu.obs.plots import plot_convergence
+
+        print(f"convergence plot: {plot_convergence(res, args.plot)}", file=out)
+    return 0
+
+
+def _cmd_study(args: argparse.Namespace, out) -> int:
+    import dataclasses
+
+    from qba_tpu.backends.jax_backend import run_trials
+
+    cfg = _config(args)
+    is_float = args.param == "p_late"
+    if is_float and cfg.delivery != "racy":
+        cfg = dataclasses.replace(cfg, delivery="racy")
+    values = [
+        float(x) if is_float else int(x) for x in args.values.split(",")
+    ]
+    rates = []
+    for v in values:
+        cfg_v = dataclasses.replace(cfg, **{args.param: v})
+        rate = float(run_trials(cfg_v).success_rate)
+        rates.append(rate)
+        print(f"{args.param}={v}: success_rate={rate:.4f} "
+              f"({cfg_v.trials} trials)", file=out)
+    if args.plot:
+        from qba_tpu.obs.plots import plot_param_study
+
+        path = plot_param_study(
+            values, rates, cfg.trials, args.param, args.plot,
+            log_x=args.param == "size_l" and min(values) > 0,
+        )
+        print(f"study plot: {path}", file=out)
     return 0
 
 
@@ -234,7 +303,10 @@ def main(argv: Sequence[str] | None = None, out=None) -> int:
             return _cmd_bench(args, out)
         if args.command == "sweep":
             return _cmd_sweep(args, out)
-    except ValueError as e:  # config validation errors -> clean CLI failure
+        if args.command == "study":
+            return _cmd_study(args, out)
+    except (ValueError, RuntimeError) as e:  # config / optional-dependency
+        # errors (e.g. --plot without matplotlib) -> clean CLI failure
         print(f"error: {e}", file=sys.stderr)
         return 2
     raise AssertionError(f"unhandled command {args.command}")

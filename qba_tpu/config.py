@@ -32,12 +32,20 @@ class QBAConfig:
         trial per mpiexec invocation).
       seed: PRNG seed (the reference uses the global NumPy MT19937; here an
         explicit threefry key tree).
-      qsim_path: "factorized" (closed-form sampler, any size — SURVEY §2.6)
-        or "dense" (full joint statevector, validation only, <= ~20 qubits).
+      qsim_path: "factorized" (closed-form sampler, any size — SURVEY §2.6),
+        "dense" (full joint statevector, validation only, <= ~20 qubits),
+        or "dense_pallas" (dense path on the fused single-kernel Pallas
+        executor, :mod:`qba_tpu.ops.fused_circuit`).
       max_accepts_per_round: static bound on mailbox slots per (sender,
         round). A lieutenant accepts each order value at most once
         (``v not in Vi``, ``tfg.py:294``), so ``w`` is a universal bound;
         smaller values trade memory for a recorded overflow flag.
+      delivery: "sync" (race-free idealization, default) or "racy" —
+        model the reference's barrier race (a packet missing its round's
+        ``Iprobe`` drain is silently lost, ``tfg.py:294,341``) as an
+        independent per-(packet, receiver) loss with probability
+        ``p_late``.  See docs/DIVERGENCES.md D1.
+      p_late: per-delivery lateness probability under ``delivery="racy"``.
     """
 
     n_parties: int
@@ -47,6 +55,8 @@ class QBAConfig:
     seed: int = 0
     qsim_path: str = "factorized"
     max_accepts_per_round: int | None = None
+    delivery: str = "sync"
+    p_late: float = 0.0
 
     def __post_init__(self) -> None:
         if self.n_parties < 2:
@@ -59,15 +69,21 @@ class QBAConfig:
             )
         if self.trials < 1:
             raise ValueError("trials must be >= 1")
-        if self.qsim_path not in ("factorized", "dense"):
+        if self.qsim_path not in ("factorized", "dense", "dense_pallas"):
             raise ValueError(f"unknown qsim_path {self.qsim_path!r}")
-        if self.qsim_path == "dense" and self.total_qubits > 20:
+        if self.qsim_path.startswith("dense") and self.total_qubits > 20:
             raise ValueError(
                 f"dense qsim path infeasible at {self.total_qubits} qubits; "
                 "use qsim_path='factorized'"
             )
         if self.max_accepts_per_round is not None and self.max_accepts_per_round < 1:
             raise ValueError("max_accepts_per_round must be >= 1")
+        if self.delivery not in ("sync", "racy"):
+            raise ValueError(f"unknown delivery model {self.delivery!r}")
+        if not 0.0 <= self.p_late <= 1.0:
+            raise ValueError("p_late must be in [0, 1]")
+        if self.p_late > 0.0 and self.delivery != "racy":
+            raise ValueError("p_late > 0 requires delivery='racy'")
 
     # Derived parameters (``tfg.py:316-318``).
     @property

@@ -9,7 +9,7 @@
 // full trial over per-party mailboxes (tfg.py:166-363).
 //
 // Randomness is pre-sampled by the caller (honesty mask, particle lists,
-// commander orders, per-cell attack triples) so the engine is a
+// commander orders, per-cell attack/late-loss quads) so the engine is a
 // deterministic function — bit-compatible with both Python backends for
 // the same key tree; tests/test_native.py enforces the three-way match.
 //
@@ -159,9 +159,13 @@ int qba_decode_pvl(const int32_t* buf, int len, int32_t* p_out, int np_cap,
 //   lists    : int32[(n_parties+1) * size_l], row-major
 //   v_sent   : int32[n_lieu] per-lieutenant commander order (equivocation
 //              already applied, tfg.py:169-181)
-//   attacks  : int32[n_rounds * n_lieu * n_lieu * slots * 3] — per
-//              (round-1, receiver, sender*slots+slot) triples
-//              (action, coin, rand_v), the sample_attack layout
+//   attacks  : int32[n_rounds * n_lieu * n_lieu * slots * 4] — per
+//              (round-1, receiver, sender*slots+slot) quads
+//              (action, coin, rand_v, late): the sample_attack layout
+//              plus the racy-delivery late-loss flag (late=1 -> the
+//              delivery is silently lost before any corruption, the
+//              barrier-race model of docs/DIVERGENCES.md D1; all 0 under
+//              delivery="sync")
 //   decisions_out : int32[n_parties] (index 0 = commander)
 //   vi_out   : uint8[n_lieu * w] accepted-set masks
 //   flags_out: int32[2] = {success, overflow}
@@ -242,7 +246,8 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
           const int32_t* a =
               attacks + (((rnd - 1) * n_lieu + recv) * n_lieu * slots +
                          sender * slots + slot) *
-                            3;
+                            4;
+          if (a[3]) continue;  // racy late loss (DIVERGENCES.md D1)
           if (!honest[sender + 2]) {  // tfg.py:271-284
             if (a[0] == 0 && a[1] == 0) continue;  // drop
             if (a[0] == 1) pk.v = a[2];            // corrupt v

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference's compute-dominant path is serial circuit simulation on an
+external native engine (``tfg.py:76-84``, SURVEY §3.2).  Here the dense
+validation engine gets a fused Pallas kernel: one kernel executes the
+*entire* circuit with the statevector resident in VMEM
+(:mod:`qba_tpu.ops.fused_circuit`), instead of one HBM round-trip per
+gate.
+"""
+
+from qba_tpu.ops.fused_circuit import build_fused_circuit_run
+
+__all__ = ["build_fused_circuit_run"]

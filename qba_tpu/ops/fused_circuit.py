@@ -1,0 +1,206 @@
+"""Fused whole-circuit statevector execution — one Pallas kernel per circuit.
+
+The dense validation engine (:mod:`qba_tpu.qsim.statevector`) applies one
+gate at a time; under XLA each gate is a statevector-sized HBM round-trip.
+This kernel executes the *entire* circuit in a single ``pallas_call`` with
+the state resident in VMEM — the TPU-native answer to the reference's
+serial per-gate native-engine calls (``tfg.py:76-80``, SURVEY §3.2).
+
+Design (see ``/opt/skills/guides/pallas_guide.md``):
+
+* **Layout** — the flat statevector (qubit 0 = the most significant index
+  bit, matching :mod:`qba_tpu.qsim.statevector`) is viewed as
+  ``[rows, lanes]`` with ``lanes = 2**min(n, 7)``: the last ``min(n, 7)``
+  qubits live in the 128-wide lane dimension, the rest in the sublane/row
+  dimension.
+* **Lane-qubit gates** (including lane-qubit controls) are ``L x L``
+  matmuls on the MXU: the controlled gate restricted to the lane subspace
+  is precomputed as a dense matrix, so ``state @ M.T`` applies it to every
+  row at once.
+* **Row-qubit gates** are sublane butterflies on the VPU: the partner
+  amplitude ``state[r ^ 2**rbs]`` is two static rolls selected by the
+  target bit; controls become iota bit-masks.
+* **Real arithmetic** — every gate the protocol circuits use (H, X/CNOT,
+  parameterized X**b; ``tfg.py:17-39``) is real-valued and the initial
+  state is |0..0>, so the state is ``float32``, not complex: half the
+  memory and FLOPs of the complex engine.
+* **Data-dependent encodings** — the reference rebuilds the Q-correlated
+  circuit per list position with fresh ``rands`` (``tfg.py:30-37``); here
+  the permutation bits arrive as an int32 param vector in SMEM, so ONE
+  compiled kernel serves every position and trial under ``vmap``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_INV_SQRT2 = float(1.0 / np.sqrt(2.0))
+
+_H2 = np.asarray([[1.0, 1.0], [1.0, -1.0]], dtype=np.float32) * np.float32(
+    _INV_SQRT2
+)
+_X2 = np.asarray([[0.0, 1.0], [1.0, 0.0]], dtype=np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class _LaneOp:
+    """Gate whose target sits in the lane dimension -> MXU matmul."""
+
+    mat_idx: int  # index into the stacked [K, L, L] matrices
+    param: int | None  # param index for X**b, None for fixed gates
+    row_ctrl_shifts: tuple[int, ...]  # row-qubit controls (iota bit tests)
+
+
+@dataclasses.dataclass(frozen=True)
+class _RowOp:
+    """Gate whose target sits in the row dimension -> sublane butterfly."""
+
+    kind: str  # "H" | "X" | "XPOW"
+    rbs: int  # target bit shift within the row index
+    param: int | None
+    row_ctrl_shifts: tuple[int, ...]
+    lane_ctrl_shifts: tuple[int, ...]
+
+
+def _lane_matrix(
+    gate2: np.ndarray, t_shift: int, ctrl_shifts: tuple[int, ...], lanes: int
+) -> np.ndarray:
+    """Dense ``[L, L]`` matrix of ``gate2`` on lane-bit ``t_shift``,
+    controlled on lane bits ``ctrl_shifts`` (identity elsewhere)."""
+    mat = np.zeros((lanes, lanes), dtype=np.float32)
+    for col in range(lanes):
+        if all((col >> c) & 1 for c in ctrl_shifts):
+            in_bit = (col >> t_shift) & 1
+            for out_bit in (0, 1):
+                row = (col & ~(1 << t_shift)) | (out_bit << t_shift)
+                mat[row, col] = gate2[out_bit, in_bit]
+        else:
+            mat[col, col] = 1.0
+    return mat
+
+
+def build_fused_circuit_run(
+    n_qubits: int, ops, n_params: int, *, interpret: bool = False
+):
+    """Compile a static op list into ``run(params) -> float32[2**n]``.
+
+    ``ops`` is a sequence of :class:`qba_tpu.qsim.circuit.Op`; the returned
+    function is jit/vmap-safe and returns the final (real) statevector.
+    """
+    lane_bits = min(n_qubits, 7)
+    lanes = 1 << lane_bits
+    n_rows = 1 << (n_qubits - lane_bits)
+
+    def bit_shift(q: int) -> tuple[bool, int]:
+        """(is_lane, shift): flat-index bit position of qubit ``q`` split
+        into the lane / row sub-index (qubit 0 = MSB of the flat index)."""
+        flat = n_qubits - 1 - q
+        if flat < lane_bits:
+            return True, flat
+        return False, flat - lane_bits
+
+    plan: list[_LaneOp | _RowOp] = []
+    mats0: list[np.ndarray] = []
+    mats_d: list[np.ndarray] = []
+    for op in ops:
+        t_lane, t_shift = bit_shift(op.target)
+        lane_cs = tuple(
+            s for c in op.controls for is_l, s in (bit_shift(c),) if is_l
+        )
+        row_cs = tuple(
+            s for c in op.controls for is_l, s in (bit_shift(c),) if not is_l
+        )
+        if t_lane:
+            gate2 = _H2 if op.kind == "H" else _X2
+            full = _lane_matrix(gate2, t_shift, lane_cs, lanes)
+            if op.kind == "XPOW":
+                mats0.append(np.eye(lanes, dtype=np.float32))
+                mats_d.append(full - np.eye(lanes, dtype=np.float32))
+            else:
+                mats0.append(full)
+                mats_d.append(np.zeros((lanes, lanes), dtype=np.float32))
+            plan.append(_LaneOp(len(mats0) - 1, op.param, row_cs))
+        else:
+            plan.append(_RowOp(op.kind, t_shift, op.param, row_cs, lane_cs))
+
+    # Stacked constants (>=1 entry so the kernel signature is static).
+    m0 = np.stack(mats0) if mats0 else np.eye(lanes, dtype=np.float32)[None]
+    md = np.stack(mats_d) if mats_d else np.zeros((1, lanes, lanes), np.float32)
+    n_params = max(n_params, 1)
+
+    def kernel(params_ref, m0_ref, md_ref, out_ref):
+        row_iota = jax.lax.broadcasted_iota(jnp.int32, (n_rows, lanes), 0)
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (n_rows, lanes), 1)
+
+        def ctrl_mask(row_cs, lane_cs):
+            mask = jnp.ones((n_rows, lanes), dtype=jnp.bool_)
+            for c in row_cs:
+                mask &= ((row_iota >> c) & 1) == 1
+            for c in lane_cs:
+                mask &= ((lane_iota >> c) & 1) == 1
+            return mask
+
+        # |0...0>
+        state = jnp.where(
+            (row_iota == 0) & (lane_iota == 0), 1.0, 0.0
+        ).astype(jnp.float32)
+
+        for op in plan:  # static unroll: the circuit IS the kernel
+            if isinstance(op, _LaneOp):
+                mat = m0_ref[op.mat_idx]
+                if op.param is not None:
+                    b = params_ref[op.param].astype(jnp.float32)
+                    mat = mat + b * md_ref[op.mat_idx]
+                new = jnp.dot(state, mat.T, preferred_element_type=jnp.float32)
+                if op.row_ctrl_shifts:
+                    state = jnp.where(ctrl_mask(op.row_ctrl_shifts, ()), new, state)
+                else:
+                    state = new
+            else:
+                stride = 1 << op.rbs
+                # partner[r] = state[r ^ stride]: two static rolls selected
+                # by the target bit (no dynamic gathers on TPU).
+                bit = ((row_iota >> op.rbs) & 1) == 1
+                up = jnp.concatenate([state[stride:], state[:stride]], axis=0)
+                down = jnp.concatenate([state[-stride:], state[:-stride]], axis=0)
+                partner = jnp.where(bit, down, up)
+                if op.kind == "H":
+                    new = jnp.where(bit, partner - state, state + partner) * _INV_SQRT2
+                elif op.kind == "X":
+                    new = partner
+                else:  # XPOW
+                    flip = params_ref[op.param] != 0
+                    new = jnp.where(flip, partner, state)
+                if op.row_ctrl_shifts or op.lane_ctrl_shifts:
+                    mask = ctrl_mask(op.row_ctrl_shifts, op.lane_ctrl_shifts)
+                    state = jnp.where(mask, new, state)
+                else:
+                    state = new
+
+        out_ref[:] = state
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_rows, lanes), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+
+    def run(params: jnp.ndarray | None = None) -> jnp.ndarray:
+        if params is None:
+            params = jnp.zeros((n_params,), dtype=jnp.int32)
+        params = jnp.asarray(params, dtype=jnp.int32)
+        return call(params, m0, md).reshape(-1)
+
+    return run

@@ -6,12 +6,13 @@ with a named `jax.sharding.Mesh`: trials over ``dp``, lieutenants over
 over ``sp``.
 """
 
-from qba_tpu.parallel.mesh import default_mesh_shape, make_mesh
+from qba_tpu.parallel.mesh import default_mesh_shape, make_hybrid_mesh, make_mesh
 from qba_tpu.parallel.montecarlo import run_trials_sharded
 from qba_tpu.parallel.spmd import run_trials_spmd
 
 __all__ = [
     "default_mesh_shape",
+    "make_hybrid_mesh",
     "make_mesh",
     "run_trials_sharded",
     "run_trials_spmd",

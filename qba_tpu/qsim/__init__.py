@@ -26,11 +26,23 @@ from qba_tpu.qsim.protocol_circuits import (
     q_correlated,
 )
 
+
+def generate_lists_for(cfg, key):
+    """Dispatch list generation on ``cfg.qsim_path`` — the single chooser
+    shared by all three protocol backends (jax / local / native), so the
+    key tree stays identical across them."""
+    if cfg.qsim_path == "factorized":
+        return generate_lists(cfg, key)
+    impl = "auto" if cfg.qsim_path == "dense_pallas" else "xla"
+    return generate_lists_dense(cfg, key, impl)
+
+
 __all__ = [
     "Circuit",
     "Gate",
     "generate_lists",
     "generate_lists_dense",
+    "generate_lists_for",
     "not_q_correlated",
     "q_correlated",
 ]

@@ -86,17 +86,40 @@ class Circuit:
         self.ops.extend(gate.ops)
         return self
 
-    def compile(self):
-        """Build ``run(key, params=None) -> int32 bits[n_qubits]``.
+    @property
+    def n_params(self) -> int:
+        return max((op.param + 1 for op in self.ops if op.param is not None), default=0)
 
-        The returned function is pure and jit/vmap-safe; measurement of
-        every qubit (the reference's per-qubit MEASURE ops,
-        ``tfg.py:49-51``) is one Born sample over the final state.
+    def compile_state(self, impl: str = "xla"):
+        """Build ``state(params=None) -> final flat statevector [2**n]``.
+
+        Contract shared by every impl: ``params=None`` means all-zero
+        params (every X**b acts as identity), and the result is the flat
+        amplitude vector in the same index order.  Dtypes differ — the
+        engines are deliberately distinct:
+
+        * ``"xla"`` — per-gate axis algebra, complex64.
+        * ``"pallas"`` — the fused single-kernel executor
+          (:func:`qba_tpu.ops.build_fused_circuit_run`), float32 (every
+          supported gate is real).
+        * ``"pallas_interpret"`` — same kernel in interpreter mode (runs
+          on any backend; used by the CPU test suite).
         """
         ops = tuple(self.ops)
         n = self.n_qubits
+        n_params = self.n_params
+        if impl in ("pallas", "pallas_interpret"):
+            from qba_tpu.ops import build_fused_circuit_run
 
-        def run(key: jax.Array, params: jnp.ndarray | None = None) -> jnp.ndarray:
+            return build_fused_circuit_run(
+                n, ops, n_params, interpret=impl == "pallas_interpret"
+            )
+        if impl != "xla":
+            raise ValueError(f"unknown circuit impl {impl!r}")
+
+        def state_fn(params: jnp.ndarray | None = None) -> jnp.ndarray:
+            if params is None:
+                params = jnp.zeros((max(n_params, 1),), dtype=jnp.int32)
             state = sv.init_state(n)
             for op in ops:
                 if op.kind == "XPOW":
@@ -107,6 +130,22 @@ class Circuit:
                     state = sv.apply_controlled_1q(state, mat, op.target, op.controls)
                 else:
                     state = sv.apply_1q(state, mat, op.target)
-            return sv.measure_all(state, key)
+            return state.reshape(-1)
+
+        return state_fn
+
+    def compile(self, impl: str = "xla"):
+        """Build ``run(key, params=None) -> int32 bits[n_qubits]``.
+
+        The returned function is pure and jit/vmap-safe; measurement of
+        every qubit (the reference's per-qubit MEASURE ops,
+        ``tfg.py:49-51``) is one Born sample over the final state.
+        """
+        n = self.n_qubits
+        state_fn = self.compile_state(impl)
+
+        def run(key: jax.Array, params: jnp.ndarray | None = None) -> jnp.ndarray:
+            state = state_fn(params)
+            return sv.measure_all(state.reshape((2,) * n), key)
 
         return run

@@ -74,17 +74,23 @@ def _perm_bits(perm: jnp.ndarray, n_qubits: int) -> jnp.ndarray:
     return ((perm[:, None] >> shifts) & 1).reshape(-1).astype(jnp.int32)
 
 
-def generate_lists_dense(cfg: QBAConfig, key: jax.Array):
+def generate_lists_dense(cfg: QBAConfig, key: jax.Array, impl: str = "xla"):
     """Dense-path ``generacionListas`` (``tfg.py:68-84``), one Born sample
     per list position, all positions batched with ``vmap``.
+
+    ``impl`` selects the circuit executor (:meth:`Circuit.compile`):
+    ``"xla"``, ``"pallas"``, ``"pallas_interpret"``, or ``"auto"`` (the
+    fused Pallas kernel on TPU, interpreter mode elsewhere).
 
     Returns ``(lists, qcorr)``: int32 ``[n_parties+1, size_l]`` decoded
     order values per party (row 0 = QSD extra copy, row 1 = commander),
     and the ground-truth Q-correlated position mask ``[size_l]``.
     """
     n, nq = cfg.n_parties, cfg.n_qubits
-    run_q = gen_q_corr_circuit(n, nq).compile()
-    run_nq = gen_nq_corr_circuit(n, nq).compile()
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "pallas_interpret"
+    run_q = gen_q_corr_circuit(n, nq).compile(impl)
+    run_nq = gen_nq_corr_circuit(n, nq).compile(impl)
 
     k_qcorr, k_perm, k_meas = jax.random.split(key, 3)
     qcorr = jax.random.bernoulli(k_qcorr, 0.5, (cfg.size_l,))

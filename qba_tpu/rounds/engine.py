@@ -24,11 +24,16 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from qba_tpu.adversary import assign_dishonest, commander_orders, corrupt_at_delivery
+from qba_tpu.adversary import (
+    assign_dishonest,
+    commander_orders,
+    corrupt_at_delivery,
+    late_drop,
+)
 from qba_tpu.config import QBAConfig
 from qba_tpu.core import append_own, consistent, decide_order, success_oracle
 from qba_tpu.core.types import SENTINEL, Evidence, Packet, empty_evidence
-from qba_tpu.qsim import generate_lists, generate_lists_dense
+from qba_tpu.qsim import generate_lists_for
 from qba_tpu.rounds.mailbox import Mailbox, empty_mailbox
 
 
@@ -100,7 +105,22 @@ def step3a_one(cfg: QBAConfig, p_row, v, li):
 
 def receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb, honest):
     """One lieutenant's inbox drain for one voting round
-    (``tfg.py:337-348`` + ``lieu_receive``, ``tfg.py:289-300``)."""
+    (``tfg.py:337-348`` + ``lieu_receive``, ``tfg.py:289-300``).
+
+    Fully vectorized: the reference drains its MPI queue packet by packet,
+    but the only *sequential* part of that drain is the accepted-set dedup
+    (``v not in Vi``, ``tfg.py:294``) and outgoing-slot allocation —
+    corruption, evidence append, and the consistency verdict are
+    per-packet independent.  So every packet is processed in parallel
+    (``vmap`` — XLA vectorizes across packets, receivers, and trials), and
+    the sequencing collapses to closed-form mask algebra in
+    (sender, slot) lexicographic packet order (docs/DIVERGENCES.md D5):
+
+    * dedup = first-occurrence-wins over a packet x packet value-match
+      matrix (identical verdicts to the serial drain: two packets only
+      interact when they carry the same ``v``);
+    * slot allocation = exclusive prefix count of rebroadcasts.
+    """
     n_s, slots = cfg.n_lieutenants, cfg.slots
     n_pk = n_s * slots
 
@@ -109,45 +129,76 @@ def receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb,
 
     vals_f, lens_f, count_f = flat(mb.vals), flat(mb.lens), flat(mb.count)
     p_f, v_f, sent_f = flat(mb.p_mask), flat(mb.v), flat(mb.sent)
+    idxs = jnp.arange(n_pk)
 
-    def body(carry, idx):
-        vi, counter, overflow, out = carry
+    def deliver(idx):
+        """Corrupt + append one mailbox cell (tfg.py:271-284,291)."""
         pk = Packet(
             p_mask=p_f[idx],
             v=v_f[idx],
             evidence=Evidence(vals=vals_f[idx], lens=lens_f[idx], count=count_f[idx]),
         )
         sender_idx = idx // slots
-        pk, delivered = corrupt_at_delivery(
-            cfg, jax.random.fold_in(key, idx), pk, honest[sender_idx + 2]
-        )
+        cell_key = jax.random.fold_in(key, idx)
+        pk, delivered = corrupt_at_delivery(cfg, cell_key, pk, honest[sender_idx + 2])
         delivered &= sent_f[idx] & (sender_idx != receiver_idx)
-
-        # Step 3 b i-ii (tfg.py:291-299)
+        delivered &= ~late_drop(cfg, cell_key)
         ev = append_own(pk.evidence, pk.p_mask, li)
-        acc = (
+        return pk, ev, delivered
+
+    def prep(idx):
+        """Per-packet verdict only (tfg.py:291-294) — scalars out, so the
+        [max_l, size_l] evidence stays a fused intermediate instead of a
+        materialized [n_pk, max_l, size_l] batch."""
+        pk, ev, delivered = deliver(idx)
+        ok = (
             delivered
             & consistent(pk.v, ev, cfg.w)
-            & ~vi[pk.v]
             & (ev.count == round_idx + 1)
         )
-        vi = vi.at[pk.v].set(vi[pk.v] | acc)
-        rebroadcast = acc & (round_idx <= cfg.n_dishonest)
-        can_write = counter < slots
-        out = _write_cell(
-            cfg, out, counter, rebroadcast & can_write, pk.p_mask, pk.v, ev
-        )
-        overflow |= rebroadcast & ~can_write
-        counter = counter + rebroadcast.astype(jnp.int32)
-        return (vi, counter, overflow, out), None
+        return pk.v, ok
 
-    init = (
-        vi_row,
-        jnp.zeros((), jnp.int32),
-        jnp.zeros((), bool),
-        _empty_out_cells(cfg),
+    v_all, ok_all = jax.vmap(prep)(idxs)
+
+    # Acceptance with first-occurrence-wins dedup against Vi (tfg.py:294).
+    cand = ok_all & ~vi_row[v_all]
+    same_v_before = (
+        (v_all[None, :] == v_all[:, None])
+        & cand[None, :]
+        & (idxs[None, :] < idxs[:, None])
     )
-    (vi_row, _, overflow, out), _ = jax.lax.scan(body, init, jnp.arange(n_pk))
+    acc = cand & ~jnp.any(same_v_before, axis=1)
+    vi_row = vi_row | jnp.any(
+        acc[:, None] & (v_all[:, None] == jnp.arange(cfg.w)[None, :]), axis=0
+    )
+
+    # Rebroadcast while round <= nDishonest (tfg.py:298-299); outgoing slot
+    # = exclusive prefix count, overflow recorded past the static bound.
+    rebroadcast = acc & (round_idx <= cfg.n_dishonest)
+    slot = jnp.cumsum(rebroadcast.astype(jnp.int32)) - rebroadcast
+    write = rebroadcast & (slot < slots)
+    overflow = jnp.any(rebroadcast & ~write)
+
+    # Scatter written packets into this sender's outgoing mailbox row.
+    # Slot assignment is injective, so each slot gathers from at most one
+    # packet; the <= slots written packets are re-delivered (same fold_in
+    # key -> identical corruption) so only [slots, max_l, size_l] — not
+    # [n_pk, ...] — is ever materialized.
+    hit = write[None, :] & (slot[None, :] == jnp.arange(slots)[:, None])
+    has = jnp.any(hit, axis=1)  # bool[slots]
+    src = jnp.argmax(hit, axis=1)  # packet index feeding each slot
+
+    def rebuild(idx, valid):
+        pk, ev, _ = deliver(idx)
+        return (
+            jnp.where(valid, ev.vals, SENTINEL),
+            jnp.where(valid, ev.lens, 0),
+            jnp.where(valid, ev.count, 0),
+            jnp.where(valid, pk.p_mask, False),
+            jnp.where(valid, pk.v, 0),
+        )
+
+    out = (*jax.vmap(rebuild)(src, has), has)
     return vi_row, out, overflow
 
 
@@ -162,8 +213,7 @@ def setup_trial(cfg: QBAConfig, key: jax.Array, hints: PartitionHints | None = N
     """
     k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
     honest = assign_dishonest(cfg, k_dis)
-    gen = generate_lists if cfg.qsim_path == "factorized" else generate_lists_dense
-    lists, _qcorr = gen(cfg, k_lists)
+    lists, _qcorr = generate_lists_for(cfg, k_lists)
     if hints is not None and hints.lists is not None:
         lists = jax.lax.with_sharding_constraint(lists, hints.lists)
 

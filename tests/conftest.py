@@ -21,3 +21,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the test suite is dominated by XLA compiles
+# (single-CPU CI box); caching them across runs cuts the suite from ~10 min
+# to well under one.  Repo-local (gitignored) so the cache is per-checkout,
+# not a shared /tmp path another user could own or poison.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".cache", "jax")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

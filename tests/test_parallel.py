@@ -114,3 +114,25 @@ class TestMeshHelpers:
         assert default_mesh_shape(8, want_tp=True) == {"dp": 4, "tp": 2}
         shape = default_mesh_shape(1)
         assert shape["dp"] == 1
+
+    def test_hybrid_mesh_single_slice_fallback(self, n_devices):
+        from qba_tpu.parallel import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh({"dp": n_devices // 2, "tp": 2})
+        assert mesh.axis_names == ("dp", "tp")
+        assert mesh.devices.shape == (n_devices // 2, 2)
+
+    def test_hybrid_mesh_explicit_slices(self, n_devices):
+        import pytest
+
+        from qba_tpu.parallel import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh({"dp": n_devices // 4, "tp": 2}, n_slices=2)
+        assert mesh.axis_names == ("dp", "tp")
+        assert mesh.devices.shape == (n_devices // 2, 2)
+        # Each slice's block stays contiguous along the non-dcn axis.
+        assert len(set(d.id for d in mesh.devices.flat)) == n_devices
+        with pytest.raises(ValueError, match="dcn_axis"):
+            make_hybrid_mesh({"tp": n_devices}, dcn_axis="dp")
+        with pytest.raises(ValueError, match="devices"):
+            make_hybrid_mesh({"dp": n_devices}, n_slices=3)
