@@ -37,9 +37,14 @@ def generate_lists_for(cfg, key):
     return generate_lists_dense(cfg, key, impl)
 
 
+from qba_tpu.qsim.compat import Drewom, QCircuit, QGate
+
 __all__ = [
     "Circuit",
+    "Drewom",
     "Gate",
+    "QCircuit",
+    "QGate",
     "generate_lists",
     "generate_lists_dense",
     "generate_lists_for",

@@ -1,0 +1,117 @@
+"""qsimov-shaped compat API (qba_tpu.qsim.compat).
+
+Builds the protocol's circuits through the reference's call shapes
+(``QGate(size, 0, name)`` / ``QCircuit`` / ``MEASURE`` with outputs /
+``Drewom().execute(circ)[0]``, ``tfg.py:17-80``) and checks the §2.6
+closed-form output properties on the results.
+"""
+
+import numpy as np
+import pytest
+
+from qba_tpu.qsim import Drewom, QCircuit, QGate
+
+
+def build_nq_circuit(n_parties: int, n_qubits: int) -> QCircuit:
+    """Reference-style construction of the not-Q-correlated circuit
+    (H on every qubit of groups 1..n, then CNOT copying group 1 onto
+    group 0; tfg.py:15-22,56-65) via the compat API."""
+    size = (n_parties + 1) * n_qubits
+    g = QGate(size, 0, "notQCorr")
+    for q in range(n_qubits, size):
+        g.add_operation("H", targets=q)
+    for b in range(n_qubits):
+        g.add_operation("X", targets=b, controls=n_qubits + b)
+    c = QCircuit(size, size, "NQCorrCircuit")
+    c.add_operation(g)
+    for i in range(size):
+        c.add_operation("MEASURE", targets=i, outputs=i)
+    return c
+
+
+def group_values(bits, n_parties: int, n_qubits: int) -> list[int]:
+    """Decode each party group's bits (big-endian) into an int."""
+    vals = []
+    for p in range(n_parties + 1):
+        v = 0
+        for b in bits[p * n_qubits:(p + 1) * n_qubits]:
+            v = (v << 1) | b
+        vals.append(v)
+    return vals
+
+
+class TestCompatAPI:
+    def test_nq_circuit_closed_form(self):
+        # Not-Q-correlated: group 0 == group 1 in every shot (§2.6).
+        n_parties, n_qubits = 3, 2
+        circ = build_nq_circuit(n_parties, n_qubits)
+        shots = Drewom(seed=1).execute(circ, shots=16)
+        assert len(shots) == 16
+        groups = [group_values(s, n_parties, n_qubits) for s in shots]
+        assert all(g[0] == g[1] for g in groups)
+        # Other groups are i.i.d. uniform; 16 shots of 3 values in [0,4)
+        # are essentially never all identical.
+        assert len({tuple(g) for g in groups}) > 1
+
+    def test_q_circuit_closed_form(self):
+        # Q-correlated with a fixed permutation: H on group 0, X-encode
+        # perm[i-1] into group i, CNOT group 0 onto all (tfg.py:25-40).
+        n_parties, n_qubits = 3, 2
+        size = (n_parties + 1) * n_qubits
+        perm = [2, 3, 1]
+        g = QGate(size, 0, "qCorr")
+        for b in range(n_qubits):
+            g.add_operation("H", targets=b)
+        for i in range(1, n_parties + 1):
+            for b in range(n_qubits):
+                if (perm[i - 1] >> (n_qubits - 1 - b)) & 1:
+                    g.add_operation("X", targets=i * n_qubits + b)
+        for i in range(1, n_parties + 1):
+            for b in range(n_qubits):
+                g.add_operation("X", targets=i * n_qubits + b, controls=b)
+        circ = QCircuit(size, size, "QCorrCircuit")
+        circ.add_operation(g)
+        for i in range(size):
+            circ.add_operation("MEASURE", targets=i, outputs=i)
+
+        for bits in Drewom(seed=2).execute(circ, shots=8):
+            vals = group_values(bits, n_parties, n_qubits)
+            # group i = r XOR perm[i-1]: all four values pairwise distinct.
+            assert len(set(vals)) == n_parties + 1
+            r = vals[0]
+            assert vals[1:] == [r ^ p for p in perm]
+
+    def test_measure_subset_and_output_order(self):
+        c = QCircuit(2, 2, "sub")
+        c.add_operation("X", targets=1)
+        c.add_operation("MEASURE", targets=1, outputs=0)
+        [bits] = Drewom().execute(c)
+        assert bits == [1]
+
+    def test_program_cache_reused(self):
+        d = Drewom(seed=0)
+        c = build_nq_circuit(2, 1)
+        d.execute(c, shots=2)
+        d.execute(build_nq_circuit(2, 1), shots=2)
+        assert len(d._programs) == 1
+
+    def test_rng_advances_between_calls(self):
+        # Stateful executor RNG: repeated executes draw fresh samples.
+        d = Drewom(seed=3)
+        c = build_nq_circuit(3, 2)
+        seen = {tuple(d.execute(c)[0]) for _ in range(12)}
+        assert len(seen) > 1
+
+    def test_api_validation(self):
+        with pytest.raises(ValueError):
+            QGate(4, 1)  # ancilla unsupported
+        c = QCircuit(2)
+        with pytest.raises(ValueError):
+            c.add_operation("MEASURE")  # no targets
+        c.add_operation("MEASURE", targets=0, outputs=0)
+        with pytest.raises(ValueError):
+            c.add_operation("MEASURE", targets=1, outputs=0)  # slot reuse
+        with pytest.raises(ValueError, match="after MEASURE"):
+            c.add_operation("X", targets=1)  # mid-circuit measurement
+        with pytest.raises(TypeError):
+            Drewom().execute("not a circuit")

@@ -110,3 +110,15 @@ class TestSlotBound:
         # protocol still completes; overflow flag is a recorded diagnostic
         assert r.success.shape == (32,)
         assert r.overflow.dtype == jnp.bool_
+
+
+class TestLargeScale:
+    def test_33_parties_all_honest_unanimous(self):
+        # The 48-qubit-class scale (nQubits=6, w=64) where the reference's
+        # dense joint circuit is infeasible; the factorized sampler
+        # (SURVEY §2.6) makes it routine.  All honest -> validity: every
+        # party decides the commander's order.
+        cfg = QBAConfig(n_parties=33, size_l=16, n_dishonest=0)
+        r = batch(cfg, 11, 4)
+        assert float(jnp.mean(r.success.astype(jnp.float32))) == 1.0
+        assert bool(jnp.all(r.decisions == r.v_comm[:, None]))
