@@ -27,16 +27,15 @@ def _measure_jax(cfg, reps: int = 3) -> float:
     """
     import jax
 
-    from qba_tpu.backends.jax_backend import run_trials, trial_keys
+    from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
 
-    jax.block_until_ready(run_trials(cfg, trial_keys(cfg)).trials)  # compile
+    fence(run_trials(cfg, trial_keys(cfg)))  # compile
     best = float("inf")
     for r in range(reps):
         keys = jax.random.split(jax.random.key(cfg.seed + 1 + r), cfg.trials)
-        keys.block_until_ready()
+        fence(keys)  # key generation off the clock
         t0 = time.perf_counter()
-        res = run_trials(cfg, keys)
-        jax.block_until_ready(res.trials)
+        fence(run_trials(cfg, keys))
         best = min(best, time.perf_counter() - t0)
     return best
 

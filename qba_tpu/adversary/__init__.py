@@ -12,14 +12,12 @@ from qba_tpu.adversary.model import (
     assign_dishonest,
     commander_orders,
     corrupt_at_delivery,
-    late_drop,
-    sample_attack,
+    sample_attacks_round,
 )
 
 __all__ = [
     "assign_dishonest",
     "commander_orders",
     "corrupt_at_delivery",
-    "late_drop",
-    "sample_attack",
+    "sample_attacks_round",
 ]

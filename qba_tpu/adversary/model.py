@@ -65,55 +65,71 @@ def commander_orders(
     return v_sent, v
 
 
-def sample_attack(cfg: QBAConfig, key: jax.Array):
-    """Draw one (action, coin, rand_v) attack triple.
+# Tags folded into the per-round key, one per attack variable — each
+# variable is ONE batched draw over every (receiver, cell) of the round.
+# Per-cell key derivation (fold_in per cell, then per draw) costs a full
+# threefry chain per cell and dominated the whole round loop on TPU
+# (~450 ms/round at 1000 trials); batched counter-mode draws are ~free.
+_ACTION_TAG = 0x0AC7
+_COIN_TAG = 0x0C01
+_RANDV_TAG = 0x0BAD
+_LATE_TAG = 0x17A7E
 
-    Shared by the vectorized engine and the local differential backend so
-    both consume identical randomness for a given key (the key is derived
-    from (trial, round, receiver, cell) — there is no sequential stream to
-    misalign).
+
+def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
+    """Draw the whole round's attack randomness in four batched calls.
+
+    Returns ``(action, coin, rand_v, late)``, each
+    ``[n_lieutenants, n_lieutenants * slots]`` indexed by
+    ``(receiver, sender * slots + slot)``:
+
+    * ``action`` — uniform in ``{0..3}``: the 4-way dishonest choice
+      (``tfg.py:272``).
+    * ``coin`` — uniform in ``{0,1}``: the drop coin for action 0
+      (``tfg.py:274``).
+    * ``rand_v`` — uniform in ``[0, nParties+1)``: the forged order for
+      action 1 (``tfg.py:277`` — the reference's range, *not* ``[0,w)``).
+    * ``late`` — the racy-delivery loss flag (docs/DIVERGENCES.md D1);
+      all-False under ``delivery="sync"`` so sync and racy-with-p_late=0
+      runs are bit-identical.
+
+    All three protocol backends (jax / local / native) consume exactly
+    these arrays, so their randomness matches bit for bit.
     """
-    k_action, k_coin, k_v = jax.random.split(key, 3)
-    action = jax.random.randint(k_action, (), 0, 4)
-    coin = jax.random.randint(k_coin, (), 0, 2)
-    rand_v = jax.random.randint(k_v, (), 0, cfg.n_parties + 1, dtype=jnp.int32)
-    return action, coin, rand_v
-
-
-_LATE_TAG = 0x17A7E  # disjoint from round/receiver/cell fold_in indices
-
-
-def late_drop(cfg: QBAConfig, cell_key: jax.Array) -> jnp.ndarray:
-    """Race-class modeling (docs/DIVERGENCES.md D1).
-
-    The reference's ``comm.Barrier`` does not flush point-to-point traffic,
-    so a packet can miss its round's ``Iprobe`` drain (``tfg.py:341``) and
-    arrive one round late, where ``len(L) == round+1`` (``tfg.py:294``)
-    silently discards it — lateness IS loss.  With ``delivery="racy"``
-    each (packet, receiver) delivery independently suffers that fate with
-    probability ``p_late``; ``delivery="sync"`` (default) is the race-free
-    idealization.  Keyed off the cell key with a disjoint tag, so sync and
-    racy-with-p_late=0 runs are bit-identical.
-    """
-    if cfg.delivery != "racy":
-        return jnp.asarray(False)
-    return jax.random.bernoulli(
-        jax.random.fold_in(cell_key, _LATE_TAG), cfg.p_late
+    shape = (cfg.n_lieutenants, cfg.n_lieutenants * cfg.slots)
+    action = jax.random.randint(
+        jax.random.fold_in(k_round, _ACTION_TAG), shape, 0, 4
     )
+    coin = jax.random.randint(
+        jax.random.fold_in(k_round, _COIN_TAG), shape, 0, 2
+    )
+    rand_v = jax.random.randint(
+        jax.random.fold_in(k_round, _RANDV_TAG), shape, 0,
+        cfg.n_parties + 1, dtype=jnp.int32,
+    )
+    if cfg.delivery == "racy":
+        late = jax.random.bernoulli(
+            jax.random.fold_in(k_round, _LATE_TAG), cfg.p_late, shape
+        )
+    else:
+        late = jnp.zeros(shape, dtype=bool)
+    return action, coin, rand_v, late
 
 
 def corrupt_at_delivery(
     cfg: QBAConfig,
-    key: jax.Array,
+    draws: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
     packet: Packet,
     sender_honest: jnp.ndarray,
 ) -> tuple[Packet, jnp.ndarray]:
-    """Apply the 4-action attack to one delivered packet.
+    """Apply the 4-action attack to one delivered packet, consuming this
+    cell's ``(action, coin, rand_v)`` scalars from
+    :func:`sample_attacks_round`.
 
     Returns ``(packet', delivered)``; no-op (and always delivered) when the
     sender is honest.
     """
-    action, coin, rand_v = sample_attack(cfg, key)
+    action, coin, rand_v = draws
     biz = ~sender_honest
 
     # Action 0: drop with probability 1/2 (tfg.py:274).

@@ -60,3 +60,16 @@ def run_trials(cfg: QBAConfig, keys: jax.Array | None = None) -> MonteCarloResul
     if keys is None:
         keys = trial_keys(cfg)
     return aggregate(batched_trials(cfg, keys))
+
+
+def fence(res):
+    """Synchronization fence for wall-clock timing.
+
+    ``jax.block_until_ready`` is NOT a fence on remote-tunnel backends
+    (axon): it returns after async dispatch, before the computation runs,
+    so timings "measure" only the enqueue (observed: identical sub-ms
+    times for any batch size).  Fetching one result to the host is the
+    only reliable barrier.  Returns ``res`` unchanged.
+    """
+    jax.device_get(jax.tree.leaves(res)[0])
+    return res

@@ -23,8 +23,7 @@ import numpy as np
 from qba_tpu.adversary import (
     assign_dishonest,
     commander_orders,
-    late_drop,
-    sample_attack,
+    sample_attacks_round,
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.qsim import generate_lists_for
@@ -79,22 +78,28 @@ def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
             vi[i].add(v)
             mailbox[i].append((p, v, ell))
 
-    # Step 3b (tfg.py:337-348): synchronous rounds.
+    # Step 3b (tfg.py:337-348): synchronous rounds.  Attack randomness is
+    # the same batched per-round arrays the jax engine draws, indexed per
+    # cell — the bit-exact three-way contract.
     for rnd in range(1, cfg.n_rounds + 1):
         k_round = jax.random.fold_in(k_rounds, rnd)
+        a_act, a_coin, a_rv, a_late = (
+            np.asarray(x) for x in sample_attacks_round(cfg, k_round)
+        )
         out: list[list] = [[] for _ in range(n_lieu)]
         for recv in range(n_lieu):
-            k_recv = jax.random.fold_in(k_round, recv)
             for sender in range(n_lieu):
                 for slot in range(min(slots, len(mailbox[sender]))):
                     if sender == recv:
                         continue
                     p, v, ell = mailbox[sender][slot]
-                    k_cell = jax.random.fold_in(k_recv, sender * slots + slot)
-                    if bool(late_drop(cfg, k_cell)):  # D1 race modeling
+                    cell = sender * slots + slot
+                    if bool(a_late[recv, cell]):  # D1 race modeling
                         continue
                     action, coin, rand_v = (
-                        int(x) for x in sample_attack(cfg, k_cell)
+                        int(a_act[recv, cell]),
+                        int(a_coin[recv, cell]),
+                        int(a_rv[recv, cell]),
                     )
                     p2, v2, ell2 = set(p), v, set(ell)
                     if not honest[sender + 2]:  # tfg.py:271-284

@@ -25,8 +25,7 @@ import numpy as np
 from qba_tpu.adversary import (
     assign_dishonest,
     commander_orders,
-    late_drop,
-    sample_attack,
+    sample_attacks_round,
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.native import load
@@ -49,26 +48,16 @@ def _u8(a: np.ndarray):
 @functools.partial(jax.jit, static_argnums=0)
 def _attack_quads(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
     """int32[n_rounds, n_lieu, n_lieu*slots, 4] — the (action, coin,
-    rand_v, late) draw for every delivery cell, with the shared key
-    derivation (round -> receiver -> cell, matching the local backend's
-    fold_in chain).  ``late`` is the racy-delivery loss flag
-    (docs/DIVERGENCES.md D1), all-zero under ``delivery="sync"``."""
-    rounds = jnp.arange(1, cfg.n_rounds + 1)
-    recvs = jnp.arange(cfg.n_lieutenants)
-    cells = jnp.arange(cfg.n_lieutenants * cfg.slots)
+    rand_v, late) draws for every delivery cell: the same batched
+    per-round arrays of :func:`sample_attacks_round` the other two
+    backends consume (bit-exact three-way contract).  ``late`` is the
+    racy-delivery loss flag (docs/DIVERGENCES.md D1), all-zero under
+    ``delivery="sync"``."""
+    def one_round(r):
+        draws = sample_attacks_round(cfg, jax.random.fold_in(k_rounds, r))
+        return jnp.stack([d.astype(jnp.int32) for d in draws], axis=-1)
 
-    def one(r, recv, cell):
-        k = jax.random.fold_in(
-            jax.random.fold_in(jax.random.fold_in(k_rounds, r), recv), cell
-        )
-        draws = (*sample_attack(cfg, k), late_drop(cfg, k))
-        return jnp.stack([x.astype(jnp.int32) for x in draws])
-
-    f = jax.vmap(
-        jax.vmap(jax.vmap(one, in_axes=(None, None, 0)), in_axes=(None, 0, None)),
-        in_axes=(0, None, None),
-    )
-    return f(rounds, recvs, cells)
+    return jax.vmap(one_round)(jnp.arange(1, cfg.n_rounds + 1))
 
 
 def run_trial_native(cfg: QBAConfig, key: jax.Array) -> dict:

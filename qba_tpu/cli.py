@@ -47,6 +47,11 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
         "only; dense_pallas = same on the fused Pallas kernel)",
     )
     p.add_argument(
+        "--round-engine", choices=("auto", "xla", "pallas"), default="auto",
+        help="voting-round engine: auto = fused Pallas kernel on TPU, "
+        "pure XLA elsewhere (both bit-identical)",
+    )
+    p.add_argument(
         "--delivery", choices=("sync", "racy"), default="sync",
         help="racy = model the reference's barrier race as per-delivery "
         "loss with prob --p-late (docs/DIVERGENCES.md D1)",
@@ -62,6 +67,7 @@ def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
         trials=trials if trials is not None else args.trials,
         seed=args.seed,
         qsim_path=args.qsim_path,
+        round_engine=args.round_engine,
         delivery=args.delivery,
         p_late=args.p_late,
     )
@@ -176,10 +182,10 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
                         print(render_verdict(cfg, trial, index=i), file=out)
             success_rate = successes / cfg.trials
         else:
-            from qba_tpu.backends.jax_backend import run_trials, trial_keys
+            from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
 
             with timers.time("trials"):
-                res = jax.block_until_ready(run_trials(cfg, trial_keys(cfg)))
+                res = fence(run_trials(cfg, trial_keys(cfg)))
             for i in range(min(cfg.trials, args.max_verdicts)):
                 one = jax.tree.map(lambda x: np.asarray(x)[i], res.trials)
                 print(render_verdict(cfg, one, index=i), file=out)
@@ -202,18 +208,18 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
 
     import jax
 
-    from qba_tpu.backends.jax_backend import run_trials, trial_keys
+    from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
     from qba_tpu.obs import profile_trace, throughput
 
     cfg = _config(args)
-    jax.block_until_ready(run_trials(cfg, trial_keys(cfg)).trials)  # compile
+    fence(run_trials(cfg, trial_keys(cfg)))  # compile
     best = float("inf")
     with profile_trace(args.profile_dir):
         for rep in range(args.reps):
             keys = jax.random.split(jax.random.key(cfg.seed + 1 + rep), cfg.trials)
-            keys.block_until_ready()
+            fence(keys)  # key generation off the clock
             t0 = time.perf_counter()
-            jax.block_until_ready(run_trials(cfg, keys).trials)
+            fence(run_trials(cfg, keys))
             best = min(best, time.perf_counter() - t0)
     th = throughput(cfg, cfg.trials, best)
     print(

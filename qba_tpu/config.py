@@ -40,6 +40,10 @@ class QBAConfig:
         round). A lieutenant accepts each order value at most once
         (``v not in Vi``, ``tfg.py:294``), so ``w`` is a universal bound;
         smaller values trade memory for a recorded overflow flag.
+      round_engine: "auto" (default — the fused Pallas round kernel on
+        TPU, pure XLA elsewhere), "xla", or "pallas" (forces the kernel;
+        interpreter mode off-TPU).  Both engines are bit-identical
+        (tests/test_round_kernel.py).
       delivery: "sync" (race-free idealization, default) or "racy" —
         model the reference's barrier race (a packet missing its round's
         ``Iprobe`` drain is silently lost, ``tfg.py:294,341``) as an
@@ -57,6 +61,7 @@ class QBAConfig:
     max_accepts_per_round: int | None = None
     delivery: str = "sync"
     p_late: float = 0.0
+    round_engine: str = "auto"
 
     def __post_init__(self) -> None:
         if self.n_parties < 2:
@@ -84,6 +89,8 @@ class QBAConfig:
             raise ValueError("p_late must be in [0, 1]")
         if self.p_late > 0.0 and self.delivery != "racy":
             raise ValueError("p_late > 0 requires delivery='racy'")
+        if self.round_engine not in ("auto", "xla", "pallas"):
+            raise ValueError(f"unknown round_engine {self.round_engine!r}")
 
     # Derived parameters (``tfg.py:316-318``).
     @property

@@ -8,7 +8,12 @@ jit/vmap/shard_map friendly.
 """
 
 from qba_tpu.core.types import Evidence, Packet, empty_evidence, empty_packet
-from qba_tpu.core.consistent import consistent, append_own, compact_tuple
+from qba_tpu.core.consistent import (
+    append_own,
+    consistent,
+    consistent_after_append,
+    sublist_row,
+)
 from qba_tpu.core.decode import measure_to_ints
 from qba_tpu.core.decide import decide_order, success_oracle
 
@@ -18,8 +23,9 @@ __all__ = [
     "empty_evidence",
     "empty_packet",
     "consistent",
+    "consistent_after_append",
     "append_own",
-    "compact_tuple",
+    "sublist_row",
     "measure_to_ints",
     "decide_order",
     "success_oracle",

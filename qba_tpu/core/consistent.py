@@ -2,7 +2,7 @@
 
 Re-designs ``consistent(v, L, w)`` (``tfg.py:87-98``) and the
 ``L.add(tuple(Li[j] for j in P))`` append (``tfg.py:189,291``) over the
-compacted tuple-ordered :class:`~qba_tpu.core.types.Evidence` layout:
+position-expanded :class:`~qba_tpu.core.types.Evidence` layout:
 
 Condition 1 — all tuples in L have the same length (``tfg.py:88-92``):
   recorded per-row lengths agree over valid rows.
@@ -10,9 +10,10 @@ Condition 2 — every element is in ``[0, w] \\ {v}`` (``tfg.py:93-94``; the
   reference's ``x <= w`` off-by-one is preserved — protocol values are < w
   anyway): no in-tuple entry equals v, exceeds w, or is negative.
 Condition 3 — every pair of tuples differs at every index (``tfg.py:96-98``):
-  no pair of valid rows agrees at any jointly-in-range tuple index.  Because
-  rows are compacted in tuple order, this is elementwise comparison — the
-  exact reference semantics, for any combination of P masks.
+  no pair of valid rows agrees at any jointly-populated list position.
+  Equal-length rows in a protocol-reachable L always share the same P
+  (docs/DIVERGENCES.md D10), so position-wise comparison is exactly the
+  reference's tuple-index comparison.
 """
 
 from __future__ import annotations
@@ -51,19 +52,21 @@ def consistent(v: jnp.ndarray, ev: Evidence, w: int) -> jnp.ndarray:
     return cond1 & cond2 & cond3
 
 
-def compact_tuple(p_mask: jnp.ndarray, li: jnp.ndarray) -> jnp.ndarray:
-    """``tuple(Li[j] for j in P)`` as a SENTINEL-padded row: the values of
-    ``li`` at True positions of ``p_mask``, left-justified in ascending
-    position order.  The reference iterates the int-set ``P`` in CPython
-    hash-table order, which need not be sorted; any single ordering shared
-    by all rows yields identical ``consistent`` verdicts, and sorted order
-    is the one we fix (docs/DIVERGENCES.md D10)."""
-    size_l = p_mask.shape[0]
-    # Stable argsort puts selected positions first, preserving position order.
-    order = jnp.argsort(~p_mask, stable=True)
-    n_sel = jnp.sum(p_mask.astype(jnp.int32))
-    gathered = li[order].astype(jnp.int32)
-    return jnp.where(jnp.arange(size_l) < n_sel, gathered, SENTINEL)
+def sublist_row(p_mask: jnp.ndarray, li: jnp.ndarray) -> jnp.ndarray:
+    """``tuple(Li[j] for j in P)`` stored *position-expanded*: ``li``'s
+    value at each True position of ``p_mask``, SENTINEL elsewhere.
+
+    A pure elementwise select — no sort, no gather (both are serial-slow
+    on the TPU VPU; a left-justified compaction here cost ~10x the whole
+    round loop).  Comparing rows at shared non-SENTINEL positions is
+    exactly the reference's compare-by-tuple-index (``tfg.py:96-98``)
+    whenever the rows were built from the same ``P`` — and every
+    protocol-reachable evidence set has that property, because the only
+    attack that mutates ``P`` (clear-P, ``tfg.py:281``) changes the tuple
+    length to 0, which the length condition already rejects against
+    non-empty rows.  See docs/DIVERGENCES.md D10 for the full argument.
+    """
+    return jnp.where(p_mask, li.astype(jnp.int32), SENTINEL)
 
 
 def append_own(ev: Evidence, p_mask: jnp.ndarray, li: jnp.ndarray) -> Evidence:
@@ -71,7 +74,7 @@ def append_own(ev: Evidence, p_mask: jnp.ndarray, li: jnp.ndarray) -> Evidence:
     (``tfg.py:189,291``) with set semantics (no-op if an identical row
     exists)."""
     max_l = ev.vals.shape[0]
-    own_vals = compact_tuple(p_mask, li)
+    own_vals = sublist_row(p_mask, li)
     own_len = jnp.sum(p_mask.astype(jnp.int32))
 
     valid = jnp.arange(max_l) < ev.count
@@ -87,3 +90,81 @@ def append_own(ev: Evidence, p_mask: jnp.ndarray, li: jnp.ndarray) -> Evidence:
     new_lens = jnp.where(write, own_len, ev.lens)
     new_count = jnp.where(dup, ev.count, jnp.minimum(ev.count + 1, max_l))
     return Evidence(vals=new_vals, lens=new_lens, count=new_count)
+
+
+def consistent_after_append(
+    v: jnp.ndarray,
+    ev: Evidence,
+    p_mask: jnp.ndarray,
+    li: jnp.ndarray,
+    w: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``(consistent(v, L'), |L'|)`` for ``L' = append_own(ev, p_mask, li)``
+    — without materializing ``L'``.
+
+    Executable specification of the verdict algebra the round engines
+    inline in batched form (:mod:`qba_tpu.rounds.engine` for XLA,
+    :mod:`qba_tpu.ops.round_kernel` for the Pallas kernel): materializing
+    the appended evidence per (receiver, packet) costs a
+    ``[trials, receivers, packets, max_l, size_l]`` tensor (~2 GB/round
+    at the headline config), so both engines compute these conditions
+    from the un-appended cell evidence plus the would-be own row.  This
+    function is the single-packet reference the property tests check the
+    composition against (tests/test_core.py); it is not on the hot path.
+
+    Decomposition — the own row's conditions apply only when it actually
+    enters ``L'``, i.e. it is not a set-duplicate (then ``L'`` equals the
+    cell rows, whose checks subsume the own row's) and the evidence is
+    not already full (``append_own`` drops the row then):
+
+    * cond1 — valid cell rows share one length, and (if appended) the
+      own row's length matches it.
+    * cond2 — no valid cell row (nor, if appended, the own row) touches
+      ``{v}`` or leaves ``[0, w]``.
+    * cond3 — no valid cell pair collides, and (if appended) the own row
+      collides with no valid cell row.
+    """
+    max_l = ev.vals.shape[0]
+    valid = jnp.arange(max_l) < ev.count  # bool[max_l]
+    in_tuple = ev.vals != SENTINEL  # bool[max_l, size_l]
+
+    own = sublist_row(p_mask, li)  # [size_l]
+    own_len = jnp.sum(p_mask.astype(jnp.int32))
+
+    dup = jnp.any(valid & jnp.all(ev.vals == own[None, :], axis=-1))
+    appended = ~dup & (ev.count < max_l)
+    new_count = jnp.where(appended, ev.count + 1, ev.count)
+
+    # Cond 1 (tfg.py:88-92).
+    cell_lens_ok = jnp.all(jnp.where(valid, ev.lens == ev.lens[0], True))
+    own_len_ok = ~appended | (ev.count == 0) | (own_len == ev.lens[0])
+    cond1 = cell_lens_ok & own_len_ok
+
+    # Cond 2 (tfg.py:93-94; the reference's `<= w` off-by-one preserved).
+    bad_cell = jnp.any(
+        in_tuple
+        & ((ev.vals == v) | (ev.vals > w) | (ev.vals < 0))
+        & valid[:, None]
+    )
+    bad_own = appended & jnp.any(p_mask & ((own == v) | (own > w) | (own < 0)))
+    cond2 = ~(bad_cell | bad_own)
+
+    # Cond 3 (tfg.py:96-98) over jointly-populated positions.
+    eq = (
+        (ev.vals[:, None, :] == ev.vals[None, :, :])
+        & in_tuple[:, None, :]
+        & in_tuple[None, :, :]
+    )
+    collide = jnp.any(eq, axis=-1)
+    pair = valid[:, None] & valid[None, :] & (
+        jnp.arange(max_l)[:, None] < jnp.arange(max_l)[None, :]
+    )
+    cells_ok = ~jnp.any(collide & pair)
+    own_hits = jnp.any(
+        p_mask[None, :] & in_tuple & (ev.vals == own[None, :]) & valid[:, None],
+        axis=-1,
+    )
+    own_ok = ~appended | ~jnp.any(own_hits)
+    cond3 = cells_ok & own_ok
+
+    return cond1 & cond2 & cond3, new_count

@@ -8,14 +8,16 @@ backend"):
 * ``P``  -> bool mask ``[size_l]``
 * ``v``  -> int32 scalar
 * ``L``  -> an :class:`Evidence` matrix: up to ``max_l`` rows, each holding
-  one tuple **compacted in tuple order** — row ``i``'s entry ``t`` is the
-  ``t``-th element of that tuple, with sentinel ``-1`` past the tuple's
-  length.  This mirrors the reference's tuples exactly: condition 3 of
-  ``consistent`` compares elements *by tuple index* (``tfg.py:96-98``), and
-  tuple equality (the ``set`` dedup of ``tfg.py:189,291``) is elementwise
-  equality in this layout.  Per-row lengths are stored explicitly so the
-  length condition (``tfg.py:88-92``) survives the clear-P attack
-  (``tfg.py:281``).
+  one tuple **position-expanded** — row ``i``'s entry at list position
+  ``j`` is that tuple's value drawn from position ``j`` (i.e. ``Li[j]``
+  for ``j`` in the packet's ``P``), with sentinel ``-1`` at positions
+  outside ``P``.  Condition 3 of ``consistent`` compares elements at
+  jointly-populated positions, and tuple equality (the ``set`` dedup of
+  ``tfg.py:189,291``) is elementwise equality — both exactly the
+  reference's by-tuple-index semantics for every protocol-reachable
+  evidence set (docs/DIVERGENCES.md D10).  Per-row lengths are stored
+  explicitly so the length condition (``tfg.py:88-92``) survives the
+  clear-P attack (``tfg.py:281``).
 * accepted-set ``Vi`` -> bool mask ``[w]``.
 
 Tuple elements are order values in ``[0, w)``; ``-1`` never collides with a
@@ -34,7 +36,7 @@ SENTINEL = -1  # "past the end of this row's tuple"
 class Evidence:
     """The set L of sub-list tuples carried by a packet (``tfg.py:189,291``)."""
 
-    vals: jnp.ndarray  # int32[max_l, size_l], tuple-ordered, SENTINEL-padded
+    vals: jnp.ndarray  # int32[max_l, size_l], position-expanded, SENTINEL-padded
     lens: jnp.ndarray  # int32[max_l], tuple length per row
     count: jnp.ndarray  # int32 scalar, number of valid rows
 

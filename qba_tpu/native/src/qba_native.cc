@@ -161,7 +161,7 @@ int qba_decode_pvl(const int32_t* buf, int len, int32_t* p_out, int np_cap,
 //              already applied, tfg.py:169-181)
 //   attacks  : int32[n_rounds * n_lieu * n_lieu * slots * 4] — per
 //              (round-1, receiver, sender*slots+slot) quads
-//              (action, coin, rand_v, late): the sample_attack layout
+//              (action, coin, rand_v, late): the sample_attacks_round layout
 //              plus the racy-delivery late-loss flag (late=1 -> the
 //              delivery is silently lost before any corruption, the
 //              barrier-race model of docs/DIVERGENCES.md D1; all 0 under

@@ -1,0 +1,302 @@
+"""Pallas TPU kernel for the voting-round hot loop.
+
+One kernel invocation executes a FULL protocol round for one trial — all
+receivers' inbox drains (``tfg.py:337-348`` + ``lieu_receive``,
+``tfg.py:289-300``) — with the round's entire mailbox resident in VMEM
+(~205 KB at the headline config).
+
+Why a kernel: the XLA formulation of the per-(receiver, packet) verdict is
+a batch of tiny ``[max_l, size_l]`` reductions whose tiles occupy ~30% of
+the VPU and whose loop fusions ran at a few Gop/s (three ~70 ms fusions
+per batch at nParties=11, sizeL=64, 1000 trials).  Here the layout is
+chosen for the hardware: packets fill the sublane dimension (``n_pk`` of
+them) and list positions fill lanes, so every verdict reduction is a
+dense ``[n_pk, size_l]`` tile op and the whole round is one fused program.
+
+Semantics are bit-identical to the XLA path
+(:func:`qba_tpu.rounds.engine.receiver_round`) — enforced by the
+equivalence tests in tests/test_round_kernel.py and by the three-way
+backend differentials.
+
+Layout conventions (per trial; ``vmap`` over trials prepends the grid):
+
+* ``vals``  — int32 ``[max_l, n_pk, size_l]`` (row-major outer so each
+  evidence row is one clean 2-D tile)
+* ``lens``  — int32 ``[n_pk, max_l]``
+* per-packet scalars (``count``, ``v``, ``sent``, honesty, draws) —
+  int32 ``[n_pk, 1]`` columns or ``[n_lieu, n_pk]`` row-sliced per
+  receiver; all flags stay 2-D end to end
+* bools travel as int32 0/1 (predicate relayouts are avoided entirely)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.core.types import SENTINEL
+
+
+def _cumsum_exclusive(col: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Exclusive prefix sum along the sublane axis of an ``[n, 1]`` int32
+    column, as log2(n) shifted adds (no scan primitive)."""
+    inclusive = col
+    shift = 1
+    while shift < n:
+        rolled = jnp.concatenate(
+            [jnp.zeros((shift, 1), jnp.int32), inclusive[:-shift]], axis=0
+        )
+        inclusive = inclusive + rolled
+        shift *= 2
+    return inclusive - col
+
+
+def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
+    """Compile one synchronous voting round for one trial.
+
+    Returns ``step(round_idx, vals, lens, count, p, v, sent, li, vi,
+    honest_pk, action, coin, rand_v, late) -> (ovals, olens, ocount, op,
+    ov, osent, ovi, overflow)`` — jit/vmap-safe (vmap over trials becomes
+    the Pallas grid).
+    """
+    n_s, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
+    size_l, w = cfg.size_l, cfg.w
+    n_pk = n_s * slots
+    n_dis = cfg.n_dishonest
+
+    def kernel(
+        round_ref,  # SMEM [1]
+        vals_ref,  # [max_l, n_pk, size_l]
+        lens_ref,  # [n_pk, max_l]
+        count_ref,  # [n_pk, 1]
+        p_ref,  # [n_pk, size_l]
+        v_ref,  # [n_pk, 1]
+        sent_ref,  # [n_pk, 1]
+        li_ref,  # [n_lieu, size_l]
+        vi_ref,  # [n_lieu, w]
+        honest_ref,  # [n_pk, 1]
+        act_ref,  # [n_lieu, n_pk]
+        coin_ref,
+        rv_ref,
+        late_ref,
+        ovals_ref,
+        olens_ref,
+        ocount_ref,
+        op_ref,
+        ov_ref,
+        osent_ref,
+        ovi_ref,
+        oovf_ref,  # [1, 1]
+    ):
+        r_idx = round_ref[0]
+        idx_col = jax.lax.broadcasted_iota(jnp.int32, (n_pk, 1), 0)
+        sender_col = idx_col // slots
+
+        vals = [vals_ref[r] for r in range(max_l)]  # each [n_pk, size_l]
+        in_t = [vals[r] != SENTINEL for r in range(max_l)]
+        lens = lens_ref[:]  # [n_pk, max_l]
+        count = count_ref[:]  # [n_pk, 1]
+        p_in = p_ref[:] != 0  # [n_pk, size_l]
+        v_in = v_ref[:]  # [n_pk, 1]
+        sent = sent_ref[:] != 0  # [n_pk, 1]
+        biz = honest_ref[:] == 0  # [n_pk, 1]
+        valid = [count > r for r in range(max_l)]  # each [n_pk, 1]
+        len0 = lens[:, 0:1]  # [n_pk, 1]
+
+        # ---- Receiver-independent raw-mailbox facts ----------------------
+        false_col = jnp.zeros((n_pk, 1), jnp.bool_)
+        oob = false_col
+        lens_bad = false_col
+        cells_coll = false_col
+        for r in range(max_l):
+            row_bad = jnp.any(
+                in_t[r] & ((vals[r] > w) | (vals[r] < 0)), axis=1, keepdims=True
+            )
+            oob |= valid[r] & row_bad
+            lens_bad |= valid[r] & (lens[:, r : r + 1] != len0)
+            for s in range(r + 1, max_l):
+                hit = jnp.any(
+                    in_t[r] & in_t[s] & (vals[r] == vals[s]),
+                    axis=1,
+                    keepdims=True,
+                )
+                cells_coll |= valid[s] & hit
+
+        ovf = jnp.zeros((1, 1), jnp.int32)
+        ovi_ref[:] = vi_ref[:]
+        olens_ref[:] = jnp.zeros((n_pk, max_l), jnp.int32)
+        ocount_ref[:] = jnp.zeros((n_pk, 1), jnp.int32)
+        op_ref[:] = jnp.zeros((n_pk, size_l), jnp.int32)
+        ov_ref[:] = jnp.zeros((n_pk, 1), jnp.int32)
+        osent_ref[:] = jnp.zeros((n_pk, 1), jnp.int32)
+        for r in range(max_l):
+            ovals_ref[r] = jnp.full((n_pk, size_l), SENTINEL, jnp.int32)
+
+        for recv in range(n_s):  # static unroll over receivers
+            act = act_ref[recv : recv + 1, :].reshape(n_pk, 1)
+            coin = coin_ref[recv : recv + 1, :].reshape(n_pk, 1)
+            rv = rv_ref[recv : recv + 1, :].reshape(n_pk, 1)
+            late = late_ref[recv : recv + 1, :].reshape(n_pk, 1)
+            li_row = li_ref[recv : recv + 1, :]  # [1, size_l]
+
+            dropped = biz & (act == 0) & (coin == 0)
+            v2 = jnp.where(biz & (act == 1), rv, v_in)  # [n_pk, 1]
+            clear_p = biz & (act == 2)
+            clear_l = biz & (act == 3)
+            delivered = (
+                ~dropped & (late == 0) & sent & (sender_col != recv)
+            )  # [n_pk, 1]
+
+            p2 = p_in & ~clear_p  # [n_pk, size_l]
+            own = jnp.where(
+                p2, jnp.broadcast_to(li_row, (n_pk, size_l)), SENTINEL
+            )
+            own_len = jnp.sum(p2.astype(jnp.int32), axis=1, keepdims=True)
+
+            dup = false_col
+            contains_v2 = false_col
+            own_coll = false_col
+            for r in range(max_l):
+                same = ~jnp.any(vals[r] != own, axis=1, keepdims=True)
+                dup |= valid[r] & same
+                contains_v2 |= valid[r] & jnp.any(
+                    in_t[r] & (vals[r] == v2), axis=1, keepdims=True
+                )
+                own_coll |= valid[r] & jnp.any(
+                    p2 & in_t[r] & (vals[r] == own), axis=1, keepdims=True
+                )
+            dup &= ~clear_l
+
+            count_eff = jnp.where(clear_l, 0, count)
+            new_count = jnp.where(
+                dup, count_eff, jnp.minimum(count_eff + 1, max_l)
+            )
+
+            cond1 = (clear_l | ~lens_bad) & (
+                (count_eff == 0) | (own_len == len0)
+            )
+            bad_own = jnp.any(
+                p2 & ((own == v2) | (own > w) | (own < 0)),
+                axis=1,
+                keepdims=True,
+            )
+            cond2 = ~((~clear_l & (oob | contains_v2)) | bad_own)
+            cond3 = (clear_l | ~cells_coll) & (dup | ~(~clear_l & own_coll))
+            ok = delivered & cond1 & cond2 & cond3 & (new_count == r_idx + 1)
+
+            # ---- dedup: first candidate per order value (tfg.py:294) -----
+            vi_row = ovi_ref[recv : recv + 1, :]  # [1, w]
+            iota_w = jax.lax.broadcasted_iota(jnp.int32, (n_pk, w), 1)
+            onehot = v2 == iota_w  # [n_pk, w]
+            in_vi = jnp.any(
+                onehot & (vi_row != 0), axis=1, keepdims=True
+            )  # [n_pk, 1]
+            cand = ok & ~in_vi
+            masked_idx = jnp.where(onehot & cand, idx_col, n_pk)
+            first = jnp.min(masked_idx, axis=0, keepdims=True)  # [1, w]
+            first_b = jnp.min(
+                jnp.where(onehot, jnp.broadcast_to(first, (n_pk, w)), n_pk),
+                axis=1,
+                keepdims=True,
+            )  # [n_pk, 1]
+            acc = cand & (first_b == idx_col)
+
+            new_vi = (vi_row != 0) | jnp.any(acc & onehot, axis=0, keepdims=True)
+            ovi_ref[recv : recv + 1, :] = new_vi.astype(jnp.int32)
+
+            # ---- slot allocation + rebroadcast (tfg.py:298-299) ----------
+            rebroadcast = acc & (r_idx <= n_dis)
+            slot_col = _cumsum_exclusive(rebroadcast.astype(jnp.int32), n_pk)
+            write = rebroadcast & (slot_col < slots)
+            ovf += jnp.any(rebroadcast & ~write).astype(jnp.int32).reshape(1, 1)
+
+            # ---- rebuild written packets into this receiver's row --------
+            # Slot assignment is injective, so the slot <- packet gather is
+            # a one-hot matrix; every rebuild field is an MXU matmul
+            # G[slots, n_pk] @ data[n_pk, X] (exact: all values < 2^24) and
+            # every store is static — no dynamic slicing anywhere.  (An
+            # XLA-side rebuild via dynamic gathers and a fused single wide
+            # matmul were both measured slower than these per-field
+            # gathers.)
+            iota_s = jax.lax.broadcasted_iota(jnp.int32, (n_pk, slots), 1)
+            g = (write & (slot_col == iota_s)).astype(jnp.float32)
+
+            def gat(x):  # [n_pk, X] -> one-hot gather [slots, X]
+                return jax.lax.dot_general(
+                    g,
+                    x.astype(jnp.float32),
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ).astype(jnp.int32)
+
+            has = gat(jnp.ones((n_pk, 1), jnp.int32)) > 0  # [slots, 1]
+            p2_g = gat(p2)  # [slots, size_l]
+            own_g = gat(own)
+            rows_g = [gat(vals[r]) for r in range(max_l)]
+            v2_g = gat(v2)  # [slots, 1]
+            cnt_g = gat(count_eff)
+            dup_g = gat(dup)
+            clr_g = gat(clear_l)
+            olen_g = gat(own_len)
+            ncnt_g = gat(new_count)
+            lens_g = gat(lens)  # [slots, max_l]
+
+            base = recv * slots
+            iota_l = jax.lax.broadcasted_iota(jnp.int32, (slots, max_l), 1)
+            keep_row = (clr_g == 0) & (iota_l < cnt_g)
+            new_row = (dup_g == 0) & (iota_l == cnt_g)
+            olens_ref[base : base + slots, :] = jnp.where(
+                has,
+                jnp.where(new_row, olen_g, jnp.where(keep_row, lens_g, 0)),
+                0,
+            )
+            for r in range(max_l):
+                keep = (clr_g == 0) & (r < cnt_g)  # [slots, 1]
+                is_new = (dup_g == 0) & (r == cnt_g)
+                row = jnp.where(
+                    is_new, own_g, jnp.where(keep, rows_g[r], SENTINEL)
+                )
+                ovals_ref[r, base : base + slots, :] = jnp.where(
+                    has, row, SENTINEL
+                )
+            ocount_ref[base : base + slots, :] = jnp.where(has, ncnt_g, 0)
+            op_ref[base : base + slots, :] = jnp.where(has, p2_g, 0)
+            ov_ref[base : base + slots, :] = jnp.where(has, v2_g, 0)
+            osent_ref[base : base + slots, :] = has.astype(jnp.int32)
+
+        oovf_ref[:] = ovf
+
+    out_shapes = (
+        jax.ShapeDtypeStruct((max_l, n_pk, size_l), jnp.int32),  # vals
+        jax.ShapeDtypeStruct((n_pk, max_l), jnp.int32),  # lens
+        jax.ShapeDtypeStruct((n_pk, 1), jnp.int32),  # count
+        jax.ShapeDtypeStruct((n_pk, size_l), jnp.int32),  # p
+        jax.ShapeDtypeStruct((n_pk, 1), jnp.int32),  # v
+        jax.ShapeDtypeStruct((n_pk, 1), jnp.int32),  # sent
+        jax.ShapeDtypeStruct((n_s, w), jnp.int32),  # vi
+        jax.ShapeDtypeStruct((1, 1), jnp.int32),  # overflow
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=out_shapes,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 13,
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in out_shapes
+        ),
+        interpret=interpret,
+    )
+
+    def step(round_idx, vals, lens, count, p, v, sent, li, vi, honest_pk,
+             action, coin, rand_v, late):
+        return call(
+            jnp.asarray([round_idx], jnp.int32),
+            vals, lens, count, p, v, sent, li, vi, honest_pk,
+            action, coin, rand_v, late,
+        )
+
+    return step

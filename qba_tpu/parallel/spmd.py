@@ -12,9 +12,10 @@ instead of O(nParties²) tagged messages.  Trials shard over ``dp`` as
 usual.
 
 Numerically identical to the single-device engine for the same keys
-(enforced by tests/test_parallel.py): per-packet corruption keys are
-derived from global (round, receiver, sender, slot) indices, so placement
-cannot change the randomness.
+(enforced by tests/test_parallel.py): the per-round attack draws are the
+same globally-indexed batched arrays every engine consumes
+(:func:`qba_tpu.adversary.sample_attacks_round`), so placement cannot
+change the randomness.
 """
 
 from __future__ import annotations
@@ -25,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from qba_tpu.adversary import sample_attacks_round
 from qba_tpu.backends.jax_backend import MonteCarloResult, aggregate, trial_keys
 from qba_tpu.config import QBAConfig
 from qba_tpu.parallel.mesh import axis_sizes, require_divisible
@@ -75,12 +77,18 @@ def _trial_party_sharded(cfg: QBAConfig, n_tp: int, key: jax.Array) -> TrialResu
         vi_l, mb_local = carry
         mb_full = jax.tree.map(gather_tp, mb_local)
         k_round = jax.random.fold_in(k_rounds, round_idx)
-        keys = jax.vmap(lambda i: jax.random.fold_in(k_round, i))(my_ids)
+        # Same batched round draws as the single-device engines; each
+        # device consumes its own receivers' rows, so placement cannot
+        # change the randomness.
+        draws = sample_attacks_round(cfg, k_round)
+        my_draws = tuple(
+            jax.lax.dynamic_slice_in_dim(d, start, n_local, 0) for d in draws
+        )
         vi_l, out_cells, ovf = jax.vmap(
-            lambda k, r, vrow, li: receiver_round(
-                cfg, round_idx, k, r, vrow, li, mb_full, honest
+            lambda d, r, vrow, li: receiver_round(
+                cfg, round_idx, d, r, vrow, li, mb_full, honest
             )
-        )(keys, my_ids, vi_l, my_li)
+        )(my_draws, my_ids, vi_l, my_li)
         return (vi_l, Mailbox(*out_cells)), jnp.any(ovf)
 
     (vi_l, _), overflows = jax.lax.scan(

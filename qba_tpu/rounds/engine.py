@@ -28,7 +28,7 @@ from qba_tpu.adversary import (
     assign_dishonest,
     commander_orders,
     corrupt_at_delivery,
-    late_drop,
+    sample_attacks_round,
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core import append_own, consistent, decide_order, success_oracle
@@ -103,7 +103,7 @@ def step3a_one(cfg: QBAConfig, p_row, v, li):
     return vi_row, out
 
 
-def receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb, honest):
+def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, mb, honest):
     """One lieutenant's inbox drain for one voting round
     (``tfg.py:337-348`` + ``lieu_receive``, ``tfg.py:289-300``).
 
@@ -130,6 +130,7 @@ def receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb,
     vals_f, lens_f, count_f = flat(mb.vals), flat(mb.lens), flat(mb.count)
     p_f, v_f, sent_f = flat(mb.p_mask), flat(mb.v), flat(mb.sent)
     idxs = jnp.arange(n_pk)
+    action, coin, rand_v, late = draws  # this receiver's [n_pk] rows
 
     def deliver(idx):
         """Corrupt + append one mailbox cell (tfg.py:271-284,291)."""
@@ -139,35 +140,114 @@ def receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb,
             evidence=Evidence(vals=vals_f[idx], lens=lens_f[idx], count=count_f[idx]),
         )
         sender_idx = idx // slots
-        cell_key = jax.random.fold_in(key, idx)
-        pk, delivered = corrupt_at_delivery(cfg, cell_key, pk, honest[sender_idx + 2])
+        pk, delivered = corrupt_at_delivery(
+            cfg, (action[idx], coin[idx], rand_v[idx]), pk, honest[sender_idx + 2]
+        )
         delivered &= sent_f[idx] & (sender_idx != receiver_idx)
-        delivered &= ~late_drop(cfg, cell_key)
+        delivered &= ~late[idx]
         ev = append_own(pk.evidence, pk.p_mask, li)
         return pk, ev, delivered
 
-    def prep(idx):
-        """Per-packet verdict only (tfg.py:291-294) — scalars out, so the
-        [max_l, size_l] evidence stays a fused intermediate instead of a
-        materialized [n_pk, max_l, size_l] batch."""
-        pk, ev, delivered = deliver(idx)
-        ok = (
-            delivered
-            & consistent(pk.v, ev, cfg.w)
-            & (ev.count == round_idx + 1)
-        )
-        return pk.v, ok
+    # ---- Per-packet verdicts (tfg.py:271-284,291-294), fully batched. ----
+    # Corruption is applied as *flags* over the verdict algebra, never as a
+    # select on the evidence tensor: materializing post-corruption evidence
+    # per (receiver, packet) costs a [trials, receivers, n_pk, max_l,
+    # size_l] tensor (~2 GB/round at the headline config) that dominated
+    # the loop.  All row-content reductions below read the raw mailbox,
+    # which is receiver-independent — XLA hoists them out of the receiver
+    # vmap — and the appended evidence is only materialized for the
+    # <= slots rebuilt packets.
+    max_l = cfg.max_l
+    senders = idxs // slots
+    biz = ~honest[senders + 2]  # [n_pk]
 
-    v_all, ok_all = jax.vmap(prep)(idxs)
+    dropped = biz & (action == 0) & (coin == 0)  # tfg.py:274
+    v2 = jnp.where(biz & (action == 1), rand_v, v_f)  # tfg.py:277
+    clear_p = biz & (action == 2)  # tfg.py:281
+    clear_l = biz & (action == 3)  # tfg.py:283
+    delivered = ~dropped & ~late & sent_f & (senders != receiver_idx)
 
-    # Acceptance with first-occurrence-wins dedup against Vi (tfg.py:294).
-    cand = ok_all & ~vi_row[v_all]
-    same_v_before = (
-        (v_all[None, :] == v_all[:, None])
-        & cand[None, :]
-        & (idxs[None, :] < idxs[:, None])
+    # Receiver-independent raw-mailbox reductions (shared by all receivers).
+    valid_raw = jnp.arange(max_l)[None, :] < count_f[:, None]  # [n_pk, max_l]
+    in_t_raw = vals_f != SENTINEL  # [n_pk, max_l, size_l]
+    oob_raw = jnp.any(
+        in_t_raw & ((vals_f > cfg.w) | (vals_f < 0)) & valid_raw[..., None],
+        axis=(1, 2),
+    )  # [n_pk]
+    # Value-presence table: presence[pk, x] = some valid row contains x.
+    presence = jnp.any(
+        (vals_f[..., None] == jnp.arange(cfg.w)[None, None, None, :])
+        & (in_t_raw & valid_raw[..., None])[..., None],
+        axis=(1, 2),
+    )  # [n_pk, w]
+    cell_lens_ok_raw = jnp.all(
+        jnp.where(valid_raw, lens_f == lens_f[:, :1], True), axis=1
+    )  # [n_pk]
+    eq_raw = jnp.any(
+        (vals_f[:, :, None, :] == vals_f[:, None, :, :])
+        & in_t_raw[:, :, None, :]
+        & in_t_raw[:, None, :, :],
+        axis=-1,
+    )  # [n_pk, max_l, max_l]
+    pair_mask = (
+        jnp.arange(max_l)[:, None] < jnp.arange(max_l)[None, :]
+    )  # upper triangle
+    cells_ok_raw = ~jnp.any(
+        eq_raw & pair_mask[None] & valid_raw[:, :, None] & valid_raw[:, None, :],
+        axis=(1, 2),
+    )  # [n_pk]
+
+    # Receiver-dependent part: the would-be own row (tfg.py:291).
+    p2 = p_f & ~clear_p[:, None]  # [n_pk, size_l]
+    own = jnp.where(p2, li[None, :], SENTINEL)  # [n_pk, size_l]
+    own_len = jnp.sum(p2.astype(jnp.int32), axis=-1)  # [n_pk]
+
+    count_eff = jnp.where(clear_l, 0, count_f)
+    dup = ~clear_l & jnp.any(
+        valid_raw & jnp.all(vals_f == own[:, None, :], axis=-1), axis=-1
     )
-    acc = cand & ~jnp.any(same_v_before, axis=1)
+    new_count = jnp.where(dup, count_eff, jnp.minimum(count_eff + 1, max_l))
+
+    # Cond 1 (tfg.py:88-92).
+    cond1 = (clear_l | cell_lens_ok_raw) & (
+        (count_eff == 0) | (own_len == lens_f[:, 0])
+    )
+    # Cond 2 (tfg.py:93-94): v2 < w always (mailbox v < w; rand_v < n+1 <= w).
+    bad_cell = ~clear_l & (
+        oob_raw | jnp.take_along_axis(presence, v2[:, None], axis=1)[:, 0]
+    )
+    bad_own = jnp.any(p2 & ((own == v2[:, None]) | (own > cfg.w) | (own < 0)), axis=-1)
+    cond2 = ~(bad_cell | bad_own)
+    # Cond 3 (tfg.py:96-98): cell pairs, and own vs cells unless duplicate.
+    own_collides = jnp.any(
+        valid_raw[..., None]
+        & p2[:, None, :]
+        & in_t_raw
+        & (vals_f == own[:, None, :]),
+        axis=(1, 2),
+    )
+    cond3 = (clear_l | cells_ok_raw) & (dup | ~(~clear_l & own_collides))
+
+    v_all = v2
+    ok_all = delivered & cond1 & cond2 & cond3 & (new_count == round_idx + 1)
+    # Pin the per-packet flags as materialized values: without the barrier
+    # XLA fuses the [max_l, size_l] reductions above into every consumer,
+    # recomputing them per use (three ~70 ms loop fusions at the headline
+    # config).
+    v_all, ok_all = jax.lax.optimization_barrier((v_all, ok_all))
+
+    # Acceptance with first-occurrence-wins dedup against Vi (tfg.py:294):
+    # for each order value, only the first candidate packet carrying it is
+    # accepted — O(w * n_pk), not an n_pk x n_pk matrix.
+    cand = ok_all & ~vi_row[v_all]
+    cand_idx = jnp.where(cand, idxs, n_pk)
+    first_idx = jnp.min(
+        jnp.where(
+            v_all[None, :] == jnp.arange(cfg.w)[:, None], cand_idx[None, :], n_pk
+        ),
+        axis=1,
+    )  # [w] — first candidate index per value
+    acc = cand & (first_idx[v_all] == idxs)
     vi_row = vi_row | jnp.any(
         acc[:, None] & (v_all[:, None] == jnp.arange(cfg.w)[None, :]), axis=0
     )
@@ -181,9 +261,9 @@ def receiver_round(cfg: QBAConfig, round_idx, key, receiver_idx, vi_row, li, mb,
 
     # Scatter written packets into this sender's outgoing mailbox row.
     # Slot assignment is injective, so each slot gathers from at most one
-    # packet; the <= slots written packets are re-delivered (same fold_in
-    # key -> identical corruption) so only [slots, max_l, size_l] — not
-    # [n_pk, ...] — is ever materialized.
+    # packet; the <= slots written packets are re-delivered (indexing the
+    # same shared draw arrays -> identical corruption) so only
+    # [slots, max_l, size_l] — not [n_pk, ...] — is ever materialized.
     hit = write[None, :] & (slot[None, :] == jnp.arange(slots)[:, None])
     has = jnp.any(hit, axis=1)  # bool[slots]
     src = jnp.argmax(hit, axis=1)  # packet index feeding each slot
@@ -241,6 +321,79 @@ def finish_trial(cfg: QBAConfig, vi, v_comm, honest, overflow) -> TrialResult:
     )
 
 
+def run_rounds_xla(cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds):
+    """Step 3b (tfg.py:337-348) as pure XLA: ``lax.scan`` over rounds,
+    receivers vmapped.  Portable to any backend."""
+    receiver_ids = jnp.arange(cfg.n_lieutenants)
+
+    def round_body(carry, round_idx):
+        vi, mb = carry
+        k_round = jax.random.fold_in(k_rounds, round_idx)
+        draws = sample_attacks_round(cfg, k_round)  # each [n_lieu, n_pk]
+        vi, out_cells, ovf = jax.vmap(
+            lambda d, r, vrow, li: receiver_round(cfg, round_idx, d, r, vrow, li, mb, honest)
+        )(draws, receiver_ids, vi, lieu_lists)
+        return (vi, Mailbox(*out_cells)), jnp.any(ovf)
+
+    (vi, _), overflows = jax.lax.scan(
+        round_body, (vi, mb), jnp.arange(1, cfg.n_rounds + 1)
+    )
+    return vi, jnp.any(overflows)
+
+
+def run_rounds_pallas(
+    cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds, *, interpret: bool
+):
+    """Step 3b on the fused Pallas round kernel
+    (:func:`qba_tpu.ops.round_kernel.build_round_step`): one kernel per
+    round per trial, mailbox in VMEM, packets in sublanes.  Bit-identical
+    verdicts to :func:`run_rounds_xla` (tests/test_round_kernel.py)."""
+    from qba_tpu.ops.round_kernel import build_round_step
+
+    step = build_round_step(cfg, interpret=interpret)
+    n_s, slots, max_l, s = cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l
+    n_pk = n_s * slots
+
+    senders = jnp.arange(n_pk) // slots
+    honest_pk = honest[senders + 2].astype(jnp.int32)[:, None]  # [n_pk, 1]
+
+    def pack(mb):
+        return (
+            mb.vals.reshape(n_pk, max_l, s).transpose(1, 0, 2),
+            mb.lens.reshape(n_pk, max_l),
+            mb.count.reshape(n_pk, 1),
+            mb.p_mask.reshape(n_pk, s).astype(jnp.int32),
+            mb.v.reshape(n_pk, 1),
+            mb.sent.reshape(n_pk, 1).astype(jnp.int32),
+        )
+
+    def round_body(carry, round_idx):
+        vi_i32, packed = carry
+        k_round = jax.random.fold_in(k_rounds, round_idx)
+        action, coin, rand_v, late = sample_attacks_round(cfg, k_round)
+        out = step(
+            round_idx, *packed, lieu_lists, vi_i32, honest_pk,
+            action.astype(jnp.int32), coin.astype(jnp.int32),
+            rand_v.astype(jnp.int32), late.astype(jnp.int32),
+        )
+        new_packed, vi_i32, ovf = out[:6], out[6], out[7]
+        return (vi_i32, tuple(new_packed)), ovf[0, 0] > 0
+
+    init = (vi.astype(jnp.int32), pack(mb))
+    (vi_i32, _), overflows = jax.lax.scan(
+        round_body, init, jnp.arange(1, cfg.n_rounds + 1)
+    )
+    return vi_i32 != 0, jnp.any(overflows)
+
+
+def resolve_round_engine(cfg: QBAConfig) -> str:
+    """``auto`` -> the fused Pallas kernel on TPU, interpreted-kernel-free
+    XLA elsewhere."""
+    if cfg.round_engine != "auto":
+        return cfg.round_engine
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
 def run_trial(
     cfg: QBAConfig, key: jax.Array, hints: PartitionHints | None = None
 ) -> TrialResult:
@@ -256,18 +409,12 @@ def run_trial(
     mb = Mailbox(*out_cells)
 
     # Step 3b (tfg.py:337-348): synchronous rounds 1..n_dishonest+1.
-    receiver_ids = jnp.arange(cfg.n_lieutenants)
-
-    def round_body(carry, round_idx):
-        vi, mb = carry
-        k_round = jax.random.fold_in(k_rounds, round_idx)
-        keys = jax.vmap(lambda i: jax.random.fold_in(k_round, i))(receiver_ids)
-        vi, out_cells, ovf = jax.vmap(
-            lambda k, r, vrow, li: receiver_round(cfg, round_idx, k, r, vrow, li, mb, honest)
-        )(keys, receiver_ids, vi, lieu_lists)
-        return (vi, Mailbox(*out_cells)), jnp.any(ovf)
-
-    (vi, _), overflows = jax.lax.scan(
-        round_body, (vi, mb), jnp.arange(1, cfg.n_rounds + 1)
-    )
-    return finish_trial(cfg, vi, v_comm, honest, jnp.any(overflows))
+    engine = resolve_round_engine(cfg)
+    if engine == "pallas":
+        vi, overflow = run_rounds_pallas(
+            cfg, vi, mb, lieu_lists, honest, k_rounds,
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        vi, overflow = run_rounds_xla(cfg, vi, mb, lieu_lists, honest, k_rounds)
+    return finish_trial(cfg, vi, v_comm, honest, overflow)

@@ -153,7 +153,9 @@ def run_sweep(
         keys = chunk_keys(cfg, chunk, chunk_trials)
         with timers.time("chunk"):
             res = runner(cfg, keys)
-            res = jax.block_until_ready(res)
+            from qba_tpu.backends.jax_backend import fence
+
+            fence(res)
         cr = ChunkResult(
             chunk=chunk,
             trials=chunk_trials,

@@ -4,10 +4,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from qba_tpu.adversary import assign_dishonest, commander_orders, corrupt_at_delivery
+from qba_tpu.adversary import (
+    assign_dishonest,
+    commander_orders,
+    corrupt_at_delivery,
+    sample_attacks_round,
+)
+
+
 from qba_tpu.config import QBAConfig
 from qba_tpu.core import append_own
 from qba_tpu.core.types import Packet, empty_evidence
+
+
+def draws_for(cfg, key):
+    """One cell's (action, coin, rand_v) from the batched round draws."""
+    a, c, rv, _ = sample_attacks_round(cfg, key)
+    return a[0, 0], c[0, 0], rv[0, 0]
 
 
 class TestAssignDishonest:
@@ -89,7 +102,7 @@ class TestCorruptAtDelivery:
         pk = self._packet(cfg)
         for i in range(10):
             out, delivered = corrupt_at_delivery(
-                cfg, jax.random.key(i), pk, jnp.asarray(True)
+                cfg, draws_for(cfg, jax.random.key(i)), pk, jnp.asarray(True)
             )
             assert bool(delivered)
             assert int(out.v) == 1
@@ -102,7 +115,7 @@ class TestCorruptAtDelivery:
         seen = {"drop": 0, "v": 0, "p": 0, "l": 0, "clean": 0}
         for i in range(400):
             out, delivered = corrupt_at_delivery(
-                cfg, jax.random.key(i), pk, jnp.asarray(False)
+                cfg, draws_for(cfg, jax.random.key(i)), pk, jnp.asarray(False)
             )
             if not bool(delivered):
                 seen["drop"] += 1
@@ -129,7 +142,7 @@ class TestCorruptAtDelivery:
         vs = set()
         for i in range(600):
             out, delivered = corrupt_at_delivery(
-                cfg, jax.random.key(i), pk, jnp.asarray(False)
+                cfg, draws_for(cfg, jax.random.key(i)), pk, jnp.asarray(False)
             )
             if bool(delivered):
                 vs.add(int(out.v))

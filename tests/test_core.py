@@ -125,8 +125,9 @@ class TestAppendOwn:
         ev = append_own(ev, p, li)
         assert int(ev.count) == 1
         assert int(ev.lens[0]) == 2
-        # compacted tuple order: values at positions {0, 2} left-justified
-        assert ev.vals[0].tolist() == [1, 2, -1, -1, -1, -1]
+        # position-expanded row: values at positions {0, 2}, sentinel
+        # elsewhere (docs/DIVERGENCES.md D10)
+        assert ev.vals[0].tolist() == [1, -1, 2, -1, -1, -1]
         # identical append is a no-op (set semantics, tfg.py:291)
         ev = append_own(ev, p, li)
         assert int(ev.count) == 1
@@ -218,3 +219,36 @@ class TestConfig:
             QBAConfig(n_parties=1, size_l=4)
         with pytest.raises(ValueError):
             QBAConfig(n_parties=11, size_l=4, qsim_path="dense")  # 48 qubits
+
+
+class TestConsistentAfterAppend:
+    def test_matches_composition_randomized(self):
+        # consistent_after_append(v, ev, p, li) must equal
+        # (consistent(v, append_own(ev, p, li)), its count) everywhere.
+        from qba_tpu.core import consistent_after_append
+
+        rng = np.random.default_rng(7)
+        size_l, max_l, w = 8, 4, 4
+        for _ in range(300):
+            # inclusive upper bound: full evidence (count == max_l) is the
+            # case where append_own silently drops the own row
+            n_rows = int(rng.integers(0, max_l + 1))
+            ev = empty_evidence(max_l, size_l)
+            vals, lens = np.array(ev.vals), np.array(ev.lens)
+            for i in range(n_rows):
+                p_i = rng.random(size_l) < 0.5
+                vals[i] = np.where(p_i, rng.integers(0, w + 2, size_l), -1)
+                lens[i] = int(p_i.sum())
+            ev = Evidence(
+                vals=jnp.asarray(vals),
+                lens=jnp.asarray(lens),
+                count=jnp.asarray(n_rows, dtype=jnp.int32),
+            )
+            p = jnp.asarray(rng.random(size_l) < 0.5)
+            li = jnp.asarray(rng.integers(0, w, size_l), dtype=jnp.int32)
+            v = jnp.asarray(int(rng.integers(0, w)), dtype=jnp.int32)
+
+            appended = append_own(ev, p, li)
+            want = bool(consistent(v, appended, w)), int(appended.count)
+            got_ok, got_count = consistent_after_append(v, ev, p, li, w)
+            assert (bool(got_ok), int(got_count)) == want
