@@ -42,16 +42,18 @@ from qba_tpu.core.types import SENTINEL
 
 def _cumsum_exclusive(col: jnp.ndarray, n: int) -> jnp.ndarray:
     """Exclusive prefix sum along the sublane axis of an ``[n, 1]`` int32
-    column, as log2(n) shifted adds (no scan primitive)."""
-    inclusive = col
-    shift = 1
-    while shift < n:
-        rolled = jnp.concatenate(
-            [jnp.zeros((shift, 1), jnp.int32), inclusive[:-shift]], axis=0
-        )
-        inclusive = inclusive + rolled
-        shift *= 2
-    return inclusive - col
+    column — one strictly-lower-triangular MXU matmul (a log2(n) chain of
+    shifted adds costs ~2 log2(n) vector relayouts per call; the matmul is
+    one op and exact for the small integer counts involved)."""
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    tri = (iota_c < iota_r).astype(jnp.float32)  # strictly lower triangular
+    return jax.lax.dot_general(
+        tri,
+        col.astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
 
 
 def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
