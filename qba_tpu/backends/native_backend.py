@@ -63,30 +63,74 @@ def _attack_quads(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
 def run_trial_native(cfg: QBAConfig, key: jax.Array) -> dict:
     """One protocol execution in the C++ runtime; returns the rank-0
     summary dict (same shape as
-    :func:`qba_tpu.backends.local_backend.run_trial_local`)."""
-    lib = load()
-    k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
+    :func:`qba_tpu.backends.local_backend.run_trial_local`).
 
-    honest = np.asarray(assign_dishonest(cfg, k_dis))
-    lists = np.asarray(generate_lists_for(cfg, k_lists)[0])
-    v_sent_arr, v_comm = commander_orders(
-        cfg, k_comm, jnp.asarray(bool(honest[1]))
+    Delegates to :func:`run_trials_native` with a singleton batch so the
+    per-trial key-tree derivation exists exactly once."""
+    res = run_trials_native(cfg, key[None], n_threads=1)
+    w, n_lieu = cfg.w, cfg.n_lieutenants
+    return {
+        "success": bool(res["success"][0]),
+        "decisions": [int(x) for x in res["decisions"][0]],
+        "honest": [bool(h) for h in res["honest"][0]],
+        "v_comm": int(res["v_comm"][0]),
+        "vi": [
+            {int(x) for x in range(w) if res["vi"][0, i, x]}
+            for i in range(n_lieu)
+        ],
+        "overflow": bool(res["overflow"][0]),
+    }
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _batch_presample(cfg: QBAConfig, keys: jax.Array):
+    """All trials' pre-sampled randomness in one jitted batch (the same
+    per-trial key tree, vmapped)."""
+    def one(key):
+        k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
+        honest = assign_dishonest(cfg, k_dis)
+        lists = generate_lists_for(cfg, k_lists)[0]
+        v_sent, v_comm = commander_orders(cfg, k_comm, honest[1])
+        return honest, lists, v_sent, v_comm, _attack_quads(cfg, k_rounds)
+
+    return jax.vmap(one)(keys)
+
+
+def run_trials_native(
+    cfg: QBAConfig, keys: jax.Array | None = None, n_threads: int = 0
+) -> dict:
+    """Monte-Carlo batch on the C++ runtime's threaded executor.
+
+    Randomness is pre-sampled in one jitted batch (identical key tree to
+    the other backends), then ``qba_run_trials`` fans the trials out over
+    a host thread pool (``n_threads <= 0`` = hardware concurrency).
+    Returns a dict of stacked arrays: ``success [n]``, ``decisions
+    [n, n_parties]``, ``honest [n, n_parties]``, ``v_comm [n]``, ``vi
+    [n, n_lieutenants, w]``, ``overflow [n]``, ``success_rate``.
+    """
+    from qba_tpu.backends.jax_backend import trial_keys
+
+    lib = load()
+    if keys is None:
+        keys = trial_keys(cfg)
+    n = keys.shape[0]
+    honest, lists, v_sent, v_comm, attacks = (
+        np.asarray(x) for x in _batch_presample(cfg, keys)
     )
-    attacks = np.asarray(_attack_quads(cfg, k_rounds))
 
     n_lieu, w = cfg.n_lieutenants, cfg.w
     honest_a, honest_p = _u8(honest)
     lists_a, lists_p = _i32(lists)
-    vs_a, vs_p = _i32(np.asarray(v_sent_arr))
+    vs_a, vs_p = _i32(v_sent)
+    vc_a, vc_p = _i32(v_comm)
     at_a, at_p = _i32(attacks)
-    decisions = np.zeros(cfg.n_parties, dtype=np.int32)
-    vi = np.zeros((n_lieu, w), dtype=np.uint8)
-    flags = np.zeros(2, dtype=np.int32)
-    _, dec_p = decisions, decisions.ctypes.data_as(_i32p)
-    _, vi_p = vi, vi.ctypes.data_as(_u8p)
-    _, fl_p = flags, flags.ctypes.data_as(_i32p)
+    decisions = np.zeros((n, cfg.n_parties), dtype=np.int32)
+    vi = np.zeros((n, n_lieu, w), dtype=np.uint8)
+    flags = np.zeros((n, 2), dtype=np.int32)
 
-    rc = lib.qba_run_trial(
+    rc = lib.qba_run_trials(
+        n,
+        n_threads,
         cfg.n_parties,
         cfg.size_l,
         cfg.n_dishonest,
@@ -95,22 +139,21 @@ def run_trial_native(cfg: QBAConfig, key: jax.Array) -> dict:
         honest_p,
         lists_p,
         vs_p,
-        int(v_comm),
+        vc_p,
         at_p,
-        dec_p,
-        vi_p,
-        fl_p,
+        decisions.ctypes.data_as(_i32p),
+        vi.ctypes.data_as(_u8p),
+        flags.ctypes.data_as(_i32p),
     )
     if rc != 0:
-        raise RuntimeError(f"qba_run_trial failed with rc={rc}")
+        raise RuntimeError(f"qba_run_trials failed with rc={rc}")
 
     return {
-        "success": bool(flags[0]),
-        "decisions": [int(x) for x in decisions],
-        "honest": [bool(h) for h in honest[1:]],
-        "v_comm": int(v_comm),
-        "vi": [
-            {int(x) for x in range(w) if vi[i, x]} for i in range(n_lieu)
-        ],
-        "overflow": bool(flags[1]),
+        "success": flags[:, 0].astype(bool),
+        "decisions": decisions,
+        "honest": honest_a[:, 1:].astype(bool),
+        "v_comm": vc_a,
+        "vi": vi.astype(bool),
+        "overflow": flags[:, 1].astype(bool),
+        "success_rate": float(flags[:, 0].mean()),
     }

@@ -156,20 +156,32 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
              backend=args.backend, qsim_path=cfg.qsim_path)
 
     with profile_trace(args.profile_dir):
-        if args.backend in ("local", "native"):
-            from qba_tpu.backends.jax_backend import trial_keys
+        if args.backend == "native":
+            # The C++ runtime's threaded batch executor.
+            from qba_tpu.backends.native_backend import run_trials_native
 
-            if args.backend == "native":
-                from qba_tpu.backends.native_backend import run_trial_native as run_one
-            else:
-                from qba_tpu.backends.local_backend import run_trial_local as run_one
+            with timers.time("trials"):
+                res = run_trials_native(cfg)
+            for i in range(min(cfg.trials, args.max_verdicts)):
+                trial = types.SimpleNamespace(
+                    decisions=res["decisions"][i],
+                    honest=res["honest"][i],
+                    success=res["success"][i],
+                    overflow=res["overflow"][i],
+                )
+                print(render_verdict(cfg, trial, index=i), file=out)
+            any_overflow = bool(np.any(res["overflow"]))
+            success_rate = res["success_rate"]
+        elif args.backend == "local":
+            from qba_tpu.backends.jax_backend import trial_keys
+            from qba_tpu.backends.local_backend import run_trial_local
 
             keys = trial_keys(cfg)
             successes = 0
             any_overflow = False
             with timers.time("trials"):
                 for i in range(cfg.trials):
-                    r = run_one(cfg, keys[i])
+                    r = run_trial_local(cfg, keys[i])
                     successes += int(r["success"])
                     any_overflow |= r["overflow"]
                     if i < args.max_verdicts:

@@ -16,8 +16,10 @@
 // Build: make -C qba_tpu/native  (g++ -O2 -shared; no dependencies).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <set>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -289,6 +291,67 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
   flags_out[0] = filtered.size() == 1 ? 1 : 0;
   flags_out[1] = overflow ? 1 : 0;
   return 0;
+}
+
+// Batched Monte-Carlo executor: runs n_trials independent trials across a
+// host thread pool (work-stealing via an atomic cursor).  qba_run_trial is
+// a pure function of its per-trial inputs, so trials parallelize with no
+// shared state beyond the cursor.  All arrays are the single-trial layouts
+// stacked along a leading n_trials axis; v_comm becomes int32[n_trials].
+//
+//   n_threads <= 0 -> std::thread::hardware_concurrency().
+//
+// Returns 0, or one failing trial's nonzero error code (the first store
+// wins; which trial that is depends on thread scheduling).
+int qba_run_trials(int n_trials, int n_threads, int n_parties, int size_l,
+                   int n_dishonest, int32_t w, int slots,
+                   const uint8_t* honest, const int32_t* lists,
+                   const int32_t* v_sent, const int32_t* v_comm,
+                   const int32_t* attacks, int32_t* decisions_out,
+                   uint8_t* vi_out, int32_t* flags_out) {
+  const int n_lieu = n_parties - 1;
+  const int n_rounds = n_dishonest + 1;
+  const size_t honest_s = static_cast<size_t>(n_parties) + 1;
+  const size_t lists_s = honest_s * size_l;
+  const size_t vsent_s = n_lieu;
+  const size_t att_s = static_cast<size_t>(n_rounds) * n_lieu * n_lieu *
+                       slots * 4;
+  const size_t dec_s = n_parties;
+  const size_t vi_s = static_cast<size_t>(n_lieu) * w;
+
+  if (n_threads <= 0) {
+    n_threads = static_cast<int>(std::thread::hardware_concurrency());
+    if (n_threads <= 0) n_threads = 1;
+  }
+  n_threads = std::min(n_threads, n_trials);
+
+  std::atomic<int> cursor(0);
+  std::atomic<int> rc(0);
+  auto worker = [&]() {
+    for (;;) {
+      const int t = cursor.fetch_add(1);
+      if (t >= n_trials) return;
+      const int r = qba_run_trial(
+          n_parties, size_l, n_dishonest, w, slots, honest + t * honest_s,
+          lists + t * lists_s, v_sent + t * vsent_s, v_comm[t],
+          attacks + t * att_s, decisions_out + t * dec_s, vi_out + t * vi_s,
+          flags_out + t * 2);
+      if (r != 0) {
+        int expected = 0;  // first error wins (deterministic reporting)
+        rc.compare_exchange_strong(expected, r);
+      }
+    }
+  };
+
+  if (n_threads == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    for (int i = 0; i < n_threads; ++i) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return rc.load();
 }
 
 }  // extern "C"

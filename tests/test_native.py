@@ -138,3 +138,38 @@ class TestDifferentialNativeVsLocal:
             assert a["decisions"] == [int(x) for x in np.asarray(r.decisions)]
             assert a["success"] == bool(np.asarray(r.success))
             assert a["honest"] == [bool(h) for h in np.asarray(r.honest)]
+
+
+class TestThreadedExecutor:
+    def test_batch_matches_per_trial(self):
+        # The threaded batch executor must reproduce the per-trial native
+        # runs exactly (same key tree, pure per-trial function).
+        from qba_tpu.backends.jax_backend import trial_keys
+        from qba_tpu.backends.native_backend import (
+            run_trial_native,
+            run_trials_native,
+        )
+
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=2, trials=12)
+        keys = trial_keys(cfg)
+        batch = run_trials_native(cfg, keys, n_threads=4)
+        for i in range(cfg.trials):
+            one = run_trial_native(cfg, keys[i])
+            assert batch["decisions"][i].tolist() == one["decisions"]
+            assert bool(batch["success"][i]) == one["success"]
+            got_vi = [
+                {int(x) for x in range(cfg.w) if batch["vi"][i, j, x]}
+                for j in range(cfg.n_lieutenants)
+            ]
+            assert got_vi == one["vi"]
+
+    def test_batch_matches_jax_backend(self):
+        from qba_tpu.backends.jax_backend import run_trials, trial_keys
+        from qba_tpu.backends.native_backend import run_trials_native
+
+        cfg = QBAConfig(n_parties=4, size_l=8, n_dishonest=1, trials=16)
+        keys = trial_keys(cfg)
+        a = run_trials(cfg, keys)
+        b = run_trials_native(cfg, keys)
+        assert np.asarray(a.trials.decisions).tolist() == b["decisions"].tolist()
+        assert abs(float(a.success_rate) - b["success_rate"]) < 1e-6
