@@ -48,8 +48,8 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
     )
     p.add_argument(
         "--round-engine", choices=("auto", "xla", "pallas"), default="auto",
-        help="voting-round engine: auto = fused Pallas kernel on TPU, "
-        "pure XLA elsewhere (both bit-identical)",
+        help="voting-round engine: auto = fused Pallas kernel on TPU "
+        "when the config fits VMEM, pure XLA otherwise (bit-identical)",
     )
     p.add_argument(
         "--delivery", choices=("sync", "racy"), default="sync",

@@ -41,8 +41,9 @@ class QBAConfig:
         (``v not in Vi``, ``tfg.py:294``), so ``w`` is a universal bound;
         smaller values trade memory for a recorded overflow flag.
       round_engine: "auto" (default — the fused Pallas round kernel on
-        TPU, pure XLA elsewhere), "xla", or "pallas" (forces the kernel;
-        interpreter mode off-TPU).  Both engines are bit-identical
+        TPU when its per-trial working set fits VMEM, pure XLA
+        otherwise), "xla", or "pallas" (forces the kernel; interpreter
+        mode off-TPU).  Both engines are bit-identical
         (tests/test_round_kernel.py).
       delivery: "sync" (race-free idealization, default) or "racy" —
         model the reference's barrier race (a packet missing its round's

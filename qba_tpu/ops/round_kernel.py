@@ -302,3 +302,26 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         )
 
     return step
+
+
+# Scoped VMEM available to a kernel instance (v5e exposes 16 MB; leave
+# headroom for Mosaic's own scratch).
+_VMEM_BUDGET_BYTES = 10 * 2**20
+
+
+def fits_kernel(cfg: QBAConfig) -> bool:
+    """Whether the round kernel's per-trial working set fits in VMEM.
+
+    The kernel holds the mailbox (in + out) plus ~a dozen
+    ``[n_pk, size_l]``-sized intermediates per receiver iteration.  At
+    the reference's sizeL=1000 with 5 traitors that is ~20 MB — over the
+    16 MB scoped-vmem limit (observed compile failure) — so ``auto``
+    engine selection falls back to the XLA path for such configs.
+    """
+    n_pk = cfg.n_lieutenants * cfg.slots
+    tile = 4 * n_pk * cfg.size_l
+    # Tile count: mailbox in + out refs (2*max_l), loaded row values and
+    # their in-tuple masks (2*max_l), and ~a dozen [n_pk, size_l]
+    # intermediates (p_in/p2/own/op plus fusion temporaries).
+    est = tile * (4 * cfg.max_l + 12)
+    return est <= _VMEM_BUDGET_BYTES

@@ -387,11 +387,16 @@ def run_rounds_pallas(
 
 
 def resolve_round_engine(cfg: QBAConfig) -> str:
-    """``auto`` -> the fused Pallas kernel on TPU, interpreted-kernel-free
-    XLA elsewhere."""
+    """``auto`` -> the fused Pallas kernel on TPU when its per-trial
+    working set fits VMEM (:func:`qba_tpu.ops.round_kernel.fits_kernel`),
+    pure XLA elsewhere."""
     if cfg.round_engine != "auto":
         return cfg.round_engine
-    return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if jax.default_backend() != "tpu":
+        return "xla"
+    from qba_tpu.ops.round_kernel import fits_kernel
+
+    return "pallas" if fits_kernel(cfg) else "xla"
 
 
 def run_trial(

@@ -65,3 +65,24 @@ class TestKernelEquivalence:
     def test_config_validation(self):
         with pytest.raises(ValueError):
             QBAConfig(n_parties=3, size_l=4, round_engine="cuda")
+
+
+class TestEngineSelection:
+    def test_vmem_fallback_at_reference_scale(self):
+        # sizeL=1000 with 5 traitors needs ~20 MB of VMEM in-kernel —
+        # over the 16 MB scoped limit (observed compile failure on TPU);
+        # auto selection must fall back to the XLA engine there.
+        from qba_tpu.ops.round_kernel import fits_kernel
+
+        assert fits_kernel(QBAConfig(n_parties=11, size_l=64, n_dishonest=3))
+        assert not fits_kernel(
+            QBAConfig(n_parties=11, size_l=1000, n_dishonest=5)
+        )
+
+    def test_explicit_engine_respected(self):
+        from qba_tpu.rounds.engine import resolve_round_engine
+
+        cfg = QBAConfig(n_parties=3, size_l=4, round_engine="pallas")
+        assert resolve_round_engine(cfg) == "pallas"
+        cfg = QBAConfig(n_parties=3, size_l=4, round_engine="xla")
+        assert resolve_round_engine(cfg) == "xla"
