@@ -1,0 +1,31 @@
+"""Minimal end-to-end run — the `mpiexec -n 12 python tfg.py 64 3` analog.
+
+Usage: python examples/basic_run.py   (CPU or TPU; no flags needed)
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+from qba_tpu import QBAConfig, run_trials
+
+cfg = QBAConfig(n_parties=11, size_l=64, n_dishonest=3, trials=100, seed=0)
+res = run_trials(cfg)
+
+print(f"config: {cfg.n_parties} parties, sizeL={cfg.size_l}, "
+      f"{cfg.n_dishonest} dishonest, w={cfg.w}")
+print(f"success rate over {cfg.trials} trials: {float(res.success_rate):.3f}")
+
+# Per-trial detail, reference-style (tfg.py:360-363): decisions of parties
+# 1..n (commander first), who was dishonest, and the verdict.
+import numpy as np
+
+t = 0
+decisions = np.asarray(res.trials.decisions[t])
+honest = np.asarray(res.trials.honest[t])
+print(f"\ntrial {t}:")
+print(f"Decisions:  {decisions.tolist()}")
+print(f"Dishonests: {[i + 1 for i, h in enumerate(honest) if not h]}")
+print(f"Success:    {bool(res.trials.success[t])}")

@@ -1,0 +1,34 @@
+"""Reference-style circuit construction through the compat API.
+
+The same call shapes as the reference's qsimov usage (tfg.py:15-80):
+QGate + add_operation, QCircuit + MEASURE, Drewom().execute.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+from qba_tpu.qsim import Drewom, QCircuit, QGate
+
+n_parties, n_qubits = 3, 2
+size = (n_parties + 1) * n_qubits
+
+# The not-Q-correlated resource circuit (tfg.py:15-22): H on groups
+# 1..n, CNOT copying group 1 onto group 0.
+gate = QGate(size, 0, "notQCorrelated")
+for q in range(n_qubits, size):
+    gate.add_operation("H", targets=q)
+for b in range(n_qubits):
+    gate.add_operation("X", targets=b, controls=n_qubits + b)
+
+circuit = QCircuit(size, size, "NQCorrCircuit")
+circuit.add_operation(gate)
+for i in range(size):
+    circuit.add_operation("MEASURE", targets=i, outputs=i)
+
+for shot, bits in enumerate(Drewom(seed=0).execute(circuit, shots=4)):
+    groups = [bits[g * n_qubits:(g + 1) * n_qubits] for g in range(n_parties + 1)]
+    print(f"shot {shot}: groups={groups}  (group 0 == group 1: "
+          f"{groups[0] == groups[1]})")

@@ -1,0 +1,29 @@
+"""Success probability vs the security parameter sizeL.
+
+The protocol's agreement guarantee sharpens as the particle lists grow;
+this sweeps sizeL and (optionally) plots the curve.
+
+Usage: python examples/security_study.py [out.png]
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+from qba_tpu import QBAConfig, run_trials
+
+values = [1, 2, 4, 8, 16, 32, 64]
+rates = []
+for L in values:
+    cfg = QBAConfig(n_parties=5, size_l=L, n_dishonest=2, trials=256, seed=7)
+    rate = float(run_trials(cfg).success_rate)
+    rates.append(rate)
+    print(f"sizeL={L:3d}: success_rate={rate:.4f}")
+
+if len(sys.argv) > 1:
+    from qba_tpu.obs.plots import plot_param_study
+
+    print("plot:", plot_param_study(values, rates, 256, "size_l",
+                                    sys.argv[1], log_x=True))

@@ -1,0 +1,46 @@
+"""Monte-Carlo over a device mesh: trials x positions (dp x sp) and the
+party-sharded spmd engine (dp x tp, one all_gather per round over ICI).
+
+Runs on real multi-chip TPU, or on a virtual 8-device CPU mesh:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/sharded_mesh.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+import jax
+
+from qba_tpu import QBAConfig
+from qba_tpu.parallel import (
+    default_mesh_shape,
+    make_mesh,
+    run_trials_sharded,
+    run_trials_spmd,
+)
+
+n = len(jax.devices())
+print(f"{n} devices: {jax.devices()}")
+
+# Trials over dp, list positions over sp; default_mesh_shape factorizes
+# any device count, and trials/size_l are sized to divide the axes.
+shape = default_mesh_shape(n)
+mesh = make_mesh(shape)
+dp, sp = shape["dp"], shape.get("sp", 1)
+cfg = QBAConfig(n_parties=5, size_l=32 * sp, n_dishonest=1,
+                trials=16 * dp, seed=3)
+res = run_trials_sharded(cfg, mesh)
+print(f"{shape}: success_rate={float(res.success_rate):.3f}")
+
+# Lieutenants over tp: the per-round mailbox exchange is one all_gather.
+shape = default_mesh_shape(n, want_tp=True)
+if shape.get("tp", 1) > 1:
+    mesh = make_mesh(shape)
+    dp, tp = shape["dp"], shape["tp"]
+    cfg = QBAConfig(n_parties=2 * tp + 1, size_l=32, n_dishonest=1,
+                    trials=16 * dp, seed=3)
+    res = run_trials_spmd(cfg, mesh)
+    print(f"{shape}: success_rate={float(res.success_rate):.3f}")

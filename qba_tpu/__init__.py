@@ -20,5 +20,15 @@ resources.  Here the message-passing design inverts into array programming:
 
 from qba_tpu.config import QBAConfig
 
-__all__ = ["QBAConfig"]
+
+def run_trials(cfg, keys=None):
+    """Convenience re-export of
+    :func:`qba_tpu.backends.jax_backend.run_trials` (lazy import so
+    ``import qba_tpu`` stays light)."""
+    from qba_tpu.backends.jax_backend import run_trials as _run
+
+    return _run(cfg, keys)
+
+
+__all__ = ["QBAConfig", "run_trials"]
 __version__ = "0.1.0"
