@@ -112,6 +112,34 @@ def save_checkpoint(
     )
 
 
+def _default_runner(chunk_trials: int, log: EventLog | None):
+    """Single-device vmap batch, or dp-sharded over all devices when
+    several are visible and the chunk size divides them."""
+    from qba_tpu.backends.jax_backend import batched_trials
+
+    n = len(jax.devices())
+    if n == 1 or chunk_trials % n != 0:
+        if log and n > 1:
+            log.info(
+                "sweep",
+                "chunk size not divisible by device count; running "
+                "single-device",
+                devices=n,
+                chunk_trials=chunk_trials,
+            )
+        return batched_trials
+    from qba_tpu.parallel import make_mesh, run_trials_sharded
+
+    mesh = make_mesh({"dp": n})
+    if log:
+        log.info("sweep", "chunks dp-sharded over devices", devices=n)
+
+    def runner(cfg, keys):
+        return run_trials_sharded(cfg, mesh, keys).trials
+
+    return runner
+
+
 def run_sweep(
     cfg: QBAConfig,
     n_chunks: int,
@@ -123,18 +151,18 @@ def run_sweep(
 ) -> SweepResult:
     """Run ``n_chunks`` batches of ``chunk_trials`` trials each.
 
-    ``runner(cfg, keys) -> TrialResult`` defaults to the jitted vmap batch
-    (:func:`qba_tpu.backends.jax_backend.batched_trials`); the mesh-sharded
-    runners in :mod:`qba_tpu.parallel` can be partial-applied in.  With
-    ``checkpoint``, completed chunks are persisted after each chunk and
-    skipped on re-run.
+    ``runner(cfg, keys) -> TrialResult`` defaults to the jitted vmap
+    batch on one device, or to trials sharded over a ``dp`` mesh spanning
+    all visible devices when there are several (and the chunk size
+    divides the device count); the mesh-sharded runners in
+    :mod:`qba_tpu.parallel` can also be partial-applied in explicitly.
+    With ``checkpoint``, completed chunks are persisted after each chunk
+    and skipped on re-run.  Results are placement-independent
+    (tests/test_parallel.py), so resuming on different hardware
+    reproduces the same sweep.
     """
-    from qba_tpu.backends.jax_backend import batched_trials
-
     if chunk_trials is None:
         chunk_trials = cfg.trials
-    if runner is None:
-        runner = batched_trials
 
     loaded = load_checkpoint(checkpoint, cfg, chunk_trials) if checkpoint else []
     # A checkpoint may hold more chunks than this invocation asks for;
@@ -150,6 +178,9 @@ def run_sweep(
     for chunk in range(n_chunks):
         if chunk in done:
             continue
+        if runner is None:
+            # Lazy: a fully-checkpointed re-run never touches the backend.
+            runner = _default_runner(chunk_trials, log)
         keys = chunk_keys(cfg, chunk, chunk_trials)
         with timers.time("chunk"):
             res = runner(cfg, keys)

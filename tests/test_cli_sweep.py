@@ -117,3 +117,24 @@ class TestCLI:
             out=io.StringIO(),
         )
         assert rc == 2
+
+
+class TestDeviceAwareRunner:
+    def test_sharded_default_matches_single_device(self):
+        # On the 8-device test mesh the default runner dp-shards chunks;
+        # results must equal the explicit single-device batch.
+        from qba_tpu.backends.jax_backend import batched_trials
+        from qba_tpu.sweep import run_sweep
+
+        cfg = QBAConfig(n_parties=4, size_l=8, n_dishonest=1, trials=16)
+        a = run_sweep(cfg, n_chunks=2)  # device-aware default
+        b = run_sweep(cfg, n_chunks=2, runner=batched_trials)
+        assert a.successes == b.successes
+        assert a.n_trials == b.n_trials
+
+    def test_indivisible_chunk_falls_back(self):
+        from qba_tpu.sweep import run_sweep
+
+        cfg = QBAConfig(n_parties=3, size_l=4, n_dishonest=0, trials=7)
+        res = run_sweep(cfg, n_chunks=2)  # 7 % 8 != 0 -> vmap fallback
+        assert res.n_trials == 14
