@@ -80,6 +80,7 @@ def make_hybrid_mesh(
     *,
     dcn_axis: str = "dp",
     n_slices: int | None = None,
+    devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
     """Multi-host / multi-slice mesh: slow DCN hops carry only the
     embarrassingly-parallel axis.
@@ -97,12 +98,14 @@ def make_hybrid_mesh(
     portable.  On a real multi-slice deployment run
     ``jax.distributed.initialize()`` first.
     """
+    if devices is None:
+        devices = jax.devices()
     if n_slices is None:
         # Devices carry a per-device slice_index on multi-slice
         # deployments; a single granule (or CPU devices without the
         # attribute) means no DCN boundary exists.
         n_slices = len(
-            {getattr(d, "slice_index", 0) or 0 for d in jax.devices()}
+            {getattr(d, "slice_index", 0) or 0 for d in devices}
         )
     if dcn_axis not in ici_axes:
         raise ValueError(
@@ -110,12 +113,11 @@ def make_hybrid_mesh(
             f"{tuple(ici_axes)}"
         )
     if n_slices <= 1:
-        return make_mesh(dict(ici_axes))
+        return make_mesh(dict(ici_axes), devices=devices)
 
     shape = dict(ici_axes)
     names = tuple(shape.keys())
     sizes = tuple(shape.values())
-    devices = jax.devices()
     if math.prod(sizes) * n_slices != len(devices):
         raise ValueError(
             f"hybrid mesh {dict(shape)} x {n_slices} slices needs "

@@ -147,3 +147,39 @@ class TestCorruptAtDelivery:
             if bool(delivered):
                 vs.add(int(out.v))
         assert vs <= set(range(cfg.n_parties + 1)) | {1}
+
+
+class TestAttackDrawDistributions:
+    def test_batched_draws_match_reference_laws(self):
+        # SURVEY §4: statistical tests of the sampling laws.  Actions
+        # uniform over 4 (tfg.py:272), coin uniform over 2 (tfg.py:274),
+        # rand_v uniform over [0, nParties+1) (tfg.py:277), late ~
+        # Bernoulli(p_late).  Chi-square over the pooled per-round draws.
+        from scipy import stats  # available via jax's scipy dependency
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=4, n_dishonest=2,
+            delivery="racy", p_late=0.3,
+        )
+        keys = jax.random.split(jax.random.key(0), 64)
+        acts, coins, rvs, lates = [], [], [], []
+        for k in keys:
+            a, c, rv, late = sample_attacks_round(cfg, k)
+            acts.append(np.asarray(a).ravel())
+            coins.append(np.asarray(c).ravel())
+            rvs.append(np.asarray(rv).ravel())
+            lates.append(np.asarray(late).ravel())
+        acts = np.concatenate(acts)
+        coins = np.concatenate(coins)
+        rvs = np.concatenate(rvs)
+        lates = np.concatenate(lates)
+
+        def chi2_uniform(x, k):
+            obs = np.bincount(x, minlength=k)
+            return stats.chisquare(obs).pvalue
+
+        assert chi2_uniform(acts, 4) > 1e-4
+        assert chi2_uniform(coins, 2) > 1e-4
+        assert chi2_uniform(rvs, cfg.n_parties + 1) > 1e-4
+        rate = lates.mean()
+        assert abs(rate - cfg.p_late) < 0.01

@@ -77,6 +77,9 @@ def test_randomized_config_fuzz_three_way():
         keys = jax.random.split(jax.random.key(cfg.seed), cfg.trials)
         a = batched_trials(cfg, keys)
         nat = run_trials_native(cfg, keys)
+        if cfg.max_accepts_per_round is None:
+            # D9: slots = w is a lossless bound; overflow must be impossible.
+            assert not bool(jnp.any(a.overflow)), f"case={case} cfg={cfg}"
         for i in range(cfg.trials):
             b = run_trial_local(cfg, keys[i])
             ctx = f"case={case} cfg={cfg} trial={i}"
