@@ -78,7 +78,10 @@ print((time.perf_counter() - t0) / {n_trials})
 
 
 def main() -> None:
+    from qba_tpu.compile_cache import enable_compile_cache
     from qba_tpu.config import QBAConfig
+
+    enable_compile_cache()
 
     quick = os.environ.get("QBA_BENCH_QUICK") == "1"
     cfg = QBAConfig(

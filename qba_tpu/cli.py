@@ -314,6 +314,9 @@ def _cmd_study(args: argparse.Namespace, out) -> int:
 def main(argv: Sequence[str] | None = None, out=None) -> int:
     out = out if out is not None else sys.stdout
     args = _parser().parse_args(argv)
+    from qba_tpu.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     try:
         if args.command == "run":
             return _cmd_run(args, out)

@@ -1,0 +1,27 @@
+"""Persistent XLA compilation cache for the command-line tools.
+
+The protocol programs take tens of seconds to compile (remote TPU
+compiles especially); caching compiled executables on disk makes
+repeated CLI/bench invocations of the same config start in seconds.
+Library imports never enable this — only the tool entry points call it —
+so embedding applications keep full control of JAX global config.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache() -> None:
+    """Point JAX's persistent compilation cache at a per-user directory
+    (override with ``QBA_COMPILE_CACHE``; set it empty to disable).
+    Harmless if the directory is unwritable (jax warns and continues)."""
+    import jax
+
+    path = os.environ.get(
+        "QBA_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "qba_tpu", "jax"),
+    )
+    if path:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -175,23 +175,26 @@ def run_sweep(
         log.info("sweep", "resumed from checkpoint", chunks=resumed, path=checkpoint)
 
     timers = timers or PhaseTimers()
-    for chunk in range(n_chunks):
-        if chunk in done:
-            continue
-        if runner is None:
-            # Lazy: a fully-checkpointed re-run never touches the backend.
-            runner = _default_runner(chunk_trials, log)
-        keys = chunk_keys(cfg, chunk, chunk_trials)
-        with timers.time("chunk"):
-            res = runner(cfg, keys)
-            from qba_tpu.backends.jax_backend import fence
+    todo = [c for c in range(n_chunks) if c not in done]
+    # Double-buffered pipeline: dispatch chunk k+1 before fetching chunk
+    # k's results, so the host-side readback (expensive on tunneled
+    # backends) overlaps the next chunk's device execution.  JAX's async
+    # dispatch makes the in-flight window free; depth 2 bounds device
+    # memory to two chunk batches.  The "chunk" timer covers dispatch +
+    # readback only (not checkpoint I/O or logging), and a finished chunk
+    # is drained-and-checkpointed even if the next dispatch raises.
+    in_flight: list[tuple[int, Any]] = []
 
-            fence(res)
+    def drain_one() -> None:
+        chunk, res = in_flight.pop(0)
+        with timers.time("chunk"):
+            successes = int(np.sum(np.asarray(res.success)))
+            overflow = bool(np.any(np.asarray(res.overflow)))
         cr = ChunkResult(
             chunk=chunk,
             trials=chunk_trials,
-            successes=int(np.sum(np.asarray(res.success))),
-            overflow=bool(np.any(np.asarray(res.overflow))),
+            successes=successes,
+            overflow=overflow,
         )
         chunks.append(cr)
         if checkpoint:
@@ -204,6 +207,23 @@ def run_sweep(
                 successes=cr.successes,
                 trials=cr.trials,
             )
+
+    try:
+        for chunk in todo:
+            if runner is None:
+                # Lazy: a fully-checkpointed re-run never touches the
+                # backend.
+                runner = _default_runner(chunk_trials, log)
+            keys = chunk_keys(cfg, chunk, chunk_trials)
+            with timers.time("chunk"):
+                res = runner(cfg, keys)
+            in_flight.append((chunk, res))
+            if len(in_flight) >= 2:
+                drain_one()
+    finally:
+        # Preserve completed work if a dispatch fails mid-pipeline.
+        while in_flight:
+            drain_one()
 
     chunks.sort(key=lambda c: c.chunk)
     return SweepResult(cfg=cfg, chunks=tuple(chunks), resumed_chunks=resumed)
