@@ -1,6 +1,7 @@
 """CLI and checkpoint-resumable sweep tests."""
 
 import io
+import os
 import json
 
 import pytest
@@ -138,3 +139,41 @@ class TestDeviceAwareRunner:
         cfg = QBAConfig(n_parties=3, size_l=4, n_dishonest=0, trials=7)
         res = run_sweep(cfg, n_chunks=2)  # 7 % 8 != 0 -> vmap fallback
         assert res.n_trials == 14
+
+
+class TestStudyCommand:
+    def test_study_sweeps_param_and_plots(self, tmp_path):
+        pytest.importorskip("matplotlib")
+        out = io.StringIO()
+        png = str(tmp_path / "study.png")
+        rc = main(
+            [
+                "study", "--n-parties", "3", "--size-l", "4",
+                "--n-dishonest", "1", "--trials", "8",
+                "--param", "size_l", "--values", "2,4", "--plot", png,
+            ],
+            out=out,
+        )
+        assert rc == 0
+        text = out.getvalue()
+        assert "size_l=2: success_rate=" in text
+        assert "size_l=4: success_rate=" in text
+        assert os.path.exists(png)
+
+    def test_study_p_late_forces_racy(self):
+        out = io.StringIO()
+        rc = main(
+            [
+                "study", "--n-parties", "3", "--size-l", "4",
+                "--n-dishonest", "1", "--trials", "8",
+                "--param", "p_late", "--values", "0.0,0.5",
+            ],
+            out=out,
+        )
+        assert rc == 0
+        assert "p_late=0.5: success_rate=" in out.getvalue()
+
+    def test_study_rejects_unknown_param(self):
+        with pytest.raises(SystemExit):
+            main(["study", "--n-parties", "3", "--size-l", "4",
+                  "--param", "w", "--values", "1,2"])
