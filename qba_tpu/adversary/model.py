@@ -65,23 +65,25 @@ def commander_orders(
     return v_sent, v
 
 
-# Tags folded into the per-round key, one per attack variable — each
-# variable is ONE batched draw over every (receiver, cell) of the round.
-# Per-cell key derivation (fold_in per cell, then per draw) costs a full
-# threefry chain per cell and dominated the whole round loop on TPU
-# (~450 ms/round at 1000 trials); batched counter-mode draws are ~free.
-_ACTION_TAG = 0x0AC7
-_COIN_TAG = 0x0C01
-_RANDV_TAG = 0x0BAD
+# Tags folded into the per-round key — each variable is ONE batched draw
+# over every (receiver, cell) of the round.  Per-cell key derivation
+# (fold_in per cell, then per draw) costs a full threefry chain per cell
+# and dominated the whole round loop on TPU (~450 ms/round at 1000
+# trials); batched counter-mode draws are ~free.  The three attack
+# variables further share a single uint32 stream (bit-sliced), since
+# three separate threefry streams were ~6 ms per 1000-trial batch.
+_ATTACK_TAG = 0x0AC7
 _LATE_TAG = 0x17A7E
 
 
 def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
-    """Draw the whole round's attack randomness in four batched calls.
+    """Draw the whole round's attack randomness from one batched stream.
 
     Returns ``(action, coin, rand_v, late)``, each
-    ``[n_lieutenants, n_lieutenants * slots]`` indexed by
-    ``(receiver, sender * slots + slot)``:
+    ``[n_lieutenants * slots, n_lieutenants]`` indexed by
+    ``(sender * slots + slot, receiver)`` — packet-major, so the Pallas
+    round kernel reads one receiver's draws as a relayout-free lane
+    slice and no engine ever materializes a transpose:
 
     * ``action`` — uniform in ``{0..3}``: the 4-way dishonest choice
       (``tfg.py:272``).
@@ -93,19 +95,22 @@ def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
       all-False under ``delivery="sync"`` so sync and racy-with-p_late=0
       runs are bit-identical.
 
+    The three attack variables are disjoint bit fields of one uint32
+    stream: bits 0-1 = action, bit 2 = coin, bits 3-26 = the dividend for
+    ``rand_v``'s modulo (24-bit remainder bias < 2^-20 — the reference's
+    own ``np.random.randint`` carries the same class of modulo bias).
+
     All three protocol backends (jax / local / native) consume exactly
     these arrays, so their randomness matches bit for bit.
     """
-    shape = (cfg.n_lieutenants, cfg.n_lieutenants * cfg.slots)
-    action = jax.random.randint(
-        jax.random.fold_in(k_round, _ACTION_TAG), shape, 0, 4
+    shape = (cfg.n_lieutenants * cfg.slots, cfg.n_lieutenants)
+    bits = jax.random.bits(
+        jax.random.fold_in(k_round, _ATTACK_TAG), shape, jnp.uint32
     )
-    coin = jax.random.randint(
-        jax.random.fold_in(k_round, _COIN_TAG), shape, 0, 2
-    )
-    rand_v = jax.random.randint(
-        jax.random.fold_in(k_round, _RANDV_TAG), shape, 0,
-        cfg.n_parties + 1, dtype=jnp.int32,
+    action = (bits & 3).astype(jnp.int32)
+    coin = ((bits >> 2) & 1).astype(jnp.int32)
+    rand_v = (
+        ((bits >> 3) & 0xFFFFFF).astype(jnp.int32) % (cfg.n_parties + 1)
     )
     if cfg.delivery == "racy":
         late = jax.random.bernoulli(

@@ -55,7 +55,9 @@ def _attack_quads(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
     ``delivery="sync"``."""
     def one_round(r):
         draws = sample_attacks_round(cfg, jax.random.fold_in(k_rounds, r))
-        return jnp.stack([d.astype(jnp.int32) for d in draws], axis=-1)
+        # Draws are packet-major [n_pk, n_lieu]; the C ABI keeps the
+        # (receiver, cell) order, so transpose host-side (cheap, CPU jit).
+        return jnp.stack([d.astype(jnp.int32).T for d in draws], axis=-1)
 
     return jax.vmap(one_round)(jnp.arange(1, cfg.n_rounds + 1))
 

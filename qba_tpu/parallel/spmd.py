@@ -82,12 +82,13 @@ def _trial_party_sharded(cfg: QBAConfig, n_tp: int, key: jax.Array) -> TrialResu
         # change the randomness.
         draws = sample_attacks_round(cfg, k_round)
         my_draws = tuple(
-            jax.lax.dynamic_slice_in_dim(d, start, n_local, 0) for d in draws
+            jax.lax.dynamic_slice_in_dim(d, start, n_local, 1) for d in draws
         )
         vi_l, out_cells, ovf = jax.vmap(
             lambda d, r, vrow, li: receiver_round(
                 cfg, round_idx, d, r, vrow, li, mb_full, honest
-            )
+            ),
+            in_axes=(1, 0, 0, 0),
         )(my_draws, my_ids, vi_l, my_li)
         return (vi_l, Mailbox(*out_cells)), jnp.any(ovf)
 

@@ -130,7 +130,7 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     vals_f, lens_f, count_f = flat(mb.vals), flat(mb.lens), flat(mb.count)
     p_f, v_f, sent_f = flat(mb.p_mask), flat(mb.v), flat(mb.sent)
     idxs = jnp.arange(n_pk)
-    action, coin, rand_v, late = draws  # this receiver's [n_pk] rows
+    action, coin, rand_v, late = draws  # this receiver's [n_pk] columns
 
     def deliver(idx):
         """Corrupt + append one mailbox cell (tfg.py:271-284,291)."""
@@ -206,6 +206,14 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     dup = ~clear_l & jnp.any(
         valid_raw & jnp.all(vals_f == own[:, None, :], axis=-1), axis=-1
     )
+    # The min() clamp below never fires: every mailbox packet was
+    # rebroadcast in some round r <= n_dishonest with count r+1 <=
+    # max_l-1, so count_eff <= max_l-1 and the append always lands.  The
+    # own-row terms in cond1/cond3 therefore never see a
+    # dropped-by-fullness append; if max_l is ever decoupled from
+    # n_dishonest+2, add an `appended = ~dup & (count_eff < max_l)` guard
+    # here and in ops/round_kernel.py to match the
+    # consistent_after_append spec.
     new_count = jnp.where(dup, count_eff, jnp.minimum(count_eff + 1, max_l))
 
     # Cond 1 (tfg.py:88-92).
@@ -329,9 +337,10 @@ def run_rounds_xla(cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds):
     def round_body(carry, round_idx):
         vi, mb = carry
         k_round = jax.random.fold_in(k_rounds, round_idx)
-        draws = sample_attacks_round(cfg, k_round)  # each [n_lieu, n_pk]
+        draws = sample_attacks_round(cfg, k_round)  # each [n_pk, n_lieu]
         vi, out_cells, ovf = jax.vmap(
-            lambda d, r, vrow, li: receiver_round(cfg, round_idx, d, r, vrow, li, mb, honest)
+            lambda d, r, vrow, li: receiver_round(cfg, round_idx, d, r, vrow, li, mb, honest),
+            in_axes=(1, 0, 0, 0),
         )(draws, receiver_ids, vi, lieu_lists)
         return (vi, Mailbox(*out_cells)), jnp.any(ovf)
 
