@@ -25,6 +25,13 @@ _i32p = ctypes.POINTER(ctypes.c_int32)
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 
 
+class NativeUnavailableError(RuntimeError):
+    """The native library could not be built (missing toolchain, compile
+    failure).  A dedicated type so the CLI can report exactly this
+    optional-dependency condition cleanly while other RuntimeErrors keep
+    their tracebacks."""
+
+
 def _build() -> None:
     proc = subprocess.run(
         ["make", "-C", _DIR],
@@ -32,7 +39,7 @@ def _build() -> None:
         text=True,
     )
     if proc.returncode != 0:
-        raise RuntimeError(
+        raise NativeUnavailableError(
             f"native build failed:\n{proc.stdout}\n{proc.stderr}"
         )
 

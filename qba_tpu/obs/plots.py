@@ -26,6 +26,15 @@ _INK = "#374151"
 _GRID = "#d1d5db"
 
 
+class PlottingUnavailableError(RuntimeError):
+    """matplotlib is not installed (it is an optional dependency).
+
+    A dedicated type so the CLI can turn exactly this condition into a
+    clean usage error while letting every other ``RuntimeError`` (XLA
+    failures, native runtime errors) propagate with a traceback.
+    """
+
+
 def _require_pyplot():
     try:
         import matplotlib
@@ -33,7 +42,7 @@ def _require_pyplot():
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
     except ImportError as e:  # pragma: no cover - matplotlib is optional
-        raise RuntimeError(
+        raise PlottingUnavailableError(
             "plotting requires matplotlib, which is not installed; "
             "qba_tpu works without it everywhere else"
         ) from e
@@ -100,6 +109,10 @@ def plot_param_study(
     plt = _require_pyplot()
     x = np.asarray(values, dtype=float)
     y = np.asarray(rates, dtype=float)
+    # Sort by x so an unordered --values list still draws a monotone line
+    # (unsorted points would zigzag and self-overlap the band).
+    order = np.argsort(x, kind="stable")
+    x, y = x[order], y[order]
     band = _band(np.full_like(y, trials), y)
 
     fig, ax = plt.subplots(figsize=(6.4, 3.6), dpi=150)

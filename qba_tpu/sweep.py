@@ -180,14 +180,15 @@ def run_sweep(
     # k's results, so the host-side readback (expensive on tunneled
     # backends) overlaps the next chunk's device execution.  JAX's async
     # dispatch makes the in-flight window free; depth 2 bounds device
-    # memory to two chunk batches.  The "chunk" timer covers dispatch +
-    # readback only (not checkpoint I/O or logging), and a finished chunk
-    # is drained-and-checkpointed even if the next dispatch raises.
+    # memory to two chunk batches.  Dispatch and readback are timed as
+    # distinct phases ("dispatch"/"readback") so each phase's count equals
+    # the number of chunks and per-chunk means stay honest; a finished
+    # chunk is drained-and-checkpointed even if the next dispatch raises.
     in_flight: list[tuple[int, Any]] = []
 
     def drain_one() -> None:
         chunk, res = in_flight.pop(0)
-        with timers.time("chunk"):
+        with timers.time("readback"):
             successes = int(np.sum(np.asarray(res.success)))
             overflow = bool(np.any(np.asarray(res.overflow)))
         cr = ChunkResult(
@@ -215,7 +216,7 @@ def run_sweep(
                 # backend.
                 runner = _default_runner(chunk_trials, log)
             keys = chunk_keys(cfg, chunk, chunk_trials)
-            with timers.time("chunk"):
+            with timers.time("dispatch"):
                 res = runner(cfg, keys)
             in_flight.append((chunk, res))
             if len(in_flight) >= 2:
