@@ -16,6 +16,7 @@ this; the backend doubles as the CPU wall-clock baseline for ``bench.py``
 from __future__ import annotations
 
 import itertools
+from typing import TYPE_CHECKING
 
 import jax
 import numpy as np
@@ -27,6 +28,12 @@ from qba_tpu.adversary import (
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.qsim import generate_lists_for
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from qba_tpu.obs import EventLog
+
+# tfg.py:272-284 — names for the 4-way dishonest action in the trail.
+_ACTION_NAMES = ("drop", "corrupt-v", "clear-P", "clear-L")
 
 
 def _consistent(v: int, L: set, w: int) -> bool:
@@ -45,9 +52,25 @@ def _consistent(v: int, L: set, w: int) -> bool:
     )
 
 
-def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
+def run_trial_local(
+    cfg: QBAConfig,
+    key: jax.Array,
+    log: "EventLog | None" = None,
+    trial: int = 0,
+) -> dict:
     """One protocol execution over Python sets; returns the rank-0 summary
-    (``tfg.py:351-363``) plus diagnostics mirroring TrialResult."""
+    (``tfg.py:351-363``) plus diagnostics mirroring TrialResult.
+
+    When ``log`` is given, the full protocol event trail is emitted —
+    the structured equivalent of every ``mpi_print`` site in the
+    reference: per-party dishonesty (``tfg.py:124``), particle lists
+    (``tfg.py:159-162``), commander state + equivocation
+    (``tfg.py:328-330,169-181``), packet sends (``tfg.py:203,229``),
+    attack actions (``tfg.py:275-284``), per-packet accept/reject with
+    the failing condition (``tfg.py:190,294``), per-round accepted-sets,
+    and the final decision summary (``tfg.py:360-363``).  Phase
+    summaries are INFO; per-packet events are DEBUG.
+    """
     k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
 
     honest = np.asarray(assign_dishonest(cfg, k_dis))
@@ -63,9 +86,51 @@ def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
     vi: list[set] = [set() for _ in range(n_lieu)]
     overflow = False
 
+    if log:
+        # tfg.py:124 — every rank announces its honesty.
+        for rank in range(1, cfg.n_parties + 1):
+            log.debug(
+                "dishonesty",
+                "party role",
+                trial=trial,
+                rank=rank,
+                honest=bool(honest[rank]),
+            )
+        # tfg.py:159-162 — received particle lists (head only; full lists
+        # can be size_l=1000 long).
+        for rank in range(cfg.n_parties + 1):
+            row = [int(x) for x in lists[rank][:16]]
+            log.debug(
+                "particles",
+                "list received",
+                trial=trial,
+                rank=rank,
+                head=row,
+                size_l=cfg.size_l,
+            )
+
     # Step 1b: the commander's recovered Q-correlated positions
     # (tfg.py:325-328).
     isq = {k for k in range(cfg.size_l) if lists[0][k] != lists[1][k]}
+
+    if log:
+        # tfg.py:328-330 — commander state; equivocation shows as
+        # distinct per-lieutenant orders (tfg.py:169-181).
+        log.info(
+            "step2",
+            "commander order",
+            trial=trial,
+            v=v_comm,
+            n_qcorr=len(isq),
+            commander_honest=bool(honest[1]),
+        )
+        if len(set(v_sent)) > 1:
+            log.info(
+                "step2",
+                "commander equivocates",
+                trial=trial,
+                orders=sorted(set(v_sent)),
+            )
 
     # Step 2 + 3a (tfg.py:166-196): per-sender packet lists; the list index
     # is the mailbox slot (same numbering as the dense mailbox tensor).
@@ -73,10 +138,34 @@ def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
     for i in range(n_lieu):
         p = {k for k in isq if int(lists[1][k]) == v_sent[i]}
         v = v_sent[i]
+        if log:
+            # tfg.py:203 — the commander's send to lieutenant rank i+2.
+            log.debug(
+                "step2",
+                "send",
+                trial=trial,
+                sender=1,
+                dest=i + 2,
+                v=v,
+                p_size=len(p),
+                l_size=0,
+            )
         ell = {tuple(li[i][j] for j in sorted(p))}
-        if _consistent(v, ell, w):
+        ok = _consistent(v, ell, w)
+        if ok:
             vi[i].add(v)
             mailbox[i].append((p, v, ell))
+        if log:
+            # tfg.py:190 — step 3a receive + accept/reject.
+            log.debug(
+                "step3a",
+                "receive",
+                trial=trial,
+                rank=i + 2,
+                v=v,
+                accepted=ok,
+                reason="accepted" if ok else "inconsistent",
+            )
 
     # Step 3b (tfg.py:337-348): synchronous rounds.  Attack randomness is
     # the same batched per-round arrays the jax engine draws, indexed per
@@ -94,15 +183,27 @@ def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
                         continue
                     p, v, ell = mailbox[sender][slot]
                     cell = sender * slots + slot
-                    if bool(a_late[recv, cell]):  # D1 race modeling
+                    if bool(a_late[cell, recv]):  # D1 race modeling
+                        if log:
+                            log.debug(
+                                "round", "late loss", trial=trial,
+                                round=rnd, sender=sender + 2, recv=recv + 2,
+                            )
                         continue
                     action, coin, rand_v = (
-                        int(a_act[recv, cell]),
-                        int(a_coin[recv, cell]),
-                        int(a_rv[recv, cell]),
+                        int(a_act[cell, recv]),
+                        int(a_coin[cell, recv]),
+                        int(a_rv[cell, recv]),
                     )
                     p2, v2, ell2 = set(p), v, set(ell)
                     if not honest[sender + 2]:  # tfg.py:271-284
+                        if log:
+                            # tfg.py:275-284 "The action for general N".
+                            log.debug(
+                                "round", "attack", trial=trial, round=rnd,
+                                sender=sender + 2, recv=recv + 2,
+                                action=_ACTION_NAMES[action],
+                            )
                         if action == 0 and coin == 0:
                             continue
                         if action == 1:
@@ -113,17 +214,42 @@ def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
                             ell2 = set()
                     # lieu_receive (tfg.py:289-300)
                     ell2.add(tuple(li[recv][j] for j in sorted(p2)))
-                    if (
-                        _consistent(v2, ell2, w)
-                        and v2 not in vi[recv]
-                        and len(ell2) == rnd + 1
-                    ):
+                    if not _consistent(v2, ell2, w):
+                        reason = "inconsistent"
+                    elif v2 in vi[recv]:
+                        reason = "duplicate-v"
+                    elif len(ell2) != rnd + 1:
+                        reason = "wrong-evidence-len"
+                    else:
+                        reason = "accepted"
+                    if log:
+                        log.debug(
+                            "round", "receive", trial=trial, round=rnd,
+                            sender=sender + 2, recv=recv + 2, v=v2,
+                            accepted=reason == "accepted", reason=reason,
+                        )
+                    if reason == "accepted":
                         vi[recv].add(v2)
                         if rnd <= cfg.n_dishonest:
                             if len(out[recv]) < slots:
                                 out[recv].append((p2, v2, ell2))
+                                if log:
+                                    # tfg.py:229 — the accepted packet is
+                                    # rebroadcast to every peer.
+                                    log.debug(
+                                        "round", "send", trial=trial,
+                                        round=rnd, sender=recv + 2, v=v2,
+                                        p_size=len(p2), l_size=len(ell2),
+                                        broadcast=True,
+                                    )
                             else:
                                 overflow = True
+        if log:
+            for i in range(n_lieu):
+                log.debug(
+                    "round", "vi", trial=trial, round=rnd, rank=i + 2,
+                    vi=sorted(vi[i]),
+                )
         mailbox = out
 
     # Decision + verdict (tfg.py:303-306,351-363; empty-Vi sentinel is D2).
@@ -132,6 +258,16 @@ def run_trial_local(cfg: QBAConfig, key: jax.Array) -> dict:
     ]
     honest_parties = [bool(h) for h in honest[1:]]
     filtered = {d for d, h in zip(decisions, honest_parties) if h}
+    if log:
+        # tfg.py:360-363 — the rank-0 verdict triple.
+        log.info(
+            "decision",
+            "verdict",
+            trial=trial,
+            decisions=decisions,
+            dishonest=[i + 1 for i, h in enumerate(honest_parties) if not h],
+            success=len(filtered) == 1,
+        )
     return {
         "success": len(filtered) == 1,
         "decisions": decisions,
