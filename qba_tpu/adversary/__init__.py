@@ -3,21 +3,33 @@
 The reference threads an ``is_biz`` flag through every broadcast
 (``tfg.py:101-125,169-181,271-284``); here the adversary is a first-class
 configurable model: a per-rank honesty mask, commander equivocation as a
-per-recipient order vector, and the 4-action lieutenant attack sampled
-independently per (broadcast, recipient) at delivery time
+per-recipient order vector, and the 4-action lieutenant attack applied at
+delivery time — sampled independently per (broadcast, recipient) under
+``attack_scope="delivery"``, or with the reference's shared-object
+mutation-leak semantics under ``attack_scope="broadcast"``
 (docs/DIVERGENCES.md D3).
 """
 
 from qba_tpu.adversary.model import (
+    CLEAR_L_BIT,
+    CLEAR_P_BIT,
+    DROP_BIT,
+    FORGE_BIT,
     assign_dishonest,
     commander_orders,
     corrupt_at_delivery,
+    raw_attack_draws,
     sample_attacks_round,
 )
 
 __all__ = [
+    "CLEAR_L_BIT",
+    "CLEAR_P_BIT",
+    "DROP_BIT",
+    "FORGE_BIT",
     "assign_dishonest",
     "commander_orders",
     "corrupt_at_delivery",
+    "raw_attack_draws",
     "sample_attacks_round",
 ]

@@ -75,15 +75,19 @@ def commander_orders(
 _ATTACK_TAG = 0x0AC7
 _LATE_TAG = 0x17A7E
 
+# Effective-edit bitmask: the attacks a receiver actually observes on one
+# delivery.  Disjoint edits, so leaked combinations under
+# attack_scope="broadcast" compose (e.g. forged v AND cleared P).
+DROP_BIT = 1  # action 0 with coin 0 (tfg.py:274)
+FORGE_BIT = 2  # action 1: v replaced (tfg.py:277)
+CLEAR_P_BIT = 4  # action 2 (tfg.py:281)
+CLEAR_L_BIT = 8  # action 3 (tfg.py:283)
 
-def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
-    """Draw the whole round's attack randomness from one batched stream.
 
-    Returns ``(action, coin, rand_v, late)``, each
-    ``[n_lieutenants * slots, n_lieutenants]`` indexed by
-    ``(sender * slots + slot, receiver)`` — packet-major, so the Pallas
-    round kernel reads one receiver's draws as a relayout-free lane
-    slice and no engine ever materializes a transpose:
+def raw_attack_draws(cfg: QBAConfig, k_round: jax.Array):
+    """The round's raw per-(cell, receiver) draws ``(action, coin,
+    rand_v)``, each ``[n_lieutenants * slots, n_lieutenants]`` indexed by
+    ``(sender * slots + slot, receiver)``:
 
     * ``action`` — uniform in ``{0..3}``: the 4-way dishonest choice
       (``tfg.py:272``).
@@ -91,17 +95,11 @@ def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
       (``tfg.py:274``).
     * ``rand_v`` — uniform in ``[0, nParties+1)``: the forged order for
       action 1 (``tfg.py:277`` — the reference's range, *not* ``[0,w)``).
-    * ``late`` — the racy-delivery loss flag (docs/DIVERGENCES.md D1);
-      all-False under ``delivery="sync"`` so sync and racy-with-p_late=0
-      runs are bit-identical.
 
-    The three attack variables are disjoint bit fields of one uint32
-    stream: bits 0-1 = action, bit 2 = coin, bits 3-26 = the dividend for
+    The three variables are disjoint bit fields of one uint32 stream:
+    bits 0-1 = action, bit 2 = coin, bits 3-26 = the dividend for
     ``rand_v``'s modulo (24-bit remainder bias < 2^-20 — the reference's
     own ``np.random.randint`` carries the same class of modulo bias).
-
-    All three protocol backends (jax / local / native) consume exactly
-    these arrays, so their randomness matches bit for bit.
     """
     shape = (cfg.n_lieutenants * cfg.slots, cfg.n_lieutenants)
     bits = jax.random.bits(
@@ -112,43 +110,112 @@ def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
     rand_v = (
         ((bits >> 3) & 0xFFFFFF).astype(jnp.int32) % (cfg.n_parties + 1)
     )
+    return action, coin, rand_v
+
+
+def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
+    """Draw one round's attack randomness and fold in the attack scope.
+
+    Returns ``(attack, rand_v, late)``, each
+    ``[n_lieutenants * slots, n_lieutenants]`` indexed by
+    ``(sender * slots + slot, receiver)`` — packet-major, so the Pallas
+    round kernel reads one receiver's draws as a relayout-free lane
+    slice and no engine ever materializes a transpose:
+
+    * ``attack`` — int32 bitmask of the edits this receiver observes
+      (DROP/FORGE/CLEAR_P/CLEAR_L bits above).  Under the default
+      ``attack_scope="delivery"`` at most one bit is set — the raw
+      per-recipient action, applied independently per delivery.  Under
+      ``attack_scope="broadcast"`` the forge/clear bits are the
+      *cumulative leaked state* of the reference's shared-object
+      mutations (``tfg.py:271-284``): ``P.clear()`` / ``L.clear()`` at
+      one recipient persist for every later recipient of the same
+      broadcast, and an action-1 ``v`` reassignment carries forward
+      until the next action-1 draw.  The drop bit never leaks (``sent``
+      resets per recipient, ``tfg.py:270``).
+    * ``rand_v`` — the forged order accompanying the FORGE bit; under
+      broadcast scope, the draw of the *most recent* forging recipient
+      in rank order.
+    * ``late`` — the racy-delivery loss flag (docs/DIVERGENCES.md D1);
+      all-False under ``delivery="sync"`` so sync and racy-with-p_late=0
+      runs are bit-identical.
+
+    The leak chain runs along the receiver axis in rank order, skipping
+    the sender's own column (the reference's recipient loop skips self
+    *before* drawing, ``tfg.py:267-269``).  All three protocol backends
+    (jax / local / native) consume exactly these effective arrays, so
+    their randomness matches bit for bit in either scope.
+    """
+    shape = (cfg.n_lieutenants * cfg.slots, cfg.n_lieutenants)
+    action, coin, rand_v = raw_attack_draws(cfg, k_round)
+    drop = (action == 0) & (coin == 0)
+    forge = action == 1
+    clear_p = action == 2
+    clear_l = action == 3
+    if cfg.attack_scope == "broadcast":
+        senders = jnp.arange(shape[0], dtype=jnp.int32)[:, None] // cfg.slots
+        recv = jnp.arange(cfg.n_lieutenants, dtype=jnp.int32)[None, :]
+        not_self = senders != recv
+        # Last forging recipient <= this one (rank order): running max of
+        # the forging column indices; -1 = none yet.
+        last_forge = jax.lax.cummax(
+            jnp.where(forge & not_self, recv, -1), axis=1
+        )
+        forge = last_forge >= 0
+        rand_v = jnp.take_along_axis(
+            rand_v, jnp.maximum(last_forge, 0), axis=1
+        )
+        clear_p = (
+            jax.lax.cummax((clear_p & not_self).astype(jnp.int32), axis=1) > 0
+        )
+        clear_l = (
+            jax.lax.cummax((clear_l & not_self).astype(jnp.int32), axis=1) > 0
+        )
+    attack = (
+        drop * DROP_BIT
+        + forge * FORGE_BIT
+        + clear_p * CLEAR_P_BIT
+        + clear_l * CLEAR_L_BIT
+    ).astype(jnp.int32)
     if cfg.delivery == "racy":
         late = jax.random.bernoulli(
             jax.random.fold_in(k_round, _LATE_TAG), cfg.p_late, shape
         )
     else:
         late = jnp.zeros(shape, dtype=bool)
-    return action, coin, rand_v, late
+    return attack, rand_v, late
 
 
 def corrupt_at_delivery(
     cfg: QBAConfig,
-    draws: tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    draws: tuple[jnp.ndarray, jnp.ndarray],
     packet: Packet,
     sender_honest: jnp.ndarray,
 ) -> tuple[Packet, jnp.ndarray]:
-    """Apply the 4-action attack to one delivered packet, consuming this
-    cell's ``(action, coin, rand_v)`` scalars from
+    """Apply the effective attack edits to one delivered packet, consuming
+    this cell's ``(attack, rand_v)`` scalars from
     :func:`sample_attacks_round`.
 
     Returns ``(packet', delivered)``; no-op (and always delivered) when the
     sender is honest.
     """
-    action, coin, rand_v = draws
+    attack, rand_v = draws
     biz = ~sender_honest
 
-    # Action 0: drop with probability 1/2 (tfg.py:274).
-    delivered = ~(biz & (action == 0) & (coin == 0))
+    # Drop: action 0 with coin 0 (tfg.py:274).
+    delivered = ~(biz & ((attack & DROP_BIT) != 0))
 
-    # Action 1: random order from [0, nParties+1) (tfg.py:277).
-    v = jnp.where(biz & (action == 1), rand_v, packet.v)
+    # Forged order from [0, nParties+1) (tfg.py:277).
+    v = jnp.where(biz & ((attack & FORGE_BIT) != 0), rand_v, packet.v)
 
-    # Action 2: clear P (tfg.py:281).
-    p_mask = jnp.where(biz & (action == 2), False, packet.p_mask)
+    # Clear P (tfg.py:281).
+    p_mask = jnp.where(
+        biz & ((attack & CLEAR_P_BIT) != 0), False, packet.p_mask
+    )
 
-    # Action 3: clear L (tfg.py:283).
+    # Clear L (tfg.py:283).
     empty = empty_evidence(*packet.evidence.vals.shape)
-    clear_l = biz & (action == 3)
+    clear_l = biz & ((attack & CLEAR_L_BIT) != 0)
     evidence = jax.tree.map(
         lambda e, z: jnp.where(clear_l, z, e), packet.evidence, empty
     )

@@ -22,6 +22,10 @@ import jax
 import numpy as np
 
 from qba_tpu.adversary import (
+    CLEAR_L_BIT,
+    CLEAR_P_BIT,
+    DROP_BIT,
+    FORGE_BIT,
     assign_dishonest,
     commander_orders,
     sample_attacks_round,
@@ -32,8 +36,18 @@ from qba_tpu.qsim import generate_lists_for
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from qba_tpu.obs import EventLog
 
-# tfg.py:272-284 — names for the 4-way dishonest action in the trail.
-_ACTION_NAMES = ("drop", "corrupt-v", "clear-P", "clear-L")
+# tfg.py:272-284 — effect names for the attack bitmask in the trail.
+_EFFECT_NAMES = (
+    (DROP_BIT, "drop"),
+    (FORGE_BIT, "corrupt-v"),
+    (CLEAR_P_BIT, "clear-P"),
+    (CLEAR_L_BIT, "clear-L"),
+)
+
+
+def _effects(bits: int) -> str:
+    names = [n for b, n in _EFFECT_NAMES if bits & b]
+    return "+".join(names) if names else "none"
 
 
 def _consistent(v: int, L: set, w: int) -> bool:
@@ -172,7 +186,7 @@ def run_trial_local(
     # cell — the bit-exact three-way contract.
     for rnd in range(1, cfg.n_rounds + 1):
         k_round = jax.random.fold_in(k_rounds, rnd)
-        a_act, a_coin, a_rv, a_late = (
+        a_att, a_rv, a_late = (
             np.asarray(x) for x in sample_attacks_round(cfg, k_round)
         )
         out: list[list] = [[] for _ in range(n_lieu)]
@@ -190,9 +204,8 @@ def run_trial_local(
                                 round=rnd, sender=sender + 2, recv=recv + 2,
                             )
                         continue
-                    action, coin, rand_v = (
-                        int(a_act[cell, recv]),
-                        int(a_coin[cell, recv]),
+                    bits, rand_v = (
+                        int(a_att[cell, recv]),
                         int(a_rv[cell, recv]),
                     )
                     p2, v2, ell2 = set(p), v, set(ell)
@@ -202,15 +215,15 @@ def run_trial_local(
                             log.debug(
                                 "round", "attack", trial=trial, round=rnd,
                                 sender=sender + 2, recv=recv + 2,
-                                action=_ACTION_NAMES[action],
+                                action=_effects(bits),
                             )
-                        if action == 0 and coin == 0:
+                        if bits & DROP_BIT:
                             continue
-                        if action == 1:
+                        if bits & FORGE_BIT:
                             v2 = rand_v
-                        elif action == 2:
+                        if bits & CLEAR_P_BIT:
                             p2 = set()
-                        elif action == 3:
+                        if bits & CLEAR_L_BIT:
                             ell2 = set()
                     # lieu_receive (tfg.py:289-300)
                     ell2.add(tuple(li[recv][j] for j in sorted(p2)))

@@ -8,7 +8,7 @@ the reference's tagged-MPI transport (``tfg.py:199-263``).
 
 Randomness is pre-sampled here with the *identical* key tree the other
 two backends consume (dishonesty, lists, orders, per-(round, receiver,
-cell) attack + late-loss quads), so for any config and trial key all
+cell) attack + late-loss triples), so for any config and trial key all
 three implementations must produce identical decisions and verdicts —
 ``tests/test_native.py`` enforces the three-way match.
 """
@@ -46,13 +46,13 @@ def _u8(a: np.ndarray):
 
 
 @functools.partial(jax.jit, static_argnums=0)
-def _attack_quads(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
-    """int32[n_rounds, n_lieu, n_lieu*slots, 4] — the (action, coin,
-    rand_v, late) draws for every delivery cell: the same batched
+def _attack_triples(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
+    """int32[n_rounds, n_lieu, n_lieu*slots, 3] — the (attack, rand_v,
+    late) effective draws for every delivery cell: the same batched
     per-round arrays of :func:`sample_attacks_round` the other two
-    backends consume (bit-exact three-way contract).  ``late`` is the
-    racy-delivery loss flag (docs/DIVERGENCES.md D1), all-zero under
-    ``delivery="sync"``."""
+    backends consume (bit-exact three-way contract, attack scope folded
+    in).  ``late`` is the racy-delivery loss flag (docs/DIVERGENCES.md
+    D1), all-zero under ``delivery="sync"``."""
     def one_round(r):
         draws = sample_attacks_round(cfg, jax.random.fold_in(k_rounds, r))
         # Draws are packet-major [n_pk, n_lieu]; the C ABI keeps the
@@ -93,7 +93,7 @@ def _batch_presample(cfg: QBAConfig, keys: jax.Array):
         honest = assign_dishonest(cfg, k_dis)
         lists = generate_lists_for(cfg, k_lists)[0]
         v_sent, v_comm = commander_orders(cfg, k_comm, honest[1])
-        return honest, lists, v_sent, v_comm, _attack_quads(cfg, k_rounds)
+        return honest, lists, v_sent, v_comm, _attack_triples(cfg, k_rounds)
 
     return jax.vmap(one)(keys)
 

@@ -59,6 +59,13 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
         "loss with prob --p-late (docs/DIVERGENCES.md D1)",
     )
     p.add_argument("--p-late", type=float, default=0.0)
+    p.add_argument(
+        "--attack-scope", choices=("delivery", "broadcast"),
+        default="delivery",
+        help="broadcast = reproduce the reference's shared-object "
+        "mutation leak across a broadcast's recipients "
+        "(tfg.py:271-284, docs/DIVERGENCES.md D3)",
+    )
 
 
 def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
@@ -72,6 +79,7 @@ def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
         round_engine=args.round_engine,
         delivery=args.delivery,
         p_late=args.p_late,
+        attack_scope=args.attack_scope,
     )
 
 

@@ -51,6 +51,13 @@ class QBAConfig:
         independent per-(packet, receiver) loss with probability
         ``p_late``.  See docs/DIVERGENCES.md D1.
       p_late: per-delivery lateness probability under ``delivery="racy"``.
+      attack_scope: "delivery" (default) — each dishonest delivery draws
+        an independent attack action, the intended per-recipient law; or
+        "broadcast" — reproduce the reference's *actual* shared-object
+        mutation semantics (``tfg.py:271-284``): ``P.clear()`` /
+        ``L.clear()`` at one recipient of a broadcast leak into every
+        later recipient, and a forged ``v`` persists until re-forged.
+        See docs/DIVERGENCES.md D3.
     """
 
     n_parties: int
@@ -63,6 +70,7 @@ class QBAConfig:
     delivery: str = "sync"
     p_late: float = 0.0
     round_engine: str = "auto"
+    attack_scope: str = "delivery"
 
     def __post_init__(self) -> None:
         if self.n_parties < 2:
@@ -92,6 +100,8 @@ class QBAConfig:
             raise ValueError("p_late > 0 requires delivery='racy'")
         if self.round_engine not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown round_engine {self.round_engine!r}")
+        if self.attack_scope not in ("delivery", "broadcast"):
+            raise ValueError(f"unknown attack_scope {self.attack_scope!r}")
 
     # Derived parameters (``tfg.py:316-318``).
     @property

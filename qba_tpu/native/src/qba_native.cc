@@ -9,7 +9,7 @@
 // full trial over per-party mailboxes (tfg.py:166-363).
 //
 // Randomness is pre-sampled by the caller (honesty mask, particle lists,
-// commander orders, per-cell attack/late-loss quads) so the engine is a
+// commander orders, per-cell attack/late-loss triples) so the engine is a
 // deterministic function — bit-compatible with both Python backends for
 // the same key tree; tests/test_native.py enforces the three-way match.
 //
@@ -161,13 +161,15 @@ int qba_decode_pvl(const int32_t* buf, int len, int32_t* p_out, int np_cap,
 //   lists    : int32[(n_parties+1) * size_l], row-major
 //   v_sent   : int32[n_lieu] per-lieutenant commander order (equivocation
 //              already applied, tfg.py:169-181)
-//   attacks  : int32[n_rounds * n_lieu * n_lieu * slots * 4] — per
-//              (round-1, receiver, sender*slots+slot) quads
-//              (action, coin, rand_v, late): the sample_attacks_round layout
-//              plus the racy-delivery late-loss flag (late=1 -> the
-//              delivery is silently lost before any corruption, the
-//              barrier-race model of docs/DIVERGENCES.md D1; all 0 under
-//              delivery="sync")
+//   attacks  : int32[n_rounds * n_lieu * n_lieu * slots * 3] — per
+//              (round-1, receiver, sender*slots+slot) triples
+//              (attack, rand_v, late): the sample_attacks_round layout.
+//              `attack` is the effective edit bitmask (bit0 drop, bit1
+//              forge-v, bit2 clear-P, bit3 clear-L) with the configured
+//              attack scope already folded in, so this engine is
+//              scope-agnostic; `late` = 1 -> the delivery is silently
+//              lost before any corruption (the barrier-race model of
+//              docs/DIVERGENCES.md D1; all 0 under delivery="sync")
 //   decisions_out : int32[n_parties] (index 0 = commander)
 //   vi_out   : uint8[n_lieu * w] accepted-set masks
 //   flags_out: int32[2] = {success, overflow}
@@ -248,13 +250,13 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
           const int32_t* a =
               attacks + (((rnd - 1) * n_lieu + recv) * n_lieu * slots +
                          sender * slots + slot) *
-                            4;
-          if (a[3]) continue;  // racy late loss (DIVERGENCES.md D1)
+                            3;
+          if (a[2]) continue;  // racy late loss (DIVERGENCES.md D1)
           if (!honest[sender + 2]) {  // tfg.py:271-284
-            if (a[0] == 0 && a[1] == 0) continue;  // drop
-            if (a[0] == 1) pk.v = a[2];            // corrupt v
-            else if (a[0] == 2) pk.p.clear();      // clear P
-            else if (a[0] == 3) pk.L.clear();      // clear L
+            if (a[0] & 1) continue;       // drop
+            if (a[0] & 2) pk.v = a[1];    // forged v
+            if (a[0] & 4) pk.p.clear();   // clear P
+            if (a[0] & 8) pk.L.clear();   // clear L
           }
           // lieu_receive (tfg.py:289-300)
           pk.L.insert(own_sublist(recv, pk.p));
@@ -315,7 +317,7 @@ int qba_run_trials(int n_trials, int n_threads, int n_parties, int size_l,
   const size_t lists_s = honest_s * size_l;
   const size_t vsent_s = n_lieu;
   const size_t att_s = static_cast<size_t>(n_rounds) * n_lieu * n_lieu *
-                       slots * 4;
+                       slots * 3;
   const size_t dec_s = n_parties;
   const size_t vi_s = static_cast<size_t>(n_lieu) * w;
 

@@ -19,11 +19,17 @@ Design (see ``/opt/skills/guides/pallas_guide.md``):
   row at once.
 * **Row-qubit gates** are sublane butterflies on the VPU: the partner
   amplitude ``state[r ^ 2**rbs]`` is two static rolls selected by the
-  target bit; controls become iota bit-masks.
-* **Real arithmetic** — every gate the protocol circuits use (H, X/CNOT,
-  parameterized X**b; ``tfg.py:17-39``) is real-valued and the initial
-  state is |0..0>, so the state is ``float32``, not complex: half the
-  memory and FLOPs of the complex engine.
+  target bit; controls become iota bit-masks.  H/X/XPOW keep their
+  add-only fast paths; every other 2x2 gate uses the generic coefficient
+  form ``new = c_s * state + c_p * partner`` with per-target-bit matrix
+  entries.
+* **Real fast path** — when every gate in the circuit is real-valued
+  (H, X/CNOT, Z/CZ, RY, parameterized X**b — all the protocol circuits
+  use, ``tfg.py:17-39``) and the initial state is |0..0>, the state
+  stays ``float32``: half the memory and FLOPs of the complex engine.
+  Circuits with complex gates (Y, S, T, RX, RZ, P) run the same kernel
+  on a dual (real, imag) float32 state pair — complex64 results without
+  complex arithmetic inside the kernel.
 * **Data-dependent encodings** — the reference rebuilds the Q-correlated
   circuit per list position with fresh ``rands`` (``tfg.py:30-37``); here
   the permutation bits arrive as an int32 param vector in SMEM, so ONE
@@ -42,11 +48,6 @@ from jax.experimental.pallas import tpu as pltpu
 
 _INV_SQRT2 = float(1.0 / np.sqrt(2.0))
 
-_H2 = np.asarray([[1.0, 1.0], [1.0, -1.0]], dtype=np.float32) * np.float32(
-    _INV_SQRT2
-)
-_X2 = np.asarray([[0.0, 1.0], [1.0, 0.0]], dtype=np.float32)
-
 
 @dataclasses.dataclass(frozen=True)
 class _LaneOp:
@@ -55,17 +56,21 @@ class _LaneOp:
     mat_idx: int  # index into the stacked [K, L, L] matrices
     param: int | None  # param index for X**b, None for fixed gates
     row_ctrl_shifts: tuple[int, ...]  # row-qubit controls (iota bit tests)
+    has_imag: bool  # the lane matrix has a nonzero imaginary part
 
 
 @dataclasses.dataclass(frozen=True)
 class _RowOp:
     """Gate whose target sits in the row dimension -> sublane butterfly."""
 
-    kind: str  # "H" | "X" | "XPOW"
+    kind: str  # "H" | "X" | "XPOW" | "GEN"
     rbs: int  # target bit shift within the row index
     param: int | None
     row_ctrl_shifts: tuple[int, ...]
     lane_ctrl_shifts: tuple[int, ...]
+    # 2x2 matrix entries for the generic coefficient form (kind "GEN"),
+    # as (real, imag) python floats baked into the kernel.
+    g2: tuple[tuple[complex, ...], ...] | None = None
 
 
 def _lane_matrix(
@@ -73,7 +78,7 @@ def _lane_matrix(
 ) -> np.ndarray:
     """Dense ``[L, L]`` matrix of ``gate2`` on lane-bit ``t_shift``,
     controlled on lane bits ``ctrl_shifts`` (identity elsewhere)."""
-    mat = np.zeros((lanes, lanes), dtype=np.float32)
+    mat = np.zeros((lanes, lanes), dtype=np.complex64)
     for col in range(lanes):
         if all((col >> c) & 1 for c in ctrl_shifts):
             in_bit = (col >> t_shift) & 1
@@ -88,11 +93,14 @@ def _lane_matrix(
 def build_fused_circuit_run(
     n_qubits: int, ops, n_params: int, *, interpret: bool = False
 ):
-    """Compile a static op list into ``run(params) -> float32[2**n]``.
+    """Compile a static op list into ``run(params) -> statevector[2**n]``.
 
     ``ops`` is a sequence of :class:`qba_tpu.qsim.circuit.Op`; the returned
-    function is jit/vmap-safe and returns the final (real) statevector.
+    function is jit/vmap-safe.  The result dtype is float32 for all-real
+    circuits and complex64 when any gate is complex (see module docs).
     """
+    from qba_tpu.qsim.statevector import gate_matrix
+
     lane_bits = min(n_qubits, 7)
     lanes = 1 << lane_bits
     n_rows = 1 << (n_qubits - lane_bits)
@@ -106,8 +114,8 @@ def build_fused_circuit_run(
         return False, flat - lane_bits
 
     plan: list[_LaneOp | _RowOp] = []
-    mats0: list[np.ndarray] = []
-    mats_d: list[np.ndarray] = []
+    mats0: list[np.ndarray] = []  # complex64 [L, L]
+    mats_d: list[np.ndarray] = []  # real XPOW deltas, complex64 for stacking
     for op in ops:
         t_lane, t_shift = bit_shift(op.target)
         lane_cs = tuple(
@@ -116,25 +124,66 @@ def build_fused_circuit_run(
         row_cs = tuple(
             s for c in op.controls for is_l, s in (bit_shift(c),) if not is_l
         )
-        if t_lane:
-            gate2 = _H2 if op.kind == "H" else _X2
-            full = _lane_matrix(gate2, t_shift, lane_cs, lanes)
-            if op.kind == "XPOW":
-                mats0.append(np.eye(lanes, dtype=np.float32))
-                mats_d.append(full - np.eye(lanes, dtype=np.float32))
-            else:
-                mats0.append(full)
-                mats_d.append(np.zeros((lanes, lanes), dtype=np.float32))
-            plan.append(_LaneOp(len(mats0) - 1, op.param, row_cs))
+        if op.kind == "XPOW":
+            g2 = None  # runtime-parameterized; handled specially below
         else:
-            plan.append(_RowOp(op.kind, t_shift, op.param, row_cs, lane_cs))
+            g2 = gate_matrix(op.kind, op.angle)
+        if t_lane:
+            if op.kind == "XPOW":
+                base = gate_matrix("X")
+                full = _lane_matrix(base, t_shift, lane_cs, lanes)
+                mats0.append(np.eye(lanes, dtype=np.complex64))
+                mats_d.append(full - np.eye(lanes, dtype=np.complex64))
+            else:
+                full = _lane_matrix(g2, t_shift, lane_cs, lanes)
+                mats0.append(full)
+                mats_d.append(np.zeros((lanes, lanes), np.complex64))
+            has_imag = bool(
+                np.any(mats0[-1].imag) or np.any(mats_d[-1].imag)
+            )
+            plan.append(_LaneOp(len(mats0) - 1, op.param, row_cs, has_imag))
+        else:
+            if op.kind in ("H", "X", "XPOW"):
+                plan.append(
+                    _RowOp(op.kind, t_shift, op.param, row_cs, lane_cs)
+                )
+            else:
+                entries = tuple(
+                    tuple(complex(g2[i, j]) for j in (0, 1)) for i in (0, 1)
+                )
+                plan.append(
+                    _RowOp("GEN", t_shift, None, row_cs, lane_cs, entries)
+                )
+
+    def _op_is_real(op) -> bool:
+        if isinstance(op, _LaneOp):
+            return not op.has_imag
+        if op.kind == "GEN":
+            return all(e.imag == 0.0 for row in op.g2 for e in row)
+        return True  # H / X / XPOW
+
+    is_real = all(_op_is_real(op) for op in plan)
 
     # Stacked constants (>=1 entry so the kernel signature is static).
-    m0 = np.stack(mats0) if mats0 else np.eye(lanes, dtype=np.float32)[None]
-    md = np.stack(mats_d) if mats_d else np.zeros((1, lanes, lanes), np.float32)
+    m0 = np.stack(mats0) if mats0 else np.eye(lanes, dtype=np.complex64)[None]
+    md = (
+        np.stack(mats_d)
+        if mats_d
+        else np.zeros((1, lanes, lanes), np.complex64)
+    )
+    m0r, m0i = m0.real.astype(np.float32), m0.imag.astype(np.float32)
+    mdr = md.real.astype(np.float32)  # XPOW deltas are always real
     n_params = max(n_params, 1)
 
-    def kernel(params_ref, m0_ref, md_ref, out_ref):
+    def kernel(params_ref, m0r_ref, *rest):
+        # The all-zero imaginary matrix stack is only an input on the
+        # complex path — the real fast path never reads it, so shipping
+        # it would be pure VMEM/bandwidth waste on the protocol circuits.
+        if is_real:
+            (mdr_ref, *out_refs) = rest
+            m0i_ref = None
+        else:
+            (m0i_ref, mdr_ref, *out_refs) = rest
         row_iota = jax.lax.broadcasted_iota(jnp.int32, (n_rows, lanes), 0)
         lane_iota = jax.lax.broadcasted_iota(jnp.int32, (n_rows, lanes), 1)
 
@@ -146,54 +195,102 @@ def build_fused_circuit_run(
                 mask &= ((lane_iota >> c) & 1) == 1
             return mask
 
-        # |0...0>
-        state = jnp.where(
-            (row_iota == 0) & (lane_iota == 0), 1.0, 0.0
-        ).astype(jnp.float32)
+        # |0...0>: real amplitude 1 at index 0, imag identically 0.
+        x = jnp.where((row_iota == 0) & (lane_iota == 0), 1.0, 0.0).astype(
+            jnp.float32
+        )
+        y = None if is_real else jnp.zeros((n_rows, lanes), jnp.float32)
+
+        def masked(op, new_x, new_y, old_x, old_y, lane_ctrls=()):
+            cs = op.row_ctrl_shifts, lane_ctrls
+            if not (cs[0] or cs[1]):
+                return new_x, new_y
+            mask = ctrl_mask(*cs)
+            out_x = jnp.where(mask, new_x, old_x)
+            out_y = (
+                None if old_y is None else jnp.where(mask, new_y, old_y)
+            )
+            return out_x, out_y
 
         for op in plan:  # static unroll: the circuit IS the kernel
             if isinstance(op, _LaneOp):
-                mat = m0_ref[op.mat_idx]
+                ar = m0r_ref[op.mat_idx]
                 if op.param is not None:
                     b = params_ref[op.param].astype(jnp.float32)
-                    mat = mat + b * md_ref[op.mat_idx]
-                new = jnp.dot(state, mat.T, preferred_element_type=jnp.float32)
-                if op.row_ctrl_shifts:
-                    state = jnp.where(ctrl_mask(op.row_ctrl_shifts, ()), new, state)
+                    ar = ar + b * mdr_ref[op.mat_idx]
+                if is_real:
+                    new_x = jnp.dot(
+                        x, ar.T, preferred_element_type=jnp.float32
+                    )
+                    new_y = None
                 else:
-                    state = new
+                    ai = m0i_ref[op.mat_idx]
+                    new_x = jnp.dot(
+                        x, ar.T, preferred_element_type=jnp.float32
+                    ) - jnp.dot(y, ai.T, preferred_element_type=jnp.float32)
+                    new_y = jnp.dot(
+                        y, ar.T, preferred_element_type=jnp.float32
+                    ) + jnp.dot(x, ai.T, preferred_element_type=jnp.float32)
+                x, y = masked(op, new_x, new_y, x, y)
             else:
                 stride = 1 << op.rbs
                 # partner[r] = state[r ^ stride]: two static rolls selected
                 # by the target bit (no dynamic gathers on TPU).
                 bit = ((row_iota >> op.rbs) & 1) == 1
-                up = jnp.concatenate([state[stride:], state[:stride]], axis=0)
-                down = jnp.concatenate([state[-stride:], state[:-stride]], axis=0)
-                partner = jnp.where(bit, down, up)
+
+                def roll_partner(s):
+                    up = jnp.concatenate([s[stride:], s[:stride]], axis=0)
+                    down = jnp.concatenate([s[-stride:], s[:-stride]], axis=0)
+                    return jnp.where(bit, down, up)
+
+                px = roll_partner(x)
+                py = None if y is None else roll_partner(y)
                 if op.kind == "H":
-                    new = jnp.where(bit, partner - state, state + partner) * _INV_SQRT2
+                    new_x = (
+                        jnp.where(bit, px - x, x + px) * _INV_SQRT2
+                    )
+                    new_y = (
+                        None
+                        if y is None
+                        else jnp.where(bit, py - y, y + py) * _INV_SQRT2
+                    )
                 elif op.kind == "X":
-                    new = partner
-                else:  # XPOW
+                    new_x, new_y = px, py
+                elif op.kind == "XPOW":
                     flip = params_ref[op.param] != 0
-                    new = jnp.where(flip, partner, state)
-                if op.row_ctrl_shifts or op.lane_ctrl_shifts:
-                    mask = ctrl_mask(op.row_ctrl_shifts, op.lane_ctrl_shifts)
-                    state = jnp.where(mask, new, state)
-                else:
-                    state = new
+                    new_x = jnp.where(flip, px, x)
+                    new_y = None if y is None else jnp.where(flip, py, y)
+                else:  # GEN: new = c_s * state + c_p * partner
+                    (m00, m01), (m10, m11) = op.g2
+                    csr = jnp.where(bit, m11.real, m00.real)
+                    cpr = jnp.where(bit, m10.real, m01.real)
+                    if is_real:
+                        new_x = csr * x + cpr * px
+                        new_y = None
+                    else:
+                        csi = jnp.where(bit, m11.imag, m00.imag)
+                        cpi = jnp.where(bit, m10.imag, m01.imag)
+                        new_x = csr * x - csi * y + cpr * px - cpi * py
+                        new_y = csi * x + csr * y + cpi * px + cpr * py
+                x, y = masked(op, new_x, new_y, x, y, op.lane_ctrl_shifts)
 
-        out_ref[:] = state
+        out_refs[0][:] = x
+        if not is_real:
+            out_refs[1][:] = y
 
+    n_out = 1 if is_real else 2
+    n_in = 3 if is_real else 4  # params + m0r [+ m0i] + mdr
     call = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n_rows, lanes), jnp.float32),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((n_rows, lanes), jnp.float32)
+            for _ in range(n_out)
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * (n_in - 1),
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n_out)
+        ),
         interpret=interpret,
     )
 
@@ -201,6 +298,10 @@ def build_fused_circuit_run(
         if params is None:
             params = jnp.zeros((n_params,), dtype=jnp.int32)
         params = jnp.asarray(params, dtype=jnp.int32)
-        return call(params, m0, md).reshape(-1)
+        if is_real:
+            out = call(params, m0r, mdr)
+            return out[0].reshape(-1)
+        out = call(params, m0r, m0i, mdr)
+        return jax.lax.complex(out[0], out[1]).reshape(-1)
 
     return run

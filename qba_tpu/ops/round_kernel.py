@@ -36,6 +36,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from qba_tpu.adversary import (
+    CLEAR_L_BIT,
+    CLEAR_P_BIT,
+    DROP_BIT,
+    FORGE_BIT,
+)
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
 
@@ -65,9 +71,12 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
     """Compile one synchronous voting round for one trial.
 
     Returns ``step(round_idx, vals, lens, count, p, v, sent, li, vi,
-    honest_pk, action, coin, rand_v, late) -> (ovals, olens, ocount, op,
+    honest_pk, attack, rand_v, late) -> (ovals, olens, ocount, op,
     ov, osent, ovi, overflow)`` — jit/vmap-safe (vmap over trials becomes
-    the Pallas grid).
+    the Pallas grid).  ``attack`` is the effective edit bitmask from
+    :func:`qba_tpu.adversary.sample_attacks_round` (bit0 drop, bit1
+    forge-v, bit2 clear-P, bit3 clear-L) — scope semantics are folded in
+    before the kernel, so the kernel algebra is scope-agnostic.
     """
     n_s, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
     size_l, w = cfg.size_l, cfg.w
@@ -88,8 +97,7 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         li_ref,  # [n_lieu, size_l]
         vi_ref,  # [n_lieu, w]
         honest_ref,  # [n_pk, 1]
-        act_ref,  # [n_pk, n_lieu] (packet-major; see receiver loop)
-        coin_ref,
+        act_ref,  # [n_pk, n_lieu] edit bitmasks (packet-major)
         rv_ref,
         late_ref,
         ovals_ref,
@@ -171,14 +179,13 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         # flag is computed for all receivers in one tile op; the unrolled
         # receiver loop below consumes relayout-free lane slices.
         act_all = act_ref[:]  # [n_pk, n_lieu]
-        coin_all = coin_ref[:]
         rv_all = rv_ref[:]
         late_all = late_ref[:]
         lane_recv = jax.lax.broadcasted_iota(jnp.int32, (n_pk, n_s), 1)
-        dropped_all = biz & (act_all == 0) & (coin_all == 0)
-        v2_all = jnp.where(biz & (act_all == 1), rv_all, v_in)
-        clearp_all = biz & (act_all == 2)
-        clearl_all = biz & (act_all == 3)
+        dropped_all = biz & ((act_all & DROP_BIT) != 0)
+        v2_all = jnp.where(biz & ((act_all & FORGE_BIT) != 0), rv_all, v_in)
+        clearp_all = biz & ((act_all & CLEAR_P_BIT) != 0)
+        clearl_all = biz & ((act_all & CLEAR_L_BIT) != 0)
         delivered_all = (
             ~dropped_all & (late_all == 0) & sent & (sender_col != lane_recv)
         )
@@ -397,7 +404,7 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         kernel,
         out_shape=out_shapes,
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
-        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 13,
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 12,
         out_specs=tuple(
             pl.BlockSpec(memory_space=pltpu.VMEM) for _ in out_shapes
         ),
@@ -412,13 +419,13 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
     )
 
     def step(round_idx, vals, lens, count, p, v, sent, li, vi, honest_pk,
-             action, coin, rand_v, late):
+             attack, rand_v, late):
         # Draws arrive packet-major [n_pk, n_lieu] straight from
         # sample_attacks_round — no transpose anywhere on the path.
         return call(
             jnp.asarray([round_idx], jnp.int32),
             vals, lens, count, p, v, sent, li, vi, honest_pk,
-            action, coin, rand_v, late,
+            attack, rand_v, late,
         )
 
     return step

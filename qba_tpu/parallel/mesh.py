@@ -114,7 +114,26 @@ def make_hybrid_mesh(
         )
     if n_slices <= 1:
         return make_mesh(dict(ici_axes), devices=devices)
+    dev_array = hybrid_device_array(
+        ici_axes, dcn_axis=dcn_axis, n_slices=n_slices, devices=devices
+    )
+    return Mesh(dev_array, tuple(ici_axes.keys()))
 
+
+def hybrid_device_array(
+    ici_axes: Mapping[str, int],
+    *,
+    dcn_axis: str,
+    n_slices: int,
+    devices: Sequence,
+) -> np.ndarray:
+    """The device layout behind :func:`make_hybrid_mesh` (factored out so
+    the multi-slice branch is unit-testable with mock devices carrying
+    ``slice_index`` — real multi-slice hardware is not available in CI).
+
+    Returns an object ndarray shaped like the final mesh: the ``ici_axes``
+    sizes with ``dcn_axis`` multiplied by ``n_slices``.
+    """
     shape = dict(ici_axes)
     names = tuple(shape.keys())
     sizes = tuple(shape.values())
@@ -127,12 +146,11 @@ def make_hybrid_mesh(
         from jax.experimental import mesh_utils
 
         dcn_shape = {a: (n_slices if a == dcn_axis else 1) for a in shape}
-        dev_array = mesh_utils.create_hybrid_device_mesh(
+        return mesh_utils.create_hybrid_device_mesh(
             sizes,
             dcn_mesh_shape=tuple(dcn_shape.values()),
             devices=devices,
         )
-        return Mesh(dev_array, names)
 
     # Devices without slice metadata (the virtual CPU test mesh): treat
     # contiguous blocks as slices — the dcn factor varies slowest along
@@ -142,7 +160,7 @@ def make_hybrid_mesh(
     dev_array = np.moveaxis(dev_array, 0, i)
     final = list(sizes)
     final[i] = sizes[i] * n_slices
-    return Mesh(dev_array.reshape(final), names)
+    return dev_array.reshape(final)
 
 
 def default_mesh_shape(n_devices: int, *, want_tp: bool = False) -> dict[str, int]:

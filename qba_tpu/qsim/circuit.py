@@ -24,14 +24,23 @@ import jax.numpy as jnp
 from qba_tpu.qsim import statevector as sv
 
 
+# Fixed gates (no angle), rotation families (static angle), and the
+# runtime-parameterized XPOW (the only data-dependent gate the protocol
+# needs, tfg.py:30-37).  Controlled variants of all of them come from
+# ``controls`` — CNOT = X + control, CZ = Z + control.
+FIXED_GATES = ("H", "X", "Y", "Z", "S", "T")
+ROTATION_GATES = ("RX", "RY", "RZ", "P")
+
+
 @dataclasses.dataclass(frozen=True)
 class Op:
     """One primitive operation (static description)."""
 
-    kind: str  # "H" | "X" | "XPOW"
+    kind: str  # one of FIXED_GATES | ROTATION_GATES | "XPOW"
     target: int
     controls: tuple[int, ...] = ()
     param: int | None = None  # index into the runtime param vector (XPOW)
+    angle: float | None = None  # static angle (rotation gates only)
 
 
 @dataclasses.dataclass
@@ -49,11 +58,16 @@ class Gate:
         targets: int,
         controls: int | tuple[int, ...] | None = None,
         param: int | None = None,
+        angle: float | None = None,
     ) -> "Gate":
-        if kind not in ("H", "X", "XPOW"):
+        if kind not in (*FIXED_GATES, *ROTATION_GATES, "XPOW"):
             raise ValueError(f"unsupported gate kind {kind!r}")
         if kind == "XPOW" and param is None:
             raise ValueError("XPOW requires a param index")
+        if kind in ROTATION_GATES and angle is None:
+            raise ValueError(f"{kind} requires an angle")
+        if kind not in ROTATION_GATES and angle is not None:
+            raise ValueError(f"{kind} takes no angle")
         ctrls: tuple[int, ...]
         if controls is None:
             ctrls = ()
@@ -66,7 +80,7 @@ class Gate:
                 raise ValueError(f"qubit {q} out of range for {self.n_qubits}-qubit gate")
         if targets in ctrls:
             raise ValueError("target cannot also be a control")
-        self.ops.append(Op(kind, targets, ctrls, param))
+        self.ops.append(Op(kind, targets, ctrls, param, angle))
         return self
 
 
@@ -100,8 +114,10 @@ class Circuit:
 
         * ``"xla"`` — per-gate axis algebra, complex64.
         * ``"pallas"`` — the fused single-kernel executor
-          (:func:`qba_tpu.ops.build_fused_circuit_run`), float32 (every
-          supported gate is real).
+          (:func:`qba_tpu.ops.build_fused_circuit_run`): float32 when
+          every gate in the circuit is real-valued (the protocol
+          circuits; half the memory and FLOPs), complex64 via a dual
+          real/imag state otherwise.
         * ``"pallas_interpret"`` — same kernel in interpreter mode (runs
           on any backend; used by the CPU test suite).
         """
@@ -125,7 +141,7 @@ class Circuit:
                 if op.kind == "XPOW":
                     mat = sv.xpow_matrix(params[op.param])
                 else:
-                    mat = sv.GATES[op.kind]
+                    mat = sv.gate_matrix(op.kind, op.angle)
                 if op.controls:
                     state = sv.apply_controlled_1q(state, mat, op.target, op.controls)
                 else:
@@ -147,5 +163,23 @@ class Circuit:
         def run(key: jax.Array, params: jnp.ndarray | None = None) -> jnp.ndarray:
             state = state_fn(params)
             return sv.measure_all(state.reshape((2,) * n), key)
+
+        return run
+
+    def compile_shots(self, impl: str = "xla"):
+        """Build ``run(key, shots, params=None) -> int32 bits[shots, n]``.
+
+        Multi-shot batching: the statevector is prepared ONCE and only
+        the Born sampling batches over shots (``shots`` must be static
+        under jit).
+        """
+        n = self.n_qubits
+        state_fn = self.compile_state(impl)
+
+        def run(
+            key: jax.Array, shots: int, params: jnp.ndarray | None = None
+        ) -> jnp.ndarray:
+            state = state_fn(params)
+            return sv.measure_shots(state.reshape((2,) * n), key, shots)
 
         return run

@@ -44,11 +44,15 @@ class QGate:
     def name(self) -> str:
         return self._gate.name
 
-    def add_operation(self, kind, *, targets, controls=None, outputs=None):
+    def add_operation(
+        self, kind, *, targets, controls=None, outputs=None, angle=None
+    ):
         if outputs is not None:
             raise ValueError("outputs= only applies to MEASURE ops on a "
                              "QCircuit")
-        self._gate.add_operation(kind, targets=targets, controls=controls)
+        self._gate.add_operation(
+            kind, targets=targets, controls=controls, angle=angle
+        )
         return self
 
 
@@ -73,7 +77,9 @@ class QCircuit:
     def n_qubits(self) -> int:
         return self._circ.n_qubits
 
-    def add_operation(self, op, *, targets=None, controls=None, outputs=None):
+    def add_operation(
+        self, op, *, targets=None, controls=None, outputs=None, angle=None
+    ):
         if op == "MEASURE":
             if targets is None:
                 raise ValueError("MEASURE requires targets=")
@@ -98,7 +104,7 @@ class QCircuit:
             raise ValueError(f"gate {op!r} requires targets=")
         self._circ.add_operation(
             Gate(self._circ.n_qubits).add_operation(
-                op, targets=targets, controls=controls
+                op, targets=targets, controls=controls, angle=angle
             )
         )
         return self
@@ -131,10 +137,14 @@ class Drewom:
         struct = circuit._structure()
         run = self._programs.get(struct)
         if run is None:
-            run = jax.jit(jax.vmap(circuit._circ.compile()))
+            # Multi-shot batching: the state is prepared once and only
+            # the Born sampling batches over shots (compile_shots).
+            run = jax.jit(
+                circuit._circ.compile_shots(), static_argnums=1
+            )
             self._programs[struct] = run
         self._key, k = jax.random.split(self._key)
-        # One batched dispatch + one host transfer for all shots.
-        bits = jax.device_get(run(jax.random.split(k, shots)))
+        # One dispatch + one host transfer for all shots.
+        bits = jax.device_get(run(k, shots))
         order = list(circuit._measure_order())
         return [[int(b) for b in row[order]] for row in bits]

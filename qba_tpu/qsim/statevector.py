@@ -27,9 +27,60 @@ import numpy as np
 _SQRT2 = np.sqrt(2.0).astype(np.float32)
 H = np.asarray([[1.0, 1.0], [1.0, -1.0]], dtype=np.complex64) / _SQRT2
 X = np.asarray([[0.0, 1.0], [1.0, 0.0]], dtype=np.complex64)
+Y = np.asarray([[0.0, -1.0j], [1.0j, 0.0]], dtype=np.complex64)
+Z = np.asarray([[1.0, 0.0], [0.0, -1.0]], dtype=np.complex64)
+S = np.asarray([[1.0, 0.0], [0.0, 1.0j]], dtype=np.complex64)
+T = np.asarray(
+    [[1.0, 0.0], [0.0, np.exp(0.25j * np.pi)]], dtype=np.complex64
+)
 I2 = np.eye(2, dtype=np.complex64)
 
-GATES = {"H": H, "X": X, "I": I2}
+GATES = {"H": H, "X": X, "Y": Y, "Z": Z, "S": S, "T": T, "I": I2}
+
+# Parameterized single-qubit families (static angle -> constant matrix).
+_ROTATIONS = {
+    "RX": lambda t: np.asarray(
+        [
+            [np.cos(t / 2), -1j * np.sin(t / 2)],
+            [-1j * np.sin(t / 2), np.cos(t / 2)],
+        ],
+        dtype=np.complex64,
+    ),
+    "RY": lambda t: np.asarray(
+        [
+            [np.cos(t / 2), -np.sin(t / 2)],
+            [np.sin(t / 2), np.cos(t / 2)],
+        ],
+        dtype=np.complex64,
+    ),
+    "RZ": lambda t: np.asarray(
+        [[np.exp(-0.5j * t), 0.0], [0.0, np.exp(0.5j * t)]],
+        dtype=np.complex64,
+    ),
+    "P": lambda t: np.asarray(
+        [[1.0, 0.0], [0.0, np.exp(1j * t)]], dtype=np.complex64
+    ),
+}
+
+
+def gate_matrix(kind: str, angle: float | None = None) -> np.ndarray:
+    """Static 2x2 matrix for a gate kind.
+
+    Fixed gates (H/X/Y/Z/S/T) take no angle; rotation families
+    (RX/RY/RZ/P) require one.  CZ/CNOT/any controlled gate are expressed
+    as the base gate plus ``controls`` at the circuit layer.  Runtime
+    data-dependent gates stay with the XPOW param mechanism
+    (``tfg.py:30-37``), which this function deliberately excludes.
+    """
+    if kind in GATES:
+        if angle is not None:
+            raise ValueError(f"gate {kind!r} takes no angle")
+        return GATES[kind]
+    if kind in _ROTATIONS:
+        if angle is None:
+            raise ValueError(f"gate {kind!r} requires an angle")
+        return _ROTATIONS[kind](float(angle))
+    raise ValueError(f"unknown gate kind {kind!r}")
 
 
 def init_state(n: int) -> jnp.ndarray:
@@ -81,6 +132,20 @@ def measure_all(state: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
     idx = jax.random.categorical(key, jnp.log(probs))
     shifts = jnp.arange(n - 1, -1, -1, dtype=jnp.int32)
     return ((idx >> shifts) & 1).astype(jnp.int32)
+
+
+def measure_shots(state: jnp.ndarray, key: jax.Array, shots: int) -> jnp.ndarray:
+    """``shots`` independent computational-basis samples from ONE state.
+
+    Returns int32 bits ``[shots, n]``.  The state is prepared once and
+    only the Born sampling batches — the multi-shot analog of qsimov's
+    repeated ``Drewom`` executions without re-simulating the circuit.
+    """
+    n = state.ndim
+    probs = jnp.abs(state.reshape(-1)) ** 2
+    idx = jax.random.categorical(key, jnp.log(probs), shape=(shots,))
+    shifts = jnp.arange(n - 1, -1, -1, dtype=jnp.int32)
+    return ((idx[:, None] >> shifts[None, :]) & 1).astype(jnp.int32)
 
 
 def xpow_matrix(bit: jnp.ndarray) -> jnp.ndarray:

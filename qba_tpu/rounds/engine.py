@@ -25,6 +25,10 @@ import jax.numpy as jnp
 from flax import struct
 
 from qba_tpu.adversary import (
+    CLEAR_L_BIT,
+    CLEAR_P_BIT,
+    DROP_BIT,
+    FORGE_BIT,
     assign_dishonest,
     commander_orders,
     corrupt_at_delivery,
@@ -130,7 +134,7 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     vals_f, lens_f, count_f = flat(mb.vals), flat(mb.lens), flat(mb.count)
     p_f, v_f, sent_f = flat(mb.p_mask), flat(mb.v), flat(mb.sent)
     idxs = jnp.arange(n_pk)
-    action, coin, rand_v, late = draws  # this receiver's [n_pk] columns
+    attack, rand_v, late = draws  # this receiver's [n_pk] columns
 
     def deliver(idx):
         """Corrupt + append one mailbox cell (tfg.py:271-284,291)."""
@@ -141,7 +145,7 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
         )
         sender_idx = idx // slots
         pk, delivered = corrupt_at_delivery(
-            cfg, (action[idx], coin[idx], rand_v[idx]), pk, honest[sender_idx + 2]
+            cfg, (attack[idx], rand_v[idx]), pk, honest[sender_idx + 2]
         )
         delivered &= sent_f[idx] & (sender_idx != receiver_idx)
         delivered &= ~late[idx]
@@ -161,10 +165,10 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     senders = idxs // slots
     biz = ~honest[senders + 2]  # [n_pk]
 
-    dropped = biz & (action == 0) & (coin == 0)  # tfg.py:274
-    v2 = jnp.where(biz & (action == 1), rand_v, v_f)  # tfg.py:277
-    clear_p = biz & (action == 2)  # tfg.py:281
-    clear_l = biz & (action == 3)  # tfg.py:283
+    dropped = biz & ((attack & DROP_BIT) != 0)  # tfg.py:274
+    v2 = jnp.where(biz & ((attack & FORGE_BIT) != 0), rand_v, v_f)  # tfg.py:277
+    clear_p = biz & ((attack & CLEAR_P_BIT) != 0)  # tfg.py:281
+    clear_l = biz & ((attack & CLEAR_L_BIT) != 0)  # tfg.py:283
     delivered = ~dropped & ~late & sent_f & (senders != receiver_idx)
 
     # Receiver-independent raw-mailbox reductions (shared by all receivers).
@@ -379,11 +383,11 @@ def run_rounds_pallas(
     def round_body(carry, round_idx):
         vi_i32, packed = carry
         k_round = jax.random.fold_in(k_rounds, round_idx)
-        action, coin, rand_v, late = sample_attacks_round(cfg, k_round)
+        attack, rand_v, late = sample_attacks_round(cfg, k_round)
         out = step(
             round_idx, *packed, lieu_lists, vi_i32, honest_pk,
-            action.astype(jnp.int32), coin.astype(jnp.int32),
-            rand_v.astype(jnp.int32), late.astype(jnp.int32),
+            attack.astype(jnp.int32), rand_v.astype(jnp.int32),
+            late.astype(jnp.int32),
         )
         new_packed, vi_i32, ovf = out[:6], out[6], out[7]
         return (vi_i32, tuple(new_packed)), ovf[0, 0] > 0

@@ -26,6 +26,15 @@ CONFIGS = [
         n_parties=5, size_l=8, n_dishonest=2, trials=12, seed=14,
         max_accepts_per_round=1,
     ),
+    # reference-faithful mutation-leak attack semantics (DIVERGENCES D3)
+    QBAConfig(
+        n_parties=5, size_l=16, n_dishonest=2, trials=16, seed=15,
+        attack_scope="broadcast",
+    ),
+    QBAConfig(
+        n_parties=7, size_l=8, n_dishonest=4, trials=8, seed=16,
+        attack_scope="broadcast",
+    ),
 ]
 
 
@@ -73,6 +82,7 @@ def test_randomized_config_fuzz_three_way():
             ),
             delivery="racy" if racy else "sync",
             p_late=0.4 if racy else 0.0,
+            attack_scope="broadcast" if rng.random() < 0.5 else "delivery",
         )
         keys = jax.random.split(jax.random.key(cfg.seed), cfg.trials)
         a = batched_trials(cfg, keys)

@@ -93,8 +93,14 @@ class TestProtocolCircuits:
 
     def test_generate_lists_dense_pallas_distribution(self):
         # The pallas executor feeds the same decode path; Q-correlated
-        # closed-form properties (SURVEY §2.6) must hold.
-        cfg = QBAConfig(n_parties=3, size_l=64, qsim_path="dense_pallas")
+        # closed-form properties (SURVEY §2.6) must hold, AND the
+        # sampled w-value distributions must match the closed form —
+        # chi-square at significance 1e-4 over every party row plus a
+        # binomial test on the qcorr rate (VERDICT r1 #7: test the Pallas
+        # executor's *distribution*, not just its amplitudes).
+        from scipy import stats
+
+        cfg = QBAConfig(n_parties=3, size_l=512, qsim_path="dense_pallas")
         lists, qcorr = generate_lists_dense(cfg, jax.random.key(0), impl="auto")
         lists, qcorr = np.asarray(lists), np.asarray(qcorr)
         for k in range(cfg.size_l):
@@ -103,6 +109,12 @@ class TestProtocolCircuits:
                 assert len(set(col.tolist())) == cfg.n_parties + 1
             else:
                 assert col[0] == col[1]
+        assert (
+            stats.binomtest(int(qcorr.sum()), cfg.size_l, 0.5).pvalue > 1e-4
+        )
+        for row in lists:
+            obs = np.bincount(row, minlength=cfg.w)
+            assert stats.chisquare(obs).pvalue > 1e-4
 
     def test_trial_on_dense_pallas_path(self):
         cfg = QBAConfig(

@@ -57,10 +57,12 @@ class TestStatevector:
 class TestCircuitBuilder:
     def test_validation(self):
         g = Gate(2)
-        for bad in (lambda: g.add_operation("Z", targets=0),
+        for bad in (lambda: g.add_operation("FOO", targets=0),
                     lambda: g.add_operation("X", targets=5),
                     lambda: g.add_operation("X", targets=0, controls=0),
-                    lambda: g.add_operation("XPOW", targets=0)):
+                    lambda: g.add_operation("XPOW", targets=0),
+                    lambda: g.add_operation("RY", targets=0),  # no angle
+                    lambda: g.add_operation("Z", targets=0, angle=0.5)):
             try:
                 bad()
                 raise AssertionError("expected ValueError")
@@ -114,14 +116,28 @@ class TestFactorizedSampler:
         np.testing.assert_array_equal(np.asarray(lists[0] != lists[1]),
                                       np.asarray(qcorr))
 
-    def test_r_uniformity(self):
+    def test_value_distributions_chi_square(self):
+        # Full w-value laws at significance 1e-4 (VERDICT r1 #7):
+        # the shared random value r (row 0 at Q-corr positions) is uniform
+        # over [0, w); every party row's marginal is uniform over [0, w)
+        # (r XOR rands[i] at Q-corr, i.i.d. uniform elsewhere — SURVEY
+        # §2.6); and each party's XOR offset at Q-corr positions is
+        # uniform over {1..nParties} (a uniformly random permutation
+        # coordinate).
+        from scipy import stats
+
         cfg = QBAConfig(n_parties=3, size_l=4096)
         lists, qcorr = generate_lists(cfg, jax.random.key(2))
-        r = np.asarray(lists[0])[np.asarray(qcorr)]
-        counts = np.bincount(r, minlength=cfg.w)
-        expected = len(r) / cfg.w
-        chi2 = ((counts - expected) ** 2 / expected).sum()
-        assert chi2 < 30, chi2  # 3 dof; extremely loose to avoid flakes
+        lists, qcorr = np.asarray(lists), np.asarray(qcorr)
+        r = lists[0][qcorr]
+        assert stats.chisquare(np.bincount(r, minlength=cfg.w)).pvalue > 1e-4
+        for row in lists:
+            obs = np.bincount(row, minlength=cfg.w)
+            assert stats.chisquare(obs).pvalue > 1e-4
+        xors = lists[1:, qcorr] ^ lists[0:1, qcorr]
+        for i in range(cfg.n_parties):
+            obs = np.bincount(xors[i], minlength=cfg.n_parties + 1)[1:]
+            assert stats.chisquare(obs).pvalue > 1e-4
 
 
 class TestDensePath:
@@ -138,12 +154,155 @@ class TestDensePath:
         lf, qf = generate_lists(cfg, jax.random.key(5))
         for lists, qcorr in ((ld, qd), (lf, qf)):
             check_closed_form_properties(lists, qcorr, cfg.w)
-        # qcorr rate ~ 1/2 on both paths
-        assert abs(float(jnp.mean(qd)) - 0.5) < 0.06
-        assert abs(float(jnp.mean(qf)) - 0.5) < 0.06
-        # commander-value distribution uniform on both paths (chi2, 3 dof)
+        from scipy import stats
+
+        # qcorr is Bernoulli(1/2) on both paths (binomial exact test at
+        # significance 1e-4).
+        for q in (qd, qf):
+            k = int(np.asarray(q).sum())
+            p = stats.binomtest(k, cfg.size_l, 0.5).pvalue
+            assert p > 1e-4, (k, cfg.size_l)
+        # Full w-value distribution uniform for every party row on both
+        # paths (chi-square at significance 1e-4) — the cross-validation
+        # VERDICT r1 #7 asked to harden.
         for lists in (ld, lf):
-            counts = np.bincount(np.asarray(lists[1]), minlength=cfg.w)
-            expected = cfg.size_l / cfg.w
-            chi2 = ((counts - expected) ** 2 / expected).sum()
-            assert chi2 < 30, chi2
+            for row in np.asarray(lists):
+                obs = np.bincount(row, minlength=cfg.w)
+                assert stats.chisquare(obs).pvalue > 1e-4
+
+
+class TestExtendedGates:
+    """The broadened gate surface (VERDICT r1 #6): Z/Y/S/T, CZ/CNOT via
+    controls, RX/RY/RZ/P rotations, multi-shot batching — so the qsimov
+    replacement survives reference-style circuits beyond the two protocol
+    families (tfg.py:4, SURVEY 2.16)."""
+
+    def test_gate_matrices_unitary(self):
+        import itertools
+
+        kinds = [("H", None), ("X", None), ("Y", None), ("Z", None),
+                 ("S", None), ("T", None)]
+        kinds += [(k, a) for k, a in itertools.product(
+            ("RX", "RY", "RZ", "P"), (0.0, 0.37, np.pi / 2, np.pi))]
+        for kind, angle in kinds:
+            m = sv.gate_matrix(kind, angle)
+            np.testing.assert_allclose(
+                m @ m.conj().T, np.eye(2), atol=1e-6,
+                err_msg=f"{kind}({angle}) not unitary",
+            )
+
+    def test_known_matrix_identities(self):
+        np.testing.assert_allclose(
+            sv.gate_matrix("S"), sv.gate_matrix("T") @ sv.gate_matrix("T"),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            sv.gate_matrix("Z"), sv.gate_matrix("S") @ sv.gate_matrix("S"),
+            atol=1e-6,
+        )
+        # RY(pi) = -iY; P(pi) = Z; HZH = X
+        np.testing.assert_allclose(
+            sv.gate_matrix("RY", np.pi), -1j * sv.gate_matrix("Y"), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            sv.gate_matrix("P", np.pi), sv.gate_matrix("Z"), atol=1e-6
+        )
+        h = sv.gate_matrix("H")
+        np.testing.assert_allclose(
+            h @ sv.gate_matrix("Z") @ h, sv.gate_matrix("X"), atol=1e-6
+        )
+
+    def _demo_circuit(self, n):
+        """A non-protocol circuit using every new gate family, with
+        targets/controls on both sides of the Pallas row/lane split."""
+        c = Circuit(n)
+        g = Gate(n)
+        g.add_operation("H", targets=0)
+        g.add_operation("H", targets=n - 1)
+        g.add_operation("S", targets=0)
+        g.add_operation("T", targets=n - 1)
+        g.add_operation("Y", targets=min(2, n - 1))
+        g.add_operation("RZ", targets=min(3, n - 1), angle=0.7)
+        g.add_operation("Z", targets=n - 1, controls=0)  # CZ
+        g.add_operation("X", targets=min(1, n - 1), controls=n - 1)  # CNOT
+        g.add_operation("RX", targets=0, angle=1.1)
+        g.add_operation("RY", targets=n - 2, angle=0.4, controls=min(2, n - 1))
+        g.add_operation("P", targets=min(4, n - 1), angle=2.2)
+        c.add_operation(g)
+        return c
+
+    def test_xla_vs_fused_pallas_complex(self):
+        # n=9 puts two qubits in the Pallas row dimension, the rest in
+        # lanes — both butterfly and MXU paths execute complex gates.
+        for n in (5, 9):
+            c = self._demo_circuit(n)
+            s_xla = np.asarray(c.compile_state("xla")())
+            s_pl = np.asarray(c.compile_state("pallas_interpret")())
+            assert s_pl.dtype == np.complex64
+            np.testing.assert_allclose(s_pl, s_xla, atol=1e-5)
+            np.testing.assert_allclose(np.linalg.norm(s_pl), 1.0, atol=1e-5)
+
+    def test_real_circuits_keep_float32_fast_path(self):
+        c = Circuit(8)
+        g = Gate(8)
+        g.add_operation("H", targets=0)
+        g.add_operation("Z", targets=3)
+        g.add_operation("RY", targets=7, angle=0.3)
+        g.add_operation("X", targets=2, controls=0)
+        c.add_operation(g)
+        s_pl = np.asarray(c.compile_state("pallas_interpret")())
+        assert s_pl.dtype == np.float32  # no imag state materialized
+        np.testing.assert_allclose(
+            s_pl, np.asarray(c.compile_state("xla")()).real, atol=1e-6
+        )
+
+    def test_measure_shots_matches_born_distribution(self):
+        # chi-square at significance 1e-4 over the full 2**n outcome set.
+        from scipy import stats
+
+        c = self._demo_circuit(5)
+        state = c.compile_state("xla")()
+        probs = np.abs(np.asarray(state)) ** 2
+        bits = np.asarray(
+            c.compile_shots("xla")(jax.random.key(3), 4000)
+        )
+        assert bits.shape == (4000, 5)
+        idx = (bits * (2 ** np.arange(4, -1, -1))).sum(axis=1)
+        obs = np.bincount(idx, minlength=32).astype(float)
+        exp = 4000 * probs / probs.sum()
+        # Pool outcomes with expected count < 5 (chi-square validity rule).
+        big = exp >= 5
+        obs_b = np.append(obs[big], obs[~big].sum())
+        exp_b = np.append(exp[big], exp[~big].sum())
+        p = stats.chisquare(obs_b, exp_b * obs_b.sum() / exp_b.sum())
+        assert p.pvalue > 1e-4
+
+    def test_compat_ghz_demo(self):
+        # The reference-style API executes a non-protocol GHZ circuit:
+        # only |000> and |111> outcomes, ~50/50 (tfg.py:4 claims a general
+        # engine; this pins the compat shim beyond the protocol families).
+        from qba_tpu.qsim.compat import Drewom, QCircuit
+
+        circ = QCircuit(3, 3, "ghz")
+        circ.add_operation("H", targets=0)
+        circ.add_operation("X", targets=1, controls=0)
+        circ.add_operation("X", targets=2, controls=1)
+        for q in range(3):
+            circ.add_operation("MEASURE", targets=q, outputs=q)
+        shots = Drewom(seed=7).execute(circ, shots=400)
+        assert len(shots) == 400
+        outcomes = {tuple(s) for s in shots}
+        assert outcomes <= {(0, 0, 0), (1, 1, 1)}
+        frac = sum(1 for s in shots if s == [1, 1, 1]) / 400
+        assert 0.4 < frac < 0.6
+
+    def test_compat_rotation_demo(self):
+        # RY(2*pi/3) on |0> gives P(1) = sin^2(pi/3) = 3/4.
+        from qba_tpu.qsim.compat import Drewom, QCircuit
+
+        circ = QCircuit(1, 1, "ry")
+        circ.add_operation("RY", targets=0, angle=2 * np.pi / 3)
+        circ.add_operation("MEASURE", targets=0, outputs=0)
+        shots = Drewom(seed=1).execute(circ, shots=2000)
+        frac = sum(s[0] for s in shots) / 2000
+        assert abs(frac - 0.75) < 0.04
