@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -67,6 +68,14 @@ def _cumsum_exclusive(col: jnp.ndarray, n: int) -> jnp.ndarray:
     ).astype(jnp.int32)
 
 
+def _lane_group(cfg: QBAConfig) -> int:
+    """Receivers packed side by side per lane tile (kernel v4): fill the
+    VPU's 128 lanes when size_l is narrow; 1 when a single receiver's
+    positions already span a full tile.  Shared by the kernel builder and
+    the fits_kernel VMEM estimate so they cannot drift."""
+    return max(1, min(128 // cfg.size_l, cfg.n_lieutenants))
+
+
 def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
     """Compile one synchronous voting round for one trial.
 
@@ -86,33 +95,36 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
     # <= 256; larger list lengths / order ranges fall back to f32.
     gdt = jnp.bfloat16 if size_l <= 256 and w <= 256 else jnp.float32
 
-    def kernel(
-        round_ref,  # SMEM [1]
-        vals_ref,  # [max_l, n_pk, size_l]
-        lens_ref,  # [n_pk, max_l]
-        count_ref,  # [n_pk, 1]
-        p_ref,  # [n_pk, size_l]
-        v_ref,  # [n_pk, 1]
-        sent_ref,  # [n_pk, 1]
-        li_ref,  # [n_lieu, size_l]
-        vi_ref,  # [n_lieu, w]
-        honest_ref,  # [n_pk, 1]
-        act_ref,  # [n_pk, n_lieu] edit bitmasks (packet-major)
-        rv_ref,
-        late_ref,
-        ovals_ref,
-        olens_ref,
-        ocount_ref,
-        op_ref,
-        ov_ref,
-        osent_ref,
-        ovi_ref,
-        oovf_ref,  # [1, 1]
-        acc_scr,  # scratch [n_pk, n_lieu] i32 — per-receiver accept cols
-        dup_scr,  # scratch [n_pk, n_lieu] i32
-        olen_scr,  # scratch [n_pk, n_lieu] i32
-        g_scr,  # scratch [n_pk, n_pk] gdt — global one-hot gather matrix
-    ):
+    # ---- Receiver lane-packing plan (kernel v4) ---------------------------
+    # A [n_pk, size_l] tile occupies only size_l of the VPU's 128 lanes;
+    # at the headline size_l=64 every per-receiver verdict op ran at half
+    # width.  Pack grp receivers side by side in the lane dimension
+    # (seg_l = grp * size_l lanes) and process them together: elementwise
+    # verdict work runs at full lane occupancy and the per-segment
+    # reductions become one small MXU matmul against the segment one-hot
+    # E [grp, seg_l] (0/1 operands, f32 accumulate — exact).  Groups are
+    # contiguous receiver slices; when grp does not divide n_lieu the last
+    # group re-covers the tail (overlap recomputes identical values; the
+    # member loop below skips already-processed receivers so the
+    # non-idempotent vi update runs exactly once per receiver).
+    grp = _lane_group(cfg)
+    seg_l = grp * size_l
+    r0_list = list(range(0, n_s - grp + 1, grp))
+    if n_s % grp:
+        r0_list.append(n_s - grp)
+    e_np = np.zeros((grp, seg_l), np.float32)
+    for j in range(grp):
+        e_np[j, j * size_l : (j + 1) * size_l] = 1.0
+
+    def kernel(round_ref, *refs):
+        (
+            vals_ref, lens_ref, count_ref, p_ref, v_ref, sent_ref,
+            li_ref, vi_ref, honest_ref, act_ref, rv_ref, late_ref,
+            e_ref, lip_ref, lioob_ref,
+            ovals_ref, olens_ref, ocount_ref, op_ref, ov_ref,
+            osent_ref, ovi_ref, oovf_ref,
+            acc_scr, dup_scr, olen_scr, g_scr,
+        ) = refs
         r_idx = round_ref[0]
         idx_col = jax.lax.broadcasted_iota(jnp.int32, (n_pk, 1), 0)
         sender_col = idx_col // slots
@@ -164,11 +176,7 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
                     jnp.left_shift(jnp.int32(1), vals[r] & 31),
                     0,
                 )
-        # Own-row out-of-range check factored out of the receiver loop:
-        # under p2 the own row is exactly the receiver's list, so
-        # ``own > w | own < 0`` reduces to this per-lieutenant table.
-        li_all = li_ref[:]  # [n_lieu, size_l]
-        li_oob_all = (li_all > w) | (li_all < 0)
+        li_all = li_ref[:]  # [n_lieu, size_l] (rebuild's li_exp below)
 
         ovi_ref[:] = vi_ref[:]
         # No zero-init of the other outputs: the batched rebuild at the
@@ -191,74 +199,12 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         )
         count_eff_all = jnp.where(clearl_all, 0, count)
 
-        for recv in range(n_s):  # Loop A: verdicts + acceptance + vi
-            v2 = v2_all[:, recv : recv + 1]  # [n_pk, 1]
-            clear_p = clearp_all[:, recv : recv + 1]
-            clear_l = clearl_all[:, recv : recv + 1]
-            delivered = delivered_all[:, recv : recv + 1]
-            count_eff = count_eff_all[:, recv : recv + 1]
-            li_row = li_ref[recv : recv + 1, :]  # [1, size_l]
-
-            p2 = p_in & ~clear_p  # [n_pk, size_l]
-            own = jnp.where(
-                p2, jnp.broadcast_to(li_row, (n_pk, size_l)), SENTINEL
-            )
-            own_len = jnp.sum(p2.astype(jnp.int32), axis=1, keepdims=True)
-
-            dup = false_col
-            for r in range(max_l):
-                same = ~jnp.any(vals[r] != own, axis=1, keepdims=True)
-                dup |= valid[r] & same
-            dup &= ~clear_l
-
-            if use_bitmask:
-                # Arithmetic shift is fine: only bit 0 is read after it.
-                # contains_v2 and bad_own share one fused [n_pk, size_l]
-                # reduction below (any(A)|any(B) == any(A|B)).
-                contains_v2_pos = (jnp.right_shift(pm, v2) & 1) != 0
-                own_coll = jnp.any(
-                    p2 & ((jnp.right_shift(pm, li_row) & 1) != 0),
-                    axis=1,
-                    keepdims=True,
-                )
-            else:
-                contains_v2 = false_col
-                own_coll = false_col
-                for r in range(max_l):
-                    contains_v2 |= valid[r] & jnp.any(
-                        in_t[r] & (vals[r] == v2), axis=1, keepdims=True
-                    )
-                    own_coll |= valid[r] & jnp.any(
-                        p2 & in_t[r] & (vals[r] == own), axis=1, keepdims=True
-                    )
-
-            # The min() clamp never fires (mailbox counts <= max_l-1 by
-            # the rebroadcast bound) — see the matching note in
-            # rounds/engine.py before changing max_l's derivation.
-            new_count = jnp.where(
-                dup, count_eff, jnp.minimum(count_eff + 1, max_l)
-            )
-
-            cond1 = (clear_l | ~lens_bad) & (
-                (count_eff == 0) | (own_len == len0)
-            )
-            bad_own_pos = p2 & (
-                (li_row == v2) | li_oob_all[recv : recv + 1, :]
-            )
-            if use_bitmask:
-                bad2 = jnp.any(
-                    (~clear_l & contains_v2_pos) | bad_own_pos,
-                    axis=1,
-                    keepdims=True,
-                )
-                cond2 = ~(bad2 | (~clear_l & oob))
-            else:
-                bad_own = jnp.any(bad_own_pos, axis=1, keepdims=True)
-                cond2 = ~((~clear_l & (oob | contains_v2)) | bad_own)
-            cond3 = (clear_l | ~cells_coll) & (dup | ~(~clear_l & own_coll))
-            ok = delivered & cond1 & cond2 & cond3 & (new_count == r_idx + 1)
-
-            # ---- dedup: first candidate per order value (tfg.py:294) -----
+        def accept_and_store(recv, ok, dup, own_len):
+            """Per-receiver acceptance: first-candidate-per-order dedup
+            against Vi (tfg.py:294), vi update, and the scratch columns
+            for the batched rebuild.  NOT idempotent (reads ovi_ref) —
+            must run exactly once per receiver."""
+            v2 = v2_all[:, recv : recv + 1]
             vi_row = ovi_ref[recv : recv + 1, :]  # [1, w]
             iota_w = jax.lax.broadcasted_iota(jnp.int32, (n_pk, w), 1)
             onehot = v2 == iota_w  # [n_pk, w]
@@ -278,11 +224,161 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
             new_vi = (vi_row != 0) | jnp.any(acc & onehot, axis=0, keepdims=True)
             ovi_ref[recv : recv + 1, :] = new_vi.astype(jnp.int32)
 
-            # Stash this receiver's per-packet columns for the batched
-            # rebuild below.
             acc_scr[:, recv : recv + 1] = acc.astype(jnp.int32)
             dup_scr[:, recv : recv + 1] = dup.astype(jnp.int32)
             olen_scr[:, recv : recv + 1] = own_len
+
+        if True:
+            # ---- Loop A, lane-packed: grp receivers per tile ----------
+            # (grp == 1 degenerates to per-receiver processing through
+            # the same algebra — ONE maintained implementation.)
+            e_mat = e_ref[:].astype(gdt)  # [grp, seg_l] segment one-hot
+
+            def as_gdt(x):
+                # Mosaic rejects the i1 vector relayout an astype from
+                # bool can pick (bitcast_vreg i1->i32 on narrow tiles);
+                # a select against float constants lowers cleanly.
+                if x.dtype == jnp.bool_:
+                    return jnp.where(x, 1.0, 0.0).astype(gdt)
+                return x.astype(gdt)
+
+            # The two segment primitives; everything downstream is ONE
+            # algebra over them.  grp == 1 degenerates both to plain
+            # broadcast / axis reduction (Mosaic cannot lower the
+            # 1-wide-output matmul, and there is nothing to pack anyway).
+            if grp == 1:
+
+                def expand(cols):  # [n_pk, 1] -> [n_pk, seg_l]
+                    return jnp.broadcast_to(
+                        as_gdt(cols).astype(jnp.float32), (n_pk, seg_l)
+                    )
+
+                def seg_reduce(lanes):  # [n_pk, seg_l] -> [n_pk, 1] counts
+                    return jnp.sum(
+                        as_gdt(lanes).astype(jnp.float32),
+                        axis=1,
+                        keepdims=True,
+                    )
+
+            else:
+
+                def expand(cols):  # [n_pk, grp] -> [n_pk, seg_l] per segment
+                    return jax.lax.dot_general(
+                        as_gdt(cols), e_mat,
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+
+                def seg_reduce(lanes):  # [n_pk, seg_l] -> [n_pk, grp] counts
+                    return jax.lax.dot_general(
+                        as_gdt(lanes), e_mat,
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+
+            # Receiver-independent lane tiles, built once: grp copies of
+            # the packet tables side by side.
+            vals_t = [
+                jnp.concatenate([vals[r]] * grp, axis=1) for r in range(max_l)
+            ]
+            # Concatenate the int32 table and compare after: an i1-vector
+            # concat trips the same Mosaic relayout as the astype above.
+            p_tile = jnp.concatenate([p_ref[:]] * grp, axis=1) != 0
+            if use_bitmask:
+                pm_t = jnp.concatenate([pm] * grp, axis=1)
+            else:
+                in_t_t = [vals_t[r] != SENTINEL for r in range(max_l)]
+
+            done: set[int] = set()
+            for gi, r0 in enumerate(r0_list):
+                sl = slice(r0, r0 + grp)
+                clearl_g = clearl_all[:, sl]  # [n_pk, grp]
+                count_eff_g = count_eff_all[:, sl]
+                delivered_g = delivered_all[:, sl]
+
+                v2_lanes = expand(v2_all[:, sl]).astype(jnp.int32)
+                clearp_lanes = expand(clearp_all[:, sl]) != 0
+                p2_lanes = p_tile & ~clearp_lanes  # [n_pk, seg_l]
+                li_row = lip_ref[gi : gi + 1, :]  # [1, seg_l]
+                li_bc = jnp.broadcast_to(li_row, (n_pk, seg_l))
+                own_lanes = jnp.where(p2_lanes, li_bc, SENTINEL)
+
+                dup_g = jnp.zeros((n_pk, grp), jnp.bool_)
+                for r in range(max_l):
+                    mism = seg_reduce(vals_t[r] != own_lanes)
+                    dup_g |= valid[r] & (mism == 0)
+                dup_g &= ~clearl_g
+                own_len_g = seg_reduce(p2_lanes).astype(jnp.int32)
+
+                bad_own_pos = p2_lanes & (
+                    (li_bc == v2_lanes) | (lioob_ref[gi : gi + 1, :] != 0)
+                )
+                if use_bitmask:
+                    contains_pos = (
+                        jnp.right_shift(pm_t, v2_lanes) & 1
+                    ) != 0
+                    cont_g = seg_reduce(contains_pos) > 0
+                    own_coll_g = (
+                        seg_reduce(
+                            p2_lanes
+                            & ((jnp.right_shift(pm_t, li_bc) & 1) != 0)
+                        )
+                        > 0
+                    )
+                    bad_own_g = seg_reduce(bad_own_pos) > 0
+                    cond2 = ~(
+                        (~clearl_g & (cont_g | oob)) | bad_own_g
+                    )
+                else:
+                    contains_g = jnp.zeros((n_pk, grp), jnp.bool_)
+                    own_coll_g = jnp.zeros((n_pk, grp), jnp.bool_)
+                    for r in range(max_l):
+                        contains_g |= valid[r] & (
+                            seg_reduce(in_t_t[r] & (vals_t[r] == v2_lanes))
+                            > 0
+                        )
+                        own_coll_g |= valid[r] & (
+                            seg_reduce(
+                                p2_lanes
+                                & in_t_t[r]
+                                & (vals_t[r] == own_lanes)
+                            )
+                            > 0
+                        )
+                    bad_own_g = seg_reduce(bad_own_pos) > 0
+                    cond2 = ~(
+                        (~clearl_g & (oob | contains_g)) | bad_own_g
+                    )
+
+                # The min() clamp never fires (see the per-receiver path).
+                new_count_g = jnp.where(
+                    dup_g, count_eff_g, jnp.minimum(count_eff_g + 1, max_l)
+                )
+                cond1 = (clearl_g | ~lens_bad) & (
+                    (count_eff_g == 0) | (own_len_g == len0)
+                )
+                cond3 = (clearl_g | ~cells_coll) & (
+                    dup_g | ~(~clearl_g & own_coll_g)
+                )
+                ok_g = (
+                    delivered_g
+                    & cond1
+                    & cond2
+                    & cond3
+                    & (new_count_g == r_idx + 1)
+                )
+
+                for j in range(grp):
+                    recv = r0 + j
+                    if recv in done:  # tail-group overlap: already done
+                        continue
+                    done.add(recv)
+                    accept_and_store(
+                        recv,
+                        ok_g[:, j : j + 1],
+                        dup_g[:, j : j + 1],
+                        own_len_g[:, j : j + 1],
+                    )
 
         # ---- Batched slot allocation (tfg.py:298-299), all receivers -----
         # One triangular MXU matmul computes every receiver's exclusive
@@ -400,11 +496,12 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
     # aliased ref into values before its first output store (vals/lens/
     # count/p/v/sent are read exactly once at the top; vi is copied into
     # ovi and only ovi is read after).
+    n_vmem_in = 15
     call = pl.pallas_call(
         kernel,
         out_shape=out_shapes,
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
-        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 12,
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * n_vmem_in,
         out_specs=tuple(
             pl.BlockSpec(memory_space=pltpu.VMEM) for _ in out_shapes
         ),
@@ -422,11 +519,18 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
              attack, rand_v, late):
         # Draws arrive packet-major [n_pk, n_lieu] straight from
         # sample_attacks_round — no transpose anywhere on the path.
-        return call(
+        base = (
             jnp.asarray([round_idx], jnp.int32),
             vals, lens, count, p, v, sent, li, vi, honest_pk,
             attack, rand_v, late,
         )
+        # Lane-packed receiver tables (cheap XLA reshapes outside the
+        # kernel; per trial under vmap like li itself).
+        li_pack = jnp.stack(
+            [li[r0 : r0 + grp].reshape(-1) for r0 in r0_list]
+        )  # [n_groups, seg_l]
+        li_oob_pack = ((li_pack > w) | (li_pack < 0)).astype(jnp.int32)
+        return call(*base, jnp.asarray(e_np), li_pack, li_oob_pack)
 
     return step
 
@@ -451,6 +555,11 @@ def fits_kernel(cfg: QBAConfig) -> bool:
     # their in-tuple masks (2*max_l), and ~a dozen [n_pk, size_l]
     # intermediates (p_in/p2/own/op plus fusion temporaries).
     est = tile * (4 * cfg.max_l + 12)
+    # Lane-packed receiver tables (kernel v4): grp copies of the packet
+    # tables plus ~6 [n_pk, grp*size_l] group intermediates.
+    grp = _lane_group(cfg)
+    if grp > 1:
+        est += tile * grp * (cfg.max_l + 6)
     # Plus the [n_pk, n_pk] working set of the batched rebuild: the
     # triangular prefix-sum operand (f32/bf16) and the one-hot gather
     # scratch.

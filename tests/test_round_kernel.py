@@ -45,6 +45,24 @@ class TestKernelEquivalence:
         # the batch must actually exercise dishonest behavior
         assert not bool(jnp.all(a.honest))
 
+    def test_wide_positions_single_receiver_group(self):
+        # size_l >= 128 -> _lane_group == 1: the degenerate per-receiver
+        # case must flow through the same lane-packed algebra unchanged.
+        from qba_tpu.ops.round_kernel import _lane_group
+
+        cfg = QBAConfig(n_parties=4, size_l=128, n_dishonest=1)
+        assert _lane_group(cfg) == 1
+        assert_equal(*both(cfg, 5, 4))
+
+    def test_tail_overlap_group(self):
+        # n_lieutenants not divisible by the group size: the tail group
+        # re-covers already-processed receivers; vi must not double-update.
+        from qba_tpu.ops.round_kernel import _lane_group
+
+        cfg = QBAConfig(n_parties=6, size_l=48, n_dishonest=2)
+        assert _lane_group(cfg) == 2 and cfg.n_lieutenants % 2 == 1
+        assert_equal(*both(cfg, 6, 8))
+
     def test_racy_delivery(self):
         cfg = QBAConfig(
             n_parties=4, size_l=8, n_dishonest=1, delivery="racy", p_late=0.5
