@@ -62,16 +62,102 @@ def _attack_triples(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
     return jax.vmap(one_round)(jnp.arange(1, cfg.n_rounds + 1))
 
 
-def run_trial_native(cfg: QBAConfig, key: jax.Array) -> dict:
+# C trace record layout (see qba_native.cc qba_run_trial docs): 7-int32
+# records {kind, round, sender_rank, recv_rank, v, a, b}.
+_TRACE_REC = 7
+_REASONS = ("accepted", "inconsistent", "duplicate-v", "wrong-evidence-len")
+_EFFECT_NAMES = ((1, "drop"), (2, "corrupt-v"), (4, "clear-P"), (8, "clear-L"))
+
+
+def _emit_trace(cfg: QBAConfig, log, trial: int, recs: np.ndarray) -> None:
+    """Render the C engine's trace records as the same event grammar the
+    local backend emits (tests/test_native.py pins the match).
+
+    Kind 7 opens a per-(round, rank) accepted-set snapshot expecting
+    ``a`` kind-8 value records; a truncated trace can cut the value list
+    short, in which case the partial snapshot is dropped rather than
+    rendered wrong."""
+    pending = None  # (round, rank, expected, values)
+
+    def flush_pending():
+        nonlocal pending
+        if pending is None:
+            return
+        rnd, rank, expect, vals = pending
+        pending = None
+        if len(vals) == expect:
+            log.debug("round", "vi", trial=trial, round=rnd, rank=rank,
+                      vi=sorted(vals))
+
+    for kind, rnd, sender, recv, v, a, b in recs.tolist():
+        if kind == 8:
+            if pending is not None:
+                pending[3].append(v)
+                if len(pending[3]) == pending[2]:
+                    flush_pending()
+            continue
+        flush_pending()
+        if kind == 7:  # per-round accepted-set snapshot header
+            pending = (rnd, sender, a, [])
+            if a == 0:
+                flush_pending()
+            continue
+        if kind == 1:  # step2 send (tfg.py:203)
+            log.debug("step2", "send", trial=trial, sender=sender,
+                      dest=recv, v=v, p_size=a, l_size=0)
+        elif kind == 2:  # step3a receive (tfg.py:190)
+            log.debug("step3a", "receive", trial=trial, rank=recv, v=v,
+                      accepted=bool(a), reason=_REASONS[b])
+        elif kind == 3:  # racy late loss (DIVERGENCES D1)
+            log.debug("round", "late loss", trial=trial, round=rnd,
+                      sender=sender, recv=recv)
+        elif kind == 4:  # attack action (tfg.py:275-284)
+            names = [n for bit, n in _EFFECT_NAMES if a & bit]
+            log.debug("round", "attack", trial=trial, round=rnd,
+                      sender=sender, recv=recv,
+                      action="+".join(names) if names else "none")
+        elif kind == 5:  # round receive (tfg.py:294)
+            log.debug("round", "receive", trial=trial, round=rnd,
+                      sender=sender, recv=recv, v=v, accepted=bool(a),
+                      reason=_REASONS[b])
+        elif kind == 6:  # rebroadcast (tfg.py:229)
+            log.debug("round", "send", trial=trial, round=rnd,
+                      sender=sender, v=v, p_size=a, l_size=b,
+                      broadcast=True)
+    flush_pending()
+
+
+def run_trial_native(
+    cfg: QBAConfig,
+    key: jax.Array,
+    log=None,
+    trial: int = 0,
+) -> dict:
     """One protocol execution in the C++ runtime; returns the rank-0
     summary dict (same shape as
     :func:`qba_tpu.backends.local_backend.run_trial_local`).
 
     Delegates to :func:`run_trials_native` with a singleton batch so the
-    per-trial key-tree derivation exists exactly once."""
-    res = run_trials_native(cfg, key[None], n_threads=1)
+    per-trial key-tree derivation exists exactly once.  With ``log``,
+    the C engine records its protocol event trail (the reference's
+    mpi_print sites, ``tfg.py:190,203,229,275-284,294``) into a trace
+    buffer decoded here into the same event grammar the local backend
+    emits; the host-side phases (dishonesty, particles, commander state,
+    verdict) are emitted from the presampled arrays."""
+    trace = None
+    if log is not None:
+        # Capacity: step2+3a (2/lieutenant) + per round: <= n_pk deliveries
+        # per receiver, each <= 3 records, + vi snapshot headers and up to
+        # w value records per rank.
+        n_lieu = cfg.n_lieutenants
+        per_round = n_lieu * (n_lieu * cfg.slots * 3 + 1 + cfg.w)
+        trace = np.zeros(
+            ((2 * n_lieu + cfg.n_rounds * per_round), _TRACE_REC),
+            dtype=np.int32,
+        )
+    res = run_trials_native(cfg, key[None], n_threads=1, trace=trace)
     w, n_lieu = cfg.w, cfg.n_lieutenants
-    return {
+    out = {
         "success": bool(res["success"][0]),
         "decisions": [int(x) for x in res["decisions"][0]],
         "honest": [bool(h) for h in res["honest"][0]],
@@ -82,6 +168,36 @@ def run_trial_native(cfg: QBAConfig, key: jax.Array) -> dict:
         ],
         "overflow": bool(res["overflow"][0]),
     }
+    if log is not None:
+        honest = res["honest"][0]
+        # tfg.py:124 — per-rank honesty (host-side phase, like local).
+        for rank in range(1, cfg.n_parties + 1):
+            log.debug("dishonesty", "party role", trial=trial, rank=rank,
+                      honest=bool(honest[rank - 1]))
+        for rank in range(cfg.n_parties + 1):
+            row = [int(x) for x in res["lists"][0][rank][:16]]
+            log.debug("particles", "list received", trial=trial, rank=rank,
+                      head=row, size_l=cfg.size_l)
+        n_qcorr = int(
+            (res["lists"][0][0] != res["lists"][0][1]).sum()
+        )
+        log.info("step2", "commander order", trial=trial,
+                 v=out["v_comm"], n_qcorr=n_qcorr,
+                 commander_honest=bool(honest[0]))
+        v_sent = set(int(x) for x in res["v_sent"][0])
+        if len(v_sent) > 1:
+            log.info("step2", "commander equivocates", trial=trial,
+                     orders=sorted(v_sent))
+        if res["trace_len"][0] >= trace.shape[0]:
+            log.warning("round", "trace truncated", trial=trial)
+        _emit_trace(cfg, log, trial, trace[: res["trace_len"][0]])
+        log.info(
+            "decision", "verdict", trial=trial,
+            decisions=out["decisions"],
+            dishonest=[i + 1 for i, h in enumerate(out["honest"]) if not h],
+            success=out["success"],
+        )
+    return out
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -99,7 +215,10 @@ def _batch_presample(cfg: QBAConfig, keys: jax.Array):
 
 
 def run_trials_native(
-    cfg: QBAConfig, keys: jax.Array | None = None, n_threads: int = 0
+    cfg: QBAConfig,
+    keys: jax.Array | None = None,
+    n_threads: int = 0,
+    trace: np.ndarray | None = None,
 ) -> dict:
     """Monte-Carlo batch on the C++ runtime's threaded executor.
 
@@ -108,7 +227,12 @@ def run_trials_native(
     a host thread pool (``n_threads <= 0`` = hardware concurrency).
     Returns a dict of stacked arrays: ``success [n]``, ``decisions
     [n, n_parties]``, ``honest [n, n_parties]``, ``v_comm [n]``, ``vi
-    [n, n_lieutenants, w]``, ``overflow [n]``, ``success_rate``.
+    [n, n_lieutenants, w]``, ``overflow [n]``, ``success_rate``, plus the
+    presampled ``lists``/``v_sent``.
+
+    ``trace`` (int32 ``[cap, 7]``, single-trial batches only) routes the
+    run through ``qba_run_trial`` with the C engine's protocol event
+    trail recorded into it; the result then includes ``trace_len``.
     """
     from qba_tpu.backends.jax_backend import trial_keys
 
@@ -130,27 +254,53 @@ def run_trials_native(
     vi = np.zeros((n, n_lieu, w), dtype=np.uint8)
     flags = np.zeros((n, 2), dtype=np.int32)
 
-    rc = lib.qba_run_trials(
-        n,
-        n_threads,
-        cfg.n_parties,
-        cfg.size_l,
-        cfg.n_dishonest,
-        w,
-        cfg.slots,
-        honest_p,
-        lists_p,
-        vs_p,
-        vc_p,
-        at_p,
-        decisions.ctypes.data_as(_i32p),
-        vi.ctypes.data_as(_u8p),
-        flags.ctypes.data_as(_i32p),
-    )
+    trace_len = None
+    if trace is not None:
+        if n != 1:
+            raise ValueError("trace capture needs a single-trial batch")
+        if trace.dtype != np.int32 or trace.ndim != 2 or trace.shape[1] != 7:
+            raise ValueError("trace must be int32 [cap, 7]")
+        trace_len = np.zeros((1,), dtype=np.int32)
+        rc = lib.qba_run_trial(
+            cfg.n_parties,
+            cfg.size_l,
+            cfg.n_dishonest,
+            w,
+            cfg.slots,
+            honest_p,
+            lists_p,
+            vs_p,
+            int(vc_a[0]),
+            at_p,
+            decisions.ctypes.data_as(_i32p),
+            vi.ctypes.data_as(_u8p),
+            flags.ctypes.data_as(_i32p),
+            trace.ctypes.data_as(_i32p),
+            trace.shape[0],
+            trace_len.ctypes.data_as(_i32p),
+        )
+    else:
+        rc = lib.qba_run_trials(
+            n,
+            n_threads,
+            cfg.n_parties,
+            cfg.size_l,
+            cfg.n_dishonest,
+            w,
+            cfg.slots,
+            honest_p,
+            lists_p,
+            vs_p,
+            vc_p,
+            at_p,
+            decisions.ctypes.data_as(_i32p),
+            vi.ctypes.data_as(_u8p),
+            flags.ctypes.data_as(_i32p),
+        )
     if rc != 0:
         raise RuntimeError(f"qba_run_trials failed with rc={rc}")
 
-    return {
+    out = {
         "success": flags[:, 0].astype(bool),
         "decisions": decisions,
         "honest": honest_a[:, 1:].astype(bool),
@@ -158,4 +308,9 @@ def run_trials_native(
         "vi": vi.astype(bool),
         "overflow": flags[:, 1].astype(bool),
         "success_rate": float(flags[:, 0].mean()),
+        "lists": lists_a,
+        "v_sent": vs_a,
     }
+    if trace_len is not None:
+        out["trace_len"] = trace_len
+    return out

@@ -158,7 +158,11 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
 
     cfg = _config(args)
     log = EventLog(
-        min_level=Level.DEBUG if args.verbose else Level.INFO, stream=out
+        # --jsonl collects the DEBUG trail for export even without -v;
+        # only -v streams it live.
+        min_level=Level.DEBUG if (args.verbose or args.jsonl) else Level.INFO,
+        stream=out,
+        stream_level=Level.DEBUG if args.verbose else Level.INFO,
     )
     timers = PhaseTimers()
     log.info("config", "experiment", n_parties=cfg.n_parties, size_l=cfg.size_l,
@@ -168,10 +172,21 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
     with profile_trace(args.profile_dir):
         if args.backend == "native":
             # The C++ runtime's threaded batch executor.
-            from qba_tpu.backends.native_backend import run_trials_native
+            from qba_tpu.backends.jax_backend import trial_keys
+            from qba_tpu.backends.native_backend import (
+                run_trial_native,
+                run_trials_native,
+            )
 
             with timers.time("trials"):
                 res = run_trials_native(cfg)
+            if args.verbose or args.jsonl:
+                # Re-run the displayed trials through the C engine's trace
+                # path: the presampled randomness is identical, so the
+                # per-packet trail matches the batch verdicts exactly.
+                keys = trial_keys(cfg)
+                for i in range(min(cfg.trials, args.max_verdicts)):
+                    run_trial_native(cfg, keys[i], log=log, trial=i)
             for i in range(min(cfg.trials, args.max_verdicts)):
                 trial = types.SimpleNamespace(
                     decisions=res["decisions"][i],

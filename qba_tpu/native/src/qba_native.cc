@@ -173,6 +173,25 @@ int qba_decode_pvl(const int32_t* buf, int len, int32_t* p_out, int np_cap,
 //   decisions_out : int32[n_parties] (index 0 = commander)
 //   vi_out   : uint8[n_lieu * w] accepted-set masks
 //   flags_out: int32[2] = {success, overflow}
+//   trace_out/trace_cap/trace_len : optional protocol event trail — the
+//              in-engine analog of the reference's mpi_print sites
+//              (tfg.py:190,203,229,275-284,294).  When trace_out is
+//              non-null, fixed 7-int32 records {kind, round, sender_rank,
+//              recv_rank, v, a, b} are appended (capacity trace_cap
+//              records; excess events are dropped and *trace_len saturates
+//              at trace_cap so the caller can detect truncation):
+//                kind 1 step2 send       (a=|P|, b=0)          tfg.py:203
+//                kind 2 step3a receive   (a=accepted, b=reason) tfg.py:190
+//                kind 3 racy late loss                      DIVERGENCES D1
+//                kind 4 attack           (a=edit bitmask)  tfg.py:275-284
+//                kind 5 round receive    (a=accepted, b=reason) tfg.py:294
+//                kind 6 rebroadcast      (a=|P|, b=|L|)        tfg.py:229
+//                kind 7 vi snapshot header (a=|Vi|), followed by |Vi|
+//                       kind 8 records {8, round, rank, 0, value, 0, 0}
+//                       — value list form, exact for any w
+//              reason codes: 0 accepted, 1 inconsistent, 2 duplicate-v,
+//              3 wrong-evidence-len (the lieu_receive condition order,
+//              tfg.py:294).
 //
 // Packets move between parties through the PvL codec (encode on send,
 // decode on delivery) — the in-process analog of the reference's tagged
@@ -181,11 +200,23 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
                   int slots, const uint8_t* honest, const int32_t* lists,
                   const int32_t* v_sent, int32_t v_comm,
                   const int32_t* attacks, int32_t* decisions_out,
-                  uint8_t* vi_out, int32_t* flags_out) {
+                  uint8_t* vi_out, int32_t* flags_out,
+                  int32_t* trace_out, int32_t trace_cap,
+                  int32_t* trace_len) {
   const int n_lieu = n_parties - 1;
   const int n_rounds = n_dishonest + 1;
   const int max_l = n_dishonest + 2;
   const int cap = 3 + size_l + max_l * (1 + size_l);
+
+  int32_t n_trace = 0;
+  auto trace = [&](int32_t kind, int32_t rnd, int32_t sender, int32_t recv,
+                   int32_t v, int32_t a, int32_t b) {
+    if (trace_out == nullptr || n_trace >= trace_cap) return;
+    int32_t* rec = trace_out + static_cast<size_t>(n_trace) * 7;
+    rec[0] = kind; rec[1] = rnd; rec[2] = sender; rec[3] = recv;
+    rec[4] = v; rec[5] = a; rec[6] = b;
+    ++n_trace;
+  };
 
   auto list_row = [&](int rank) { return lists + rank * size_l; };
 
@@ -227,8 +258,11 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
     for (int32_t k : isq) {
       if (list_row(1)[k] == pk.v) pk.p.push_back(k);
     }
+    trace(1, 0, 1, i + 2, pk.v, static_cast<int32_t>(pk.p.size()), 0);
     pk.L.insert(own_sublist(i, pk.p));
-    if (consistent(pk.v, pk.L, w)) {
+    const bool ok3a = consistent(pk.v, pk.L, w);
+    trace(2, 0, 1, i + 2, pk.v, ok3a ? 1 : 0, ok3a ? 0 : 1);
+    if (ok3a) {
       vi[i].insert(pk.v);
       if (push(&mailbox[i], pk) < 0) return -1;
     }
@@ -251,8 +285,12 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
               attacks + (((rnd - 1) * n_lieu + recv) * n_lieu * slots +
                          sender * slots + slot) *
                             3;
-          if (a[2]) continue;  // racy late loss (DIVERGENCES.md D1)
+          if (a[2]) {  // racy late loss (DIVERGENCES.md D1)
+            trace(3, rnd, sender + 2, recv + 2, 0, 0, 0);
+            continue;
+          }
           if (!honest[sender + 2]) {  // tfg.py:271-284
+            trace(4, rnd, sender + 2, recv + 2, 0, a[0], 0);
             if (a[0] & 1) continue;       // drop
             if (a[0] & 2) pk.v = a[1];    // forged v
             if (a[0] & 4) pk.p.clear();   // clear P
@@ -260,11 +298,20 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
           }
           // lieu_receive (tfg.py:289-300)
           pk.L.insert(own_sublist(recv, pk.p));
-          if (consistent(pk.v, pk.L, w) && !vi[recv].count(pk.v) &&
-              static_cast<int>(pk.L.size()) == rnd + 1) {
+          int32_t reason;
+          if (!consistent(pk.v, pk.L, w)) reason = 1;
+          else if (vi[recv].count(pk.v)) reason = 2;
+          else if (static_cast<int>(pk.L.size()) != rnd + 1) reason = 3;
+          else reason = 0;
+          trace(5, rnd, sender + 2, recv + 2, pk.v, reason == 0 ? 1 : 0,
+                reason);
+          if (reason == 0) {
             vi[recv].insert(pk.v);
             if (rnd <= n_dishonest) {
               if (static_cast<int>(out[recv].size()) < slots) {
+                trace(6, rnd, recv + 2, 0, pk.v,
+                      static_cast<int32_t>(pk.p.size()),
+                      static_cast<int32_t>(pk.L.size()));
                 if (push(&out[recv], pk) < 0) return -1;
               } else {
                 overflow = true;
@@ -273,6 +320,10 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
           }
         }
       }
+    }
+    for (int i = 0; i < n_lieu; ++i) {
+      trace(7, rnd, i + 2, 0, 0, static_cast<int32_t>(vi[i].size()), 0);
+      for (int32_t x : vi[i]) trace(8, rnd, i + 2, 0, x, 0, 0);
     }
     mailbox = std::move(out);
   }
@@ -292,6 +343,7 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
   }
   flags_out[0] = filtered.size() == 1 ? 1 : 0;
   flags_out[1] = overflow ? 1 : 0;
+  if (trace_len) *trace_len = n_trace;
   return 0;
 }
 
@@ -337,7 +389,7 @@ int qba_run_trials(int n_trials, int n_threads, int n_parties, int size_l,
           n_parties, size_l, n_dishonest, w, slots, honest + t * honest_s,
           lists + t * lists_s, v_sent + t * vsent_s, v_comm[t],
           attacks + t * att_s, decisions_out + t * dec_s, vi_out + t * vi_s,
-          flags_out + t * 2);
+          flags_out + t * 2, nullptr, 0, nullptr);
       if (r != 0) {
         int expected = 0;  // first error wins (deterministic reporting)
         rc.compare_exchange_strong(expected, r);

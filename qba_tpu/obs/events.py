@@ -68,9 +68,14 @@ class EventLog:
         min_level: Level = Level.INFO,
         stream: TextIO | None = None,
         clock: Callable[[], float] = time.monotonic,
+        stream_level: Level | None = None,
     ) -> None:
         self.min_level = min_level
         self.stream = stream
+        # Collection and live streaming can have different thresholds:
+        # ``--jsonl`` without ``-v`` collects the DEBUG trail for export
+        # without flooding stdout.
+        self.stream_level = min_level if stream_level is None else stream_level
         self.events: list[Event] = []
         self._clock = clock
 
@@ -81,7 +86,7 @@ class EventLog:
             return
         ev = Event(self._clock(), level, phase, message, fields)
         self.events.append(ev)
-        if self.stream is not None:
+        if self.stream is not None and level >= self.stream_level:
             # print + flush, as the reference's mpi_print does (tfg.py:10-12)
             print(ev.render(), file=self.stream, flush=True)
 

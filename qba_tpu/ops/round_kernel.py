@@ -228,157 +228,157 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
             dup_scr[:, recv : recv + 1] = dup.astype(jnp.int32)
             olen_scr[:, recv : recv + 1] = own_len
 
-        if True:
-            # ---- Loop A, lane-packed: grp receivers per tile ----------
-            # (grp == 1 degenerates to per-receiver processing through
-            # the same algebra — ONE maintained implementation.)
+        # ---- Loop A, lane-packed: grp receivers per tile ----------
+        # (grp == 1 degenerates to per-receiver processing through
+        # the same algebra — ONE maintained implementation.)
+        if grp > 1:  # unused by the grp == 1 primitives below
             e_mat = e_ref[:].astype(gdt)  # [grp, seg_l] segment one-hot
 
-            def as_gdt(x):
-                # Mosaic rejects the i1 vector relayout an astype from
-                # bool can pick (bitcast_vreg i1->i32 on narrow tiles);
-                # a select against float constants lowers cleanly.
-                if x.dtype == jnp.bool_:
-                    return jnp.where(x, 1.0, 0.0).astype(gdt)
-                return x.astype(gdt)
+        def as_gdt(x):
+            # Mosaic rejects the i1 vector relayout an astype from
+            # bool can pick (bitcast_vreg i1->i32 on narrow tiles);
+            # a select against float constants lowers cleanly.
+            if x.dtype == jnp.bool_:
+                return jnp.where(x, 1.0, 0.0).astype(gdt)
+            return x.astype(gdt)
 
-            # The two segment primitives; everything downstream is ONE
-            # algebra over them.  grp == 1 degenerates both to plain
-            # broadcast / axis reduction (Mosaic cannot lower the
-            # 1-wide-output matmul, and there is nothing to pack anyway).
-            if grp == 1:
+        # The two segment primitives; everything downstream is ONE
+        # algebra over them.  grp == 1 degenerates both to plain
+        # broadcast / axis reduction (Mosaic cannot lower the
+        # 1-wide-output matmul, and there is nothing to pack anyway).
+        if grp == 1:
 
-                def expand(cols):  # [n_pk, 1] -> [n_pk, seg_l]
-                    return jnp.broadcast_to(
-                        as_gdt(cols).astype(jnp.float32), (n_pk, seg_l)
-                    )
-
-                def seg_reduce(lanes):  # [n_pk, seg_l] -> [n_pk, 1] counts
-                    return jnp.sum(
-                        as_gdt(lanes).astype(jnp.float32),
-                        axis=1,
-                        keepdims=True,
-                    )
-
-            else:
-
-                def expand(cols):  # [n_pk, grp] -> [n_pk, seg_l] per segment
-                    return jax.lax.dot_general(
-                        as_gdt(cols), e_mat,
-                        (((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-
-                def seg_reduce(lanes):  # [n_pk, seg_l] -> [n_pk, grp] counts
-                    return jax.lax.dot_general(
-                        as_gdt(lanes), e_mat,
-                        (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-
-            # Receiver-independent lane tiles, built once: grp copies of
-            # the packet tables side by side.
-            vals_t = [
-                jnp.concatenate([vals[r]] * grp, axis=1) for r in range(max_l)
-            ]
-            # Concatenate the int32 table and compare after: an i1-vector
-            # concat trips the same Mosaic relayout as the astype above.
-            p_tile = jnp.concatenate([p_ref[:]] * grp, axis=1) != 0
-            if use_bitmask:
-                pm_t = jnp.concatenate([pm] * grp, axis=1)
-            else:
-                in_t_t = [vals_t[r] != SENTINEL for r in range(max_l)]
-
-            done: set[int] = set()
-            for gi, r0 in enumerate(r0_list):
-                sl = slice(r0, r0 + grp)
-                clearl_g = clearl_all[:, sl]  # [n_pk, grp]
-                count_eff_g = count_eff_all[:, sl]
-                delivered_g = delivered_all[:, sl]
-
-                v2_lanes = expand(v2_all[:, sl]).astype(jnp.int32)
-                clearp_lanes = expand(clearp_all[:, sl]) != 0
-                p2_lanes = p_tile & ~clearp_lanes  # [n_pk, seg_l]
-                li_row = lip_ref[gi : gi + 1, :]  # [1, seg_l]
-                li_bc = jnp.broadcast_to(li_row, (n_pk, seg_l))
-                own_lanes = jnp.where(p2_lanes, li_bc, SENTINEL)
-
-                dup_g = jnp.zeros((n_pk, grp), jnp.bool_)
-                for r in range(max_l):
-                    mism = seg_reduce(vals_t[r] != own_lanes)
-                    dup_g |= valid[r] & (mism == 0)
-                dup_g &= ~clearl_g
-                own_len_g = seg_reduce(p2_lanes).astype(jnp.int32)
-
-                bad_own_pos = p2_lanes & (
-                    (li_bc == v2_lanes) | (lioob_ref[gi : gi + 1, :] != 0)
+            def expand(cols):  # [n_pk, 1] -> [n_pk, seg_l]
+                return jnp.broadcast_to(
+                    as_gdt(cols).astype(jnp.float32), (n_pk, seg_l)
                 )
-                if use_bitmask:
-                    contains_pos = (
-                        jnp.right_shift(pm_t, v2_lanes) & 1
-                    ) != 0
-                    cont_g = seg_reduce(contains_pos) > 0
-                    own_coll_g = (
+
+            def seg_reduce(lanes):  # [n_pk, seg_l] -> [n_pk, 1] counts
+                return jnp.sum(
+                    as_gdt(lanes).astype(jnp.float32),
+                    axis=1,
+                    keepdims=True,
+                )
+
+        else:
+
+            def expand(cols):  # [n_pk, grp] -> [n_pk, seg_l] per segment
+                return jax.lax.dot_general(
+                    as_gdt(cols), e_mat,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            def seg_reduce(lanes):  # [n_pk, seg_l] -> [n_pk, grp] counts
+                return jax.lax.dot_general(
+                    as_gdt(lanes), e_mat,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+        # Receiver-independent lane tiles, built once: grp copies of
+        # the packet tables side by side.
+        vals_t = [
+            jnp.concatenate([vals[r]] * grp, axis=1) for r in range(max_l)
+        ]
+        # Concatenate the int32 table and compare after: an i1-vector
+        # concat trips the same Mosaic relayout as the astype above.
+        p_tile = jnp.concatenate([p_ref[:]] * grp, axis=1) != 0
+        if use_bitmask:
+            pm_t = jnp.concatenate([pm] * grp, axis=1)
+        else:
+            in_t_t = [vals_t[r] != SENTINEL for r in range(max_l)]
+
+        done: set[int] = set()
+        for gi, r0 in enumerate(r0_list):
+            sl = slice(r0, r0 + grp)
+            clearl_g = clearl_all[:, sl]  # [n_pk, grp]
+            count_eff_g = count_eff_all[:, sl]
+            delivered_g = delivered_all[:, sl]
+
+            v2_lanes = expand(v2_all[:, sl]).astype(jnp.int32)
+            clearp_lanes = expand(clearp_all[:, sl]) != 0
+            p2_lanes = p_tile & ~clearp_lanes  # [n_pk, seg_l]
+            li_row = lip_ref[gi : gi + 1, :]  # [1, seg_l]
+            li_bc = jnp.broadcast_to(li_row, (n_pk, seg_l))
+            own_lanes = jnp.where(p2_lanes, li_bc, SENTINEL)
+
+            dup_g = jnp.zeros((n_pk, grp), jnp.bool_)
+            for r in range(max_l):
+                mism = seg_reduce(vals_t[r] != own_lanes)
+                dup_g |= valid[r] & (mism == 0)
+            dup_g &= ~clearl_g
+            own_len_g = seg_reduce(p2_lanes).astype(jnp.int32)
+
+            bad_own_pos = p2_lanes & (
+                (li_bc == v2_lanes) | (lioob_ref[gi : gi + 1, :] != 0)
+            )
+            if use_bitmask:
+                contains_pos = (
+                    jnp.right_shift(pm_t, v2_lanes) & 1
+                ) != 0
+                cont_g = seg_reduce(contains_pos) > 0
+                own_coll_g = (
+                    seg_reduce(
+                        p2_lanes
+                        & ((jnp.right_shift(pm_t, li_bc) & 1) != 0)
+                    )
+                    > 0
+                )
+                bad_own_g = seg_reduce(bad_own_pos) > 0
+                cond2 = ~(
+                    (~clearl_g & (cont_g | oob)) | bad_own_g
+                )
+            else:
+                contains_g = jnp.zeros((n_pk, grp), jnp.bool_)
+                own_coll_g = jnp.zeros((n_pk, grp), jnp.bool_)
+                for r in range(max_l):
+                    contains_g |= valid[r] & (
+                        seg_reduce(in_t_t[r] & (vals_t[r] == v2_lanes))
+                        > 0
+                    )
+                    own_coll_g |= valid[r] & (
                         seg_reduce(
                             p2_lanes
-                            & ((jnp.right_shift(pm_t, li_bc) & 1) != 0)
+                            & in_t_t[r]
+                            & (vals_t[r] == own_lanes)
                         )
                         > 0
                     )
-                    bad_own_g = seg_reduce(bad_own_pos) > 0
-                    cond2 = ~(
-                        (~clearl_g & (cont_g | oob)) | bad_own_g
-                    )
-                else:
-                    contains_g = jnp.zeros((n_pk, grp), jnp.bool_)
-                    own_coll_g = jnp.zeros((n_pk, grp), jnp.bool_)
-                    for r in range(max_l):
-                        contains_g |= valid[r] & (
-                            seg_reduce(in_t_t[r] & (vals_t[r] == v2_lanes))
-                            > 0
-                        )
-                        own_coll_g |= valid[r] & (
-                            seg_reduce(
-                                p2_lanes
-                                & in_t_t[r]
-                                & (vals_t[r] == own_lanes)
-                            )
-                            > 0
-                        )
-                    bad_own_g = seg_reduce(bad_own_pos) > 0
-                    cond2 = ~(
-                        (~clearl_g & (oob | contains_g)) | bad_own_g
-                    )
-
-                # The min() clamp never fires (see the per-receiver path).
-                new_count_g = jnp.where(
-                    dup_g, count_eff_g, jnp.minimum(count_eff_g + 1, max_l)
-                )
-                cond1 = (clearl_g | ~lens_bad) & (
-                    (count_eff_g == 0) | (own_len_g == len0)
-                )
-                cond3 = (clearl_g | ~cells_coll) & (
-                    dup_g | ~(~clearl_g & own_coll_g)
-                )
-                ok_g = (
-                    delivered_g
-                    & cond1
-                    & cond2
-                    & cond3
-                    & (new_count_g == r_idx + 1)
+                bad_own_g = seg_reduce(bad_own_pos) > 0
+                cond2 = ~(
+                    (~clearl_g & (oob | contains_g)) | bad_own_g
                 )
 
-                for j in range(grp):
-                    recv = r0 + j
-                    if recv in done:  # tail-group overlap: already done
-                        continue
-                    done.add(recv)
-                    accept_and_store(
-                        recv,
-                        ok_g[:, j : j + 1],
-                        dup_g[:, j : j + 1],
-                        own_len_g[:, j : j + 1],
-                    )
+            # The min() clamp never fires (see the per-receiver path).
+            new_count_g = jnp.where(
+                dup_g, count_eff_g, jnp.minimum(count_eff_g + 1, max_l)
+            )
+            cond1 = (clearl_g | ~lens_bad) & (
+                (count_eff_g == 0) | (own_len_g == len0)
+            )
+            cond3 = (clearl_g | ~cells_coll) & (
+                dup_g | ~(~clearl_g & own_coll_g)
+            )
+            ok_g = (
+                delivered_g
+                & cond1
+                & cond2
+                & cond3
+                & (new_count_g == r_idx + 1)
+            )
+
+            for j in range(grp):
+                recv = r0 + j
+                if recv in done:  # tail-group overlap: already done
+                    continue
+                done.add(recv)
+                accept_and_store(
+                    recv,
+                    ok_g[:, j : j + 1],
+                    dup_g[:, j : j + 1],
+                    own_len_g[:, j : j + 1],
+                )
 
         # ---- Batched slot allocation (tfg.py:298-299), all receivers -----
         # One triangular MXU matmul computes every receiver's exclusive
