@@ -14,8 +14,10 @@ from qba_tpu.adversary.model import (
     CLEAR_L_BIT,
     CLEAR_P_BIT,
     DROP_BIT,
+    EFFECT_NAMES,
     FORGE_BIT,
     assign_dishonest,
+    effect_names,
     commander_orders,
     corrupt_at_delivery,
     raw_attack_draws,
@@ -26,7 +28,9 @@ __all__ = [
     "CLEAR_L_BIT",
     "CLEAR_P_BIT",
     "DROP_BIT",
+    "EFFECT_NAMES",
     "FORGE_BIT",
+    "effect_names",
     "assign_dishonest",
     "commander_orders",
     "corrupt_at_delivery",

@@ -83,6 +83,21 @@ FORGE_BIT = 2  # action 1: v replaced (tfg.py:277)
 CLEAR_P_BIT = 4  # action 2 (tfg.py:281)
 CLEAR_L_BIT = 8  # action 3 (tfg.py:283)
 
+# tfg.py:272-284 — trail names for the attack edits, shared by every
+# backend that renders protocol events so the trails cannot drift.
+EFFECT_NAMES = (
+    (DROP_BIT, "drop"),
+    (FORGE_BIT, "corrupt-v"),
+    (CLEAR_P_BIT, "clear-P"),
+    (CLEAR_L_BIT, "clear-L"),
+)
+
+
+def effect_names(bits: int) -> str:
+    """Human-readable rendering of an attack bitmask for the event trail."""
+    names = [n for b, n in EFFECT_NAMES if bits & b]
+    return "+".join(names) if names else "none"
+
 
 def raw_attack_draws(cfg: QBAConfig, k_round: jax.Array):
     """The round's raw per-(cell, receiver) draws ``(action, coin,

@@ -28,6 +28,7 @@ from qba_tpu.adversary import (
     FORGE_BIT,
     assign_dishonest,
     commander_orders,
+    effect_names,
     sample_attacks_round,
 )
 from qba_tpu.config import QBAConfig
@@ -36,18 +37,6 @@ from qba_tpu.qsim import generate_lists_for
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from qba_tpu.obs import EventLog
 
-# tfg.py:272-284 — effect names for the attack bitmask in the trail.
-_EFFECT_NAMES = (
-    (DROP_BIT, "drop"),
-    (FORGE_BIT, "corrupt-v"),
-    (CLEAR_P_BIT, "clear-P"),
-    (CLEAR_L_BIT, "clear-L"),
-)
-
-
-def _effects(bits: int) -> str:
-    names = [n for b, n in _EFFECT_NAMES if bits & b]
-    return "+".join(names) if names else "none"
 
 
 def _consistent(v: int, L: set, w: int) -> bool:
@@ -184,12 +173,70 @@ def run_trial_local(
     # Step 3b (tfg.py:337-348): synchronous rounds.  Attack randomness is
     # the same batched per-round arrays the jax engine draws, indexed per
     # cell — the bit-exact three-way contract.
+    #
+    # Under racy_mode="defer", a late packet is not lost: it is delivered
+    # at the start of the NEXT round's drain — the reference's actual
+    # race mechanism, where a packet missing its round's Iprobe drain
+    # arrives a round later and the ``len(L) == round+1`` check
+    # (tfg.py:294) necessarily rejects it (a once-deferred packet's
+    # evidence count is one short of the new round's requirement).
+    # Corruption is applied at deferral time with the ORIGINAL round's
+    # draws — the reference corrupts at send time, before the race.
+    deferred: list[list] = [[] for _ in range(n_lieu)]
     for rnd in range(1, cfg.n_rounds + 1):
         k_round = jax.random.fold_in(k_rounds, rnd)
         a_att, a_rv, a_late = (
             np.asarray(x) for x in sample_attacks_round(cfg, k_round)
         )
         out: list[list] = [[] for _ in range(n_lieu)]
+        next_deferred: list[list] = [[] for _ in range(n_lieu)]
+
+        def lieu_receive(recv, sender_rank, p2, v2, ell2, was_deferred=False):
+            """tfg.py:289-300 for one delivered packet."""
+            ell2 = set(ell2)
+            ell2.add(tuple(li[recv][j] for j in sorted(p2)))
+            if not _consistent(v2, ell2, w):
+                reason = "inconsistent"
+            elif v2 in vi[recv]:
+                reason = "duplicate-v"
+            elif len(ell2) != rnd + 1:
+                reason = "wrong-evidence-len"
+            else:
+                reason = "accepted"
+            if log:
+                fields = dict(
+                    trial=trial, round=rnd, sender=sender_rank,
+                    recv=recv + 2, v=v2,
+                    accepted=reason == "accepted", reason=reason,
+                )
+                if was_deferred:
+                    fields["deferred"] = True
+                log.debug("round", "receive", **fields)
+            if reason == "accepted":
+                vi[recv].add(v2)
+                if rnd <= cfg.n_dishonest:
+                    if len(out[recv]) < slots:
+                        out[recv].append((p2, v2, ell2))
+                        if log:
+                            # tfg.py:229 — the accepted packet is
+                            # rebroadcast to every peer.
+                            log.debug(
+                                "round", "send", trial=trial,
+                                round=rnd, sender=recv + 2, v=v2,
+                                p_size=len(p2), l_size=len(ell2),
+                                broadcast=True,
+                            )
+                    else:
+                        nonlocal overflow
+                        overflow = True
+
+        # Deferred arrivals from the previous round drain first (they
+        # were in the queue before this round's traffic; deterministic
+        # (sender, slot) order per D5).
+        for recv in range(n_lieu):
+            for sender_rank, p2, v2, ell2 in deferred[recv]:
+                lieu_receive(recv, sender_rank, p2, v2, ell2, was_deferred=True)
+
         for recv in range(n_lieu):
             for sender in range(n_lieu):
                 for slot in range(min(slots, len(mailbox[sender]))):
@@ -197,17 +244,18 @@ def run_trial_local(
                         continue
                     p, v, ell = mailbox[sender][slot]
                     cell = sender * slots + slot
-                    if bool(a_late[cell, recv]):  # D1 race modeling
+                    bits, rand_v = (
+                        int(a_att[cell, recv]),
+                        int(a_rv[cell, recv]),
+                    )
+                    late = bool(a_late[cell, recv])  # D1 race modeling
+                    if late and cfg.racy_mode == "loss":
                         if log:
                             log.debug(
                                 "round", "late loss", trial=trial,
                                 round=rnd, sender=sender + 2, recv=recv + 2,
                             )
                         continue
-                    bits, rand_v = (
-                        int(a_att[cell, recv]),
-                        int(a_rv[cell, recv]),
-                    )
                     p2, v2, ell2 = set(p), v, set(ell)
                     if not honest[sender + 2]:  # tfg.py:271-284
                         if log:
@@ -215,7 +263,7 @@ def run_trial_local(
                             log.debug(
                                 "round", "attack", trial=trial, round=rnd,
                                 sender=sender + 2, recv=recv + 2,
-                                action=_effects(bits),
+                                action=effect_names(bits),
                             )
                         if bits & DROP_BIT:
                             continue
@@ -225,38 +273,17 @@ def run_trial_local(
                             p2 = set()
                         if bits & CLEAR_L_BIT:
                             ell2 = set()
-                    # lieu_receive (tfg.py:289-300)
-                    ell2.add(tuple(li[recv][j] for j in sorted(p2)))
-                    if not _consistent(v2, ell2, w):
-                        reason = "inconsistent"
-                    elif v2 in vi[recv]:
-                        reason = "duplicate-v"
-                    elif len(ell2) != rnd + 1:
-                        reason = "wrong-evidence-len"
-                    else:
-                        reason = "accepted"
-                    if log:
-                        log.debug(
-                            "round", "receive", trial=trial, round=rnd,
-                            sender=sender + 2, recv=recv + 2, v=v2,
-                            accepted=reason == "accepted", reason=reason,
+                    if late:  # racy_mode == "defer"
+                        if log:
+                            log.debug(
+                                "round", "late defer", trial=trial,
+                                round=rnd, sender=sender + 2, recv=recv + 2,
+                            )
+                        next_deferred[recv].append(
+                            (sender + 2, p2, v2, ell2)
                         )
-                    if reason == "accepted":
-                        vi[recv].add(v2)
-                        if rnd <= cfg.n_dishonest:
-                            if len(out[recv]) < slots:
-                                out[recv].append((p2, v2, ell2))
-                                if log:
-                                    # tfg.py:229 — the accepted packet is
-                                    # rebroadcast to every peer.
-                                    log.debug(
-                                        "round", "send", trial=trial,
-                                        round=rnd, sender=recv + 2, v=v2,
-                                        p_size=len(p2), l_size=len(ell2),
-                                        broadcast=True,
-                                    )
-                            else:
-                                overflow = True
+                        continue
+                    lieu_receive(recv, sender + 2, p2, v2, ell2)
         if log:
             for i in range(n_lieu):
                 log.debug(
@@ -264,6 +291,7 @@ def run_trial_local(
                     vi=sorted(vi[i]),
                 )
         mailbox = out
+        deferred = next_deferred
 
     # Decision + verdict (tfg.py:303-306,351-363; empty-Vi sentinel is D2).
     decisions = [v_comm] + [

@@ -25,6 +25,7 @@ import numpy as np
 from qba_tpu.adversary import (
     assign_dishonest,
     commander_orders,
+    effect_names,
     sample_attacks_round,
 )
 from qba_tpu.config import QBAConfig
@@ -66,7 +67,6 @@ def _attack_triples(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
 # records {kind, round, sender_rank, recv_rank, v, a, b}.
 _TRACE_REC = 7
 _REASONS = ("accepted", "inconsistent", "duplicate-v", "wrong-evidence-len")
-_EFFECT_NAMES = ((1, "drop"), (2, "corrupt-v"), (4, "clear-P"), (8, "clear-L"))
 
 
 def _emit_trace(cfg: QBAConfig, log, trial: int, recs: np.ndarray) -> None:
@@ -112,10 +112,8 @@ def _emit_trace(cfg: QBAConfig, log, trial: int, recs: np.ndarray) -> None:
             log.debug("round", "late loss", trial=trial, round=rnd,
                       sender=sender, recv=recv)
         elif kind == 4:  # attack action (tfg.py:275-284)
-            names = [n for bit, n in _EFFECT_NAMES if a & bit]
             log.debug("round", "attack", trial=trial, round=rnd,
-                      sender=sender, recv=recv,
-                      action="+".join(names) if names else "none")
+                      sender=sender, recv=recv, action=effect_names(a))
         elif kind == 5:  # round receive (tfg.py:294)
             log.debug("round", "receive", trial=trial, round=rnd,
                       sender=sender, recv=recv, v=v, accepted=bool(a),

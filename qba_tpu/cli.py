@@ -60,6 +60,12 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
     )
     p.add_argument("--p-late", type=float, default=0.0)
     p.add_argument(
+        "--racy-mode", choices=("loss", "defer"), default="loss",
+        help="defer = deliver late packets one round later where the "
+        "evidence-length check rejects them (the reference's actual race "
+        "mechanism; message-level local backend, docs/DIVERGENCES.md D1)",
+    )
+    p.add_argument(
         "--attack-scope", choices=("delivery", "broadcast"),
         default="delivery",
         help="broadcast = reproduce the reference's shared-object "
@@ -79,6 +85,7 @@ def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
         round_engine=args.round_engine,
         delivery=args.delivery,
         p_late=args.p_late,
+        racy_mode=args.racy_mode,
         attack_scope=args.attack_scope,
     )
 

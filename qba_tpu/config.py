@@ -58,6 +58,18 @@ class QBAConfig:
         ``L.clear()`` at one recipient of a broadcast leak into every
         later recipient, and a forged ``v`` persists until re-forged.
         See docs/DIVERGENCES.md D3.
+      racy_mode: under ``delivery="racy"``: "loss" (default) — a late
+        packet is silently lost, the *effect* of the reference's barrier
+        race; or "defer" — the *mechanism*: the packet is delivered in
+        the next round's drain, where ``len(L) == round+1``
+        (``tfg.py:294``) necessarily rejects it.  Provably
+        decision-equivalent (a once-deferred packet can never satisfy
+        the evidence-length check); "defer" is implemented in the
+        message-level local backend so the event trail shows the real
+        wrong-evidence-len rejections, while the vectorized/native
+        engines keep the equivalent loss semantics —
+        ``tests/test_racy.py`` pins the cross-mode decision match.
+        See docs/DIVERGENCES.md D1.
     """
 
     n_parties: int
@@ -71,6 +83,7 @@ class QBAConfig:
     p_late: float = 0.0
     round_engine: str = "auto"
     attack_scope: str = "delivery"
+    racy_mode: str = "loss"
 
     def __post_init__(self) -> None:
         if self.n_parties < 2:
@@ -102,6 +115,10 @@ class QBAConfig:
             raise ValueError(f"unknown round_engine {self.round_engine!r}")
         if self.attack_scope not in ("delivery", "broadcast"):
             raise ValueError(f"unknown attack_scope {self.attack_scope!r}")
+        if self.racy_mode not in ("loss", "defer"):
+            raise ValueError(f"unknown racy_mode {self.racy_mode!r}")
+        if self.racy_mode == "defer" and self.delivery != "racy":
+            raise ValueError("racy_mode='defer' requires delivery='racy'")
 
     # Derived parameters (``tfg.py:316-318``).
     @property
