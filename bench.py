@@ -93,10 +93,12 @@ def main() -> None:
     )
     rounds_per_trial = cfg.n_rounds
 
-    # 5 reps: the remote-tunnel result fetch has ~30 ms of run-to-run
-    # jitter on top of a ~60 ms floor, so a few extra full-work reps make
+    # 8 reps: the remote-tunnel result fetch has ~30 ms of run-to-run
+    # jitter on top of a ~60 ms floor (and the floor itself drifts by
+    # tens of ms over minutes on the shared tunnel), so extra full-work
+    # reps make
     # the best-of estimate much less noisy.
-    dt = _measure_jax(cfg, reps=2 if quick else 5)
+    dt = _measure_jax(cfg, reps=2 if quick else 8)
     rps = cfg.trials * rounds_per_trial / dt
     print(f"jax: {cfg.trials} trials in {dt:.3f}s -> {rps:.1f} rounds/s", file=sys.stderr)
 

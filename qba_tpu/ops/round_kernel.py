@@ -537,17 +537,31 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
 
 # Scoped VMEM available to a kernel instance (v5e exposes 16 MB; leave
 # headroom for Mosaic's own scratch).
-_VMEM_BUDGET_BYTES = 10 * 2**20
+_VMEM_BUDGET_BYTES = 15 * 2**20
 
 
 def fits_kernel(cfg: QBAConfig) -> bool:
     """Whether the round kernel's per-trial working set fits in VMEM.
 
     The kernel holds the mailbox (in + out) plus ~a dozen
-    ``[n_pk, size_l]``-sized intermediates per receiver iteration.  At
-    the reference's sizeL=1000 with 5 traitors that is ~20 MB — over the
-    16 MB scoped-vmem limit (observed compile failure) — so ``auto``
-    engine selection falls back to the XLA path for such configs.
+    ``[n_pk, size_l]``-sized intermediates per receiver iteration, and
+    Mosaic's stack grows with the statically unrolled evidence-row loops.
+    Calibration points against the real 16 MB scoped-vmem limit:
+
+    * nParties=11, sizeL=64, nDishonest=3 (slots=16, max_l=3+2=5 —
+      the headline) — runs.
+    * nParties=33, sizeL=64, nDishonest=10, slots=4 (max_l=12) — runs
+      (~13 MB).
+    * nParties=33, sizeL=64, nDishonest=10, slots=8 (max_l=12) —
+      observed compile OOM at 25.45 MB against the 16 MB limit.
+    * nParties=11, sizeL=1000, nDishonest=5 (slots=16, max_l=7 — the
+      reference scale) — observed compile OOM (~20 MB).
+
+    The raw tile count underestimates the stack's growth in ``max_l``
+    by ~4x at max_l=12, hence the ``1 + max_l/4`` scale below (exact at
+    the observed OOM point, safely conservative at the headline).
+    ``auto`` engine selection falls back to the XLA path when this
+    returns False.
     """
     n_pk = cfg.n_lieutenants * cfg.slots
     tile = 4 * n_pk * cfg.size_l
@@ -564,4 +578,6 @@ def fits_kernel(cfg: QBAConfig) -> bool:
     # triangular prefix-sum operand (f32/bf16) and the one-hot gather
     # scratch.
     est += n_pk * n_pk * 8
+    # Mosaic stack scaling with the unrolled row loops (see calibration).
+    est = int(est * (1.0 + cfg.max_l / 4.0))
     return est <= _VMEM_BUDGET_BYTES

@@ -97,6 +97,15 @@ class TestEngineSelection:
             QBAConfig(n_parties=11, size_l=1000, n_dishonest=5)
         )
 
+    def test_vmem_calibration_points_at_33_parties(self):
+        # Observed on TPU v5e (16 MB scoped vmem): slots=4 runs (~13 MB),
+        # slots=8 OOMs at 25.45 MB — the estimate must classify both.
+        from qba_tpu.ops.round_kernel import fits_kernel
+
+        base = dict(n_parties=33, size_l=64, n_dishonest=10)
+        assert fits_kernel(QBAConfig(**base, max_accepts_per_round=4))
+        assert not fits_kernel(QBAConfig(**base, max_accepts_per_round=8))
+
     def test_explicit_engine_respected(self):
         from qba_tpu.rounds.engine import resolve_round_engine
 
