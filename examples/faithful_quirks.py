@@ -1,0 +1,65 @@
+"""Reproducing the reference's *actual* quirks, not just its intent.
+
+Two behaviors of ``tfg.py`` are implementation accidents rather than
+protocol design, and both are available as opt-in modes (next to the
+idealized defaults):
+
+* ``attack_scope="broadcast"`` — the 4-action attack mutates shared
+  packet objects (``tfg.py:271-284``): a ``P.clear()`` / ``L.clear()``
+  chosen for one recipient leaks into every later recipient of the same
+  broadcast, and a forged order carries forward.  (Default
+  ``"delivery"`` samples each recipient independently.)
+* ``racy_mode="defer"`` — the barrier race (``tfg.py:335-348``)
+  delivers a late packet one round later, where the
+  ``len(L) == round+1`` check rejects it.  (Default ``"loss"`` models
+  the same outcome as silent loss.)
+
+The full per-packet event trail (every ``mpi_print`` site of the
+reference) shows both mechanisms at work.
+
+Usage: python examples/faithful_quirks.py
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+
+from qba_tpu import QBAConfig
+from qba_tpu.backends.local_backend import run_trial_local
+from qba_tpu.obs import EventLog, Level
+
+cfg = QBAConfig(
+    n_parties=5,
+    size_l=16,
+    n_dishonest=2,
+    attack_scope="broadcast",
+    delivery="racy",
+    p_late=0.4,
+    racy_mode="defer",
+)
+
+log = EventLog(min_level=Level.DEBUG)
+result = run_trial_local(cfg, jax.random.key(7), log=log)
+
+leaks = [
+    e for e in log.events
+    if e.message == "attack" and "+" in e.fields.get("action", "")
+]
+defers = [e for e in log.events if e.message == "late defer"]
+deferred_rejects = [
+    e for e in log.events
+    if e.message == "receive" and e.fields.get("deferred")
+]
+
+print(f"decisions: {result['decisions']}  success: {result['success']}")
+print(f"{len(log.events)} protocol events in the trail, including:")
+print(f"  {len(leaks)} leaked multi-edit attacks (broadcast scope), e.g.")
+for e in leaks[:3]:
+    print(f"    {e.render()}")
+print(f"  {len(defers)} deferred late packets (defer mode); all "
+      f"{len(deferred_rejects)} re-deliveries rejected:")
+for e in deferred_rejects[:3]:
+    print(f"    {e.render()}")

@@ -31,6 +31,8 @@ Layout conventions (per trial; ``vmap`` over trials prepends the grid):
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -537,31 +539,24 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
 
 # Scoped VMEM available to a kernel instance (v5e exposes 16 MB; leave
 # headroom for Mosaic's own scratch).
-_VMEM_BUDGET_BYTES = 15 * 2**20
+# Pre-filter bound for the compile probe.  The real gate is a one-time
+# compile attempt (kernel_compiles below): Mosaic's scoped-vmem use is
+# hard to model — observed actual/estimate ratios range from ~0.8x
+# (nParties=11, sizeL=1000, slots=16: est 25.8 MB, OOM at ~20 MB) to
+# ~3.7x (nParties=33, sizeL=64, slots=8: est 6.8 MB, OOM at 25.45 MB) —
+# so the estimate only screens out hopeless configs before paying for a
+# doomed compile.
+_VMEM_PREFILTER_BYTES = 64 * 2**20
 
 
 def fits_kernel(cfg: QBAConfig) -> bool:
-    """Whether the round kernel's per-trial working set fits in VMEM.
+    """Loose VMEM pre-filter for the round kernel.
 
-    The kernel holds the mailbox (in + out) plus ~a dozen
-    ``[n_pk, size_l]``-sized intermediates per receiver iteration, and
-    Mosaic's stack grows with the statically unrolled evidence-row loops.
-    Calibration points against the real 16 MB scoped-vmem limit:
-
-    * nParties=11, sizeL=64, nDishonest=3 (slots=16, max_l=3+2=5 —
-      the headline) — runs.
-    * nParties=33, sizeL=64, nDishonest=10, slots=4 (max_l=12) — runs
-      (~13 MB).
-    * nParties=33, sizeL=64, nDishonest=10, slots=8 (max_l=12) —
-      observed compile OOM at 25.45 MB against the 16 MB limit.
-    * nParties=11, sizeL=1000, nDishonest=5 (slots=16, max_l=7 — the
-      reference scale) — observed compile OOM (~20 MB).
-
-    The raw tile count underestimates the stack's growth in ``max_l``
-    by ~4x at max_l=12, hence the ``1 + max_l/4`` scale below (exact at
-    the observed OOM point, safely conservative at the headline).
-    ``auto`` engine selection falls back to the XLA path when this
-    returns False.
+    True means "plausibly fits — worth a compile probe", not "fits":
+    the authoritative check is :func:`kernel_compiles`, which attempts
+    the compile once per config shape and caches the outcome.  False
+    configs (e.g. the reference's sizeL=1000 at the default lossless
+    slot bound) skip the probe and go straight to the XLA engine.
     """
     n_pk = cfg.n_lieutenants * cfg.slots
     tile = 4 * n_pk * cfg.size_l
@@ -578,6 +573,63 @@ def fits_kernel(cfg: QBAConfig) -> bool:
     # triangular prefix-sum operand (f32/bf16) and the one-hot gather
     # scratch.
     est += n_pk * n_pk * 8
-    # Mosaic stack scaling with the unrolled row loops (see calibration).
+    # Mosaic stack scaling with the unrolled row loops (worst observed
+    # ratio; see the pre-filter note above).
     est = int(est * (1.0 + cfg.max_l / 4.0))
-    return est <= _VMEM_BUDGET_BYTES
+    return est <= _VMEM_PREFILTER_BYTES
+
+
+# Probe outcomes per kernel shape — a compile attempt is seconds on a
+# remote tunnel, so pay it once per (process, config shape).
+_PROBE_CACHE: dict[tuple, bool] = {}
+
+
+def kernel_compiles(cfg: QBAConfig) -> bool:
+    """Whether the round kernel actually compiles for this config.
+
+    Attempts a real (abstract-shape, data-free) compile of one round
+    step and caches the verdict.  This is the authoritative ``auto``
+    engine gate: Mosaic's scoped-vmem accounting cannot be predicted
+    reliably from the outside (see the pre-filter note), and a failed
+    probe here is exactly the failure the fallback must avoid at
+    run-trial compile time.
+    """
+    key = (cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l, cfg.w)
+    hit = _PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    if not fits_kernel(cfg):
+        _PROBE_CACHE[key] = False
+        return False
+    n_pk = cfg.n_lieutenants * cfg.slots
+    n_s, max_l, s, w = cfg.n_lieutenants, cfg.max_l, cfg.size_l, cfg.w
+    i32 = jnp.int32
+
+    def shp(*dims):
+        return jax.ShapeDtypeStruct(dims, i32)
+
+    try:
+        step = build_round_step(cfg)
+        jax.jit(step).lower(
+            shp(),  # round_idx
+            shp(max_l, n_pk, s), shp(n_pk, max_l), shp(n_pk, 1),
+            shp(n_pk, s), shp(n_pk, 1), shp(n_pk, 1),  # vals..sent
+            shp(n_s, s), shp(n_s, w), shp(n_pk, 1),  # li, vi, honest
+            shp(n_pk, n_s), shp(n_pk, n_s), shp(n_pk, n_s),  # draws
+        ).compile()
+        ok = True
+    except Exception as e:  # compile failures only reach here (no execution)
+        # Loud on purpose: a genuine VMEM overflow and a transient
+        # tunnel/infrastructure error both land here, and the fallback
+        # costs up to ~26x (docs/PERF.md) — the operator should see why.
+        warnings.warn(
+            "round kernel compile probe failed for "
+            f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
+            f"slots={cfg.slots}); falling back to the XLA round engine "
+            f"for this config: {e!r:.500}",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        ok = False
+    _PROBE_CACHE[key] = ok
+    return ok

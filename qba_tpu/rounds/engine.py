@@ -400,16 +400,17 @@ def run_rounds_pallas(
 
 
 def resolve_round_engine(cfg: QBAConfig) -> str:
-    """``auto`` -> the fused Pallas kernel on TPU when its per-trial
-    working set fits VMEM (:func:`qba_tpu.ops.round_kernel.fits_kernel`),
-    pure XLA elsewhere."""
+    """``auto`` -> the fused Pallas kernel on TPU when it compiles for
+    this config (:func:`qba_tpu.ops.round_kernel.kernel_compiles` — a
+    cached one-time compile probe behind a loose VMEM pre-filter), pure
+    XLA elsewhere."""
     if cfg.round_engine != "auto":
         return cfg.round_engine
     if jax.default_backend() != "tpu":
         return "xla"
-    from qba_tpu.ops.round_kernel import fits_kernel
+    from qba_tpu.ops.round_kernel import kernel_compiles
 
-    return "pallas" if fits_kernel(cfg) else "xla"
+    return "pallas" if kernel_compiles(cfg) else "xla"
 
 
 def run_trial(

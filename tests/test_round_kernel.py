@@ -86,25 +86,60 @@ class TestKernelEquivalence:
 
 
 class TestEngineSelection:
-    def test_vmem_fallback_at_reference_scale(self):
-        # sizeL=1000 with 5 traitors needs ~20 MB of VMEM in-kernel —
-        # over the 16 MB scoped limit (observed compile failure on TPU);
-        # auto selection must fall back to the XLA engine there.
+    def test_vmem_prefilter(self):
+        # fits_kernel is the loose pre-filter in front of the compile
+        # probe: plausible configs pass (the probe decides), hopeless
+        # ones (the reference's sizeL=1000 at the lossless slot bound,
+        # observed compile OOM on TPU) are rejected without paying for a
+        # doomed compile.
         from qba_tpu.ops.round_kernel import fits_kernel
 
         assert fits_kernel(QBAConfig(n_parties=11, size_l=64, n_dishonest=3))
+        assert fits_kernel(
+            QBAConfig(
+                n_parties=33, size_l=64, n_dishonest=10,
+                max_accepts_per_round=4,
+            )
+        )
         assert not fits_kernel(
             QBAConfig(n_parties=11, size_l=1000, n_dishonest=5)
         )
 
-    def test_vmem_calibration_points_at_33_parties(self):
-        # Observed on TPU v5e (16 MB scoped vmem): slots=4 runs (~13 MB),
-        # slots=8 OOMs at 25.45 MB — the estimate must classify both.
-        from qba_tpu.ops.round_kernel import fits_kernel
+    @pytest.fixture
+    def clean_probe_cache(self):
+        import qba_tpu.ops.round_kernel as rk
 
-        base = dict(n_parties=33, size_l=64, n_dishonest=10)
-        assert fits_kernel(QBAConfig(**base, max_accepts_per_round=4))
-        assert not fits_kernel(QBAConfig(**base, max_accepts_per_round=8))
+        rk._PROBE_CACHE.clear()
+        yield rk
+        rk._PROBE_CACHE.clear()
+
+    def test_probe_skipped_when_prefiltered(self, monkeypatch, clean_probe_cache):
+        # A config outside the pre-filter must return False without
+        # attempting a compile.
+        rk = clean_probe_cache
+
+        def boom(*a, **k):  # pragma: no cover - failure path
+            raise AssertionError("probe compiled a prefiltered config")
+
+        monkeypatch.setattr(rk, "build_round_step", boom)
+        cfg = QBAConfig(n_parties=11, size_l=1000, n_dishonest=5)
+        assert rk.kernel_compiles(cfg) is False
+
+    def test_probe_result_cached(self, monkeypatch, clean_probe_cache):
+        rk = clean_probe_cache
+        calls = []
+        real = rk.build_round_step
+
+        def counting(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(rk, "build_round_step", counting)
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=1)
+        first = rk.kernel_compiles(cfg)
+        second = rk.kernel_compiles(cfg)
+        assert first == second
+        assert len(calls) == 1  # probe ran exactly once, result cached
 
     def test_explicit_engine_respected(self):
         from qba_tpu.rounds.engine import resolve_round_engine
