@@ -70,15 +70,43 @@ def _cumsum_exclusive(col: jnp.ndarray, n: int) -> jnp.ndarray:
     ).astype(jnp.int32)
 
 
-def _lane_group(cfg: QBAConfig) -> int:
+def _lane_group(size_l: int, n_recv: int) -> int:
     """Receivers packed side by side per lane tile (kernel v4): fill the
     VPU's 128 lanes when size_l is narrow; 1 when a single receiver's
     positions already span a full tile.  Shared by the kernel builder and
     the fits_kernel VMEM estimate so they cannot drift."""
-    return max(1, min(128 // cfg.size_l, cfg.n_lieutenants))
+    return max(1, min(128 // size_l, n_recv))
 
 
-def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
+def pack_mailbox(mb, n_rows: int, max_l: int, size_l: int):
+    """Mailbox pytree -> the kernel's operand layout (shared by the
+    single-device and party-sharded callers so the layout contract lives
+    in exactly one place next to the kernel that defines it)."""
+    return (
+        mb.vals.reshape(n_rows, max_l, size_l).transpose(1, 0, 2),
+        mb.lens.reshape(n_rows, max_l),
+        mb.count.reshape(n_rows, 1),
+        mb.p_mask.reshape(n_rows, size_l).astype(jnp.int32),
+        mb.v.reshape(n_rows, 1),
+        mb.sent.reshape(n_rows, 1).astype(jnp.int32),
+    )
+
+
+def honest_packets(honest, cfg: QBAConfig):
+    """Per-packet sender-honesty column [n_pk, 1] from the rank-indexed
+    honesty mask (the kernel's honest_pk operand)."""
+    n_pk = cfg.n_lieutenants * cfg.slots
+    senders = jnp.arange(n_pk) // cfg.slots
+    return honest[senders + 2].astype(jnp.int32)[:, None]
+
+
+def build_round_step(
+    cfg: QBAConfig,
+    *,
+    interpret: bool = False,
+    n_recv: int | None = None,
+    out_vma: frozenset | None = None,
+):
     """Compile one synchronous voting round for one trial.
 
     Returns ``step(round_idx, vals, lens, count, p, v, sent, li, vi,
@@ -88,11 +116,25 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
     :func:`qba_tpu.adversary.sample_attacks_round` (bit0 drop, bit1
     forge-v, bit2 clear-P, bit3 clear-L) — scope semantics are folded in
     before the kernel, so the kernel algebra is scope-agnostic.
+
+    ``n_recv`` builds the party-sharded variant for
+    :mod:`qba_tpu.parallel.spmd`: the kernel drains the inbox of a
+    contiguous block of ``n_recv`` receivers against the FULL gathered
+    mailbox, taking the block's first receiver index as an extra
+    *runtime* operand (every device runs the same program under
+    shard_map, so the offset cannot be compile-time).  ``step`` then has
+    signature ``step(round_idx, recv_off, vals..., li_local, vi_local,
+    honest_pk, attack_local, rand_v_local, late_local)`` with the
+    receiver-indexed operands holding only the local block's rows /
+    columns, and returns the local block's outgoing mailbox cells + vi.
     """
     n_s, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
     size_l, w = cfg.size_l, cfg.w
     n_pk = n_s * slots
     n_dis = cfg.n_dishonest
+    local = n_recv is not None
+    n_rv = n_recv if local else n_s  # receivers this kernel drains
+    n_c = n_rv * slots  # outgoing mailbox cells produced
     # Matmul operand dtype: bf16 is exact for integers of magnitude
     # <= 256; larger list lengths / order ranges fall back to f32.
     gdt = jnp.bfloat16 if size_l <= 256 and w <= 256 else jnp.float32
@@ -109,25 +151,47 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
     # group re-covers the tail (overlap recomputes identical values; the
     # member loop below skips already-processed receivers so the
     # non-idempotent vi update runs exactly once per receiver).
-    grp = _lane_group(cfg)
+    grp = _lane_group(size_l, n_rv)
     seg_l = grp * size_l
-    r0_list = list(range(0, n_s - grp + 1, grp))
-    if n_s % grp:
-        r0_list.append(n_s - grp)
+    r0_list = list(range(0, n_rv - grp + 1, grp))
+    if n_rv % grp:
+        r0_list.append(n_rv - grp)
     e_np = np.zeros((grp, seg_l), np.float32)
     for j in range(grp):
         e_np[j, j * size_l : (j + 1) * size_l] = 1.0
 
     def kernel(round_ref, *refs):
-        (
-            vals_ref, lens_ref, count_ref, p_ref, v_ref, sent_ref,
-            li_ref, vi_ref, honest_ref, act_ref, rv_ref, late_ref,
-            e_ref, lip_ref, lioob_ref,
-            ovals_ref, olens_ref, ocount_ref, op_ref, ov_ref,
-            osent_ref, ovi_ref, oovf_ref,
-            acc_scr, dup_scr, olen_scr, g_scr,
-        ) = refs
-        r_idx = round_ref[0]
+        def scalar_read(ref):
+            # In interpret mode under shard_map's replication checker,
+            # ``ref[0]`` stages a dynamic_slice whose literal index lacks
+            # the operand's vma; a full load + squeeze avoids the slice.
+            # Mosaic (the real TPU path) keeps the canonical SMEM read.
+            if interpret:
+                return ref[:].reshape(())
+            return ref[0]
+
+        if local:
+            (
+                off_ref,
+                vals_ref, lens_ref, count_ref, p_ref, v_ref, sent_ref,
+                li_ref, vi_ref, honest_ref, act_ref, rv_ref, late_ref,
+                e_ref, lip_ref, lioob_ref,
+                ovals_ref, olens_ref, ocount_ref, op_ref, ov_ref,
+                osent_ref, ovi_ref, oovf_ref,
+                acc_scr, dup_scr, olen_scr, g_scr,
+            ) = refs
+            r_off = scalar_read(off_ref)  # block's first receiver (runtime)
+        else:
+            (
+                vals_ref, lens_ref, count_ref, p_ref, v_ref, sent_ref,
+                li_ref, vi_ref, honest_ref, act_ref, rv_ref, late_ref,
+                e_ref, lip_ref, lioob_ref,
+                ovals_ref, olens_ref, ocount_ref, op_ref, ov_ref,
+                osent_ref, ovi_ref, oovf_ref,
+                acc_scr, dup_scr, olen_scr, g_scr,
+            ) = refs
+            r_off = 0
+        r_idx = scalar_read(round_ref)
         idx_col = jax.lax.broadcasted_iota(jnp.int32, (n_pk, 1), 0)
         sender_col = idx_col // slots
 
@@ -188,10 +252,12 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         # The draws are packet-major, so every per-receiver corruption
         # flag is computed for all receivers in one tile op; the unrolled
         # receiver loop below consumes relayout-free lane slices.
-        act_all = act_ref[:]  # [n_pk, n_lieu]
+        act_all = act_ref[:]  # [n_pk, n_rv]
         rv_all = rv_ref[:]
         late_all = late_ref[:]
-        lane_recv = jax.lax.broadcasted_iota(jnp.int32, (n_pk, n_s), 1)
+        lane_recv = (
+            jax.lax.broadcasted_iota(jnp.int32, (n_pk, n_rv), 1) + r_off
+        )
         dropped_all = biz & ((act_all & DROP_BIT) != 0)
         v2_all = jnp.where(biz & ((act_all & FORGE_BIT) != 0), rv_all, v_in)
         clearp_all = biz & ((act_all & CLEAR_P_BIT) != 0)
@@ -385,7 +451,7 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         # ---- Batched slot allocation (tfg.py:298-299), all receivers -----
         # One triangular MXU matmul computes every receiver's exclusive
         # prefix count at once (the per-receiver version was n_s matmuls).
-        acc_all = acc_scr[:] != 0  # [n_pk, n_lieu]
+        acc_all = acc_scr[:] != 0  # [n_pk, n_rv]
         dup_all = dup_scr[:] != 0
         olen_all = olen_scr[:]
         rebroadcast_all = acc_all & (r_idx <= n_dis)
@@ -404,7 +470,7 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         # column block — G[pk, c] = 1 iff packet pk feeds output cell c
         # (injective: each cell has at most one source).
         iota_s = jax.lax.broadcasted_iota(jnp.int32, (n_pk, slots), 1)
-        for recv in range(n_s):
+        for recv in range(n_rv):
             g_r = write_all[:, recv : recv + 1] & (
                 slot_all[:, recv : recv + 1] == iota_s
             )
@@ -420,8 +486,9 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         # is an integer of magnitude <= 256 (vals < w, lengths <= size_l,
         # G is 0/1); larger configs fall back to f32 (see gdt).
         big_g = g_scr[:]
-        row_c = jax.lax.broadcasted_iota(jnp.int32, (n_pk, n_s), 0)
-        recv_onehot = (lane_recv == row_c // slots).astype(jnp.float32)
+        row_c = jax.lax.broadcasted_iota(jnp.int32, (n_c, n_rv), 0)
+        lane_rv_c = jax.lax.broadcasted_iota(jnp.int32, (n_c, n_rv), 1)
+        recv_onehot = (lane_rv_c == row_c // slots).astype(jnp.float32)
 
         def gmat(x):  # [n_pk(src), X] -> f32 [n_pk(c), X]
             return jax.lax.dot_general(
@@ -437,7 +504,7 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
                 jnp.int32
             )
 
-        has = gsel(jnp.ones((n_pk, n_s), jnp.int32)) != 0  # [c, 1]
+        has = gsel(jnp.ones((n_pk, n_rv), jnp.int32)) != 0  # [c, 1]
         v2_g = gsel(v2_all)
         cnt_g = gsel(count_eff_all)
         dup_g = gsel(dup_all.astype(jnp.int32))
@@ -460,7 +527,7 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         p2_g = (pin_g != 0) & (clrp_g == 0)
         own_g = jnp.where(p2_g, li_exp, SENTINEL)
 
-        iota_l = jax.lax.broadcasted_iota(jnp.int32, (n_pk, max_l), 1)
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (n_c, max_l), 1)
         keep_row = (clr_g == 0) & (iota_l < cnt_g)
         new_row = (dup_g == 0) & (iota_l == cnt_g)
         olens_ref[:] = jnp.where(
@@ -480,15 +547,23 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
         ov_ref[:] = jnp.where(has, v2_g, 0)
         osent_ref[:] = has.astype(jnp.int32)
 
+    # Inside shard_map with its replication checker on, pallas outputs
+    # must declare which mesh axes they vary over (out_vma; the
+    # party-sharded spmd engine passes its mesh axes).
+    def oshp(*dims):
+        if out_vma is None:
+            return jax.ShapeDtypeStruct(dims, jnp.int32)
+        return jax.ShapeDtypeStruct(dims, jnp.int32, vma=out_vma)
+
     out_shapes = (
-        jax.ShapeDtypeStruct((max_l, n_pk, size_l), jnp.int32),  # vals
-        jax.ShapeDtypeStruct((n_pk, max_l), jnp.int32),  # lens
-        jax.ShapeDtypeStruct((n_pk, 1), jnp.int32),  # count
-        jax.ShapeDtypeStruct((n_pk, size_l), jnp.int32),  # p
-        jax.ShapeDtypeStruct((n_pk, 1), jnp.int32),  # v
-        jax.ShapeDtypeStruct((n_pk, 1), jnp.int32),  # sent
-        jax.ShapeDtypeStruct((n_s, w), jnp.int32),  # vi
-        jax.ShapeDtypeStruct((1, 1), jnp.int32),  # overflow
+        oshp(max_l, n_c, size_l),  # vals
+        oshp(n_c, max_l),  # lens
+        oshp(n_c, 1),  # count
+        oshp(n_c, size_l),  # p
+        oshp(n_c, 1),  # v
+        oshp(n_c, 1),  # sent
+        oshp(n_rv, w),  # vi
+        oshp(1, 1),  # overflow
     )
 
     # The mailbox + vi inputs are donated into the corresponding outputs:
@@ -499,46 +574,79 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
     # count/p/v/sent are read exactly once at the top; vi is copied into
     # ovi and only ovi is read after).
     n_vmem_in = 15
+    n_smem_in = 2 if local else 1  # round_idx [+ recv offset]
+    # The local variant cannot alias the global mailbox inputs into its
+    # block-local outputs (shapes differ); vi still aliases.
+    if local:
+        aliases = {n_smem_in + 7: 6}  # vi -> ovi
+    else:
+        aliases = {1: 0, 2: 1, 3: 2, 4: 3, 5: 4, 6: 5, 8: 6}
     call = pl.pallas_call(
         kernel,
         out_shape=out_shapes,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * n_smem_in
         + [pl.BlockSpec(memory_space=pltpu.VMEM)] * n_vmem_in,
         out_specs=tuple(
             pl.BlockSpec(memory_space=pltpu.VMEM) for _ in out_shapes
         ),
-        input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3, 5: 4, 6: 5, 8: 6},
+        input_output_aliases=aliases,
         scratch_shapes=[
-            pltpu.VMEM((n_pk, n_s), jnp.int32),  # acc_scr
-            pltpu.VMEM((n_pk, n_s), jnp.int32),  # dup_scr
-            pltpu.VMEM((n_pk, n_s), jnp.int32),  # olen_scr
-            pltpu.VMEM((n_pk, n_pk), gdt),  # g_scr
+            pltpu.VMEM((n_pk, n_rv), jnp.int32),  # acc_scr
+            pltpu.VMEM((n_pk, n_rv), jnp.int32),  # dup_scr
+            pltpu.VMEM((n_pk, n_rv), jnp.int32),  # olen_scr
+            pltpu.VMEM((n_pk, n_c), gdt),  # g_scr
         ],
         interpret=interpret,
     )
 
-    def step(round_idx, vals, lens, count, p, v, sent, li, vi, honest_pk,
-             attack, rand_v, late):
-        # Draws arrive packet-major [n_pk, n_lieu] straight from
-        # sample_attacks_round — no transpose anywhere on the path.
-        base = (
-            jnp.asarray([round_idx], jnp.int32),
-            vals, lens, count, p, v, sent, li, vi, honest_pk,
-            attack, rand_v, late,
-        )
+    def _pv(x):
+        # Under shard_map's replication checker every pallas operand must
+        # carry the declared vma; constants (E, the scalar round index)
+        # and replicated values get promoted explicitly.
+        if out_vma is None:
+            return x
+        have = getattr(jax.typeof(x), "vma", frozenset())
+        need = tuple(a for a in out_vma if a not in have)
+        return jax.lax.pcast(x, need, to="varying") if need else x
+
+    def _tail(li):
         # Lane-packed receiver tables (cheap XLA reshapes outside the
         # kernel; per trial under vmap like li itself).
         li_pack = jnp.stack(
             [li[r0 : r0 + grp].reshape(-1) for r0 in r0_list]
         )  # [n_groups, seg_l]
         li_oob_pack = ((li_pack > w) | (li_pack < 0)).astype(jnp.int32)
-        return call(*base, jnp.asarray(e_np), li_pack, li_oob_pack)
+        return jnp.asarray(e_np), li_pack, li_oob_pack
+
+    if local:
+
+        def step(round_idx, recv_off, vals, lens, count, p, v, sent, li,
+                 vi, honest_pk, attack, rand_v, late):
+            # Mailbox operands are GLOBAL; li/vi/draw columns are the
+            # local receiver block's; recv_off is its first receiver.
+            args = (
+                jnp.asarray([round_idx], jnp.int32),
+                jnp.asarray(recv_off, jnp.int32).reshape(1),
+                vals, lens, count, p, v, sent, li, vi, honest_pk,
+                attack, rand_v, late, *_tail(li),
+            )
+            return call(*map(_pv, args))
+
+    else:
+
+        def step(round_idx, vals, lens, count, p, v, sent, li, vi,
+                 honest_pk, attack, rand_v, late):
+            # Draws arrive packet-major [n_pk, n_lieu] straight from
+            # sample_attacks_round — no transpose anywhere on the path.
+            return call(
+                jnp.asarray([round_idx], jnp.int32),
+                vals, lens, count, p, v, sent, li, vi, honest_pk,
+                attack, rand_v, late, *_tail(li),
+            )
 
     return step
 
 
-# Scoped VMEM available to a kernel instance (v5e exposes 16 MB; leave
-# headroom for Mosaic's own scratch).
 # Pre-filter bound for the compile probe.  The real gate is a one-time
 # compile attempt (kernel_compiles below): Mosaic's scoped-vmem use is
 # hard to model — observed actual/estimate ratios range from ~0.8x
@@ -549,7 +657,7 @@ def build_round_step(cfg: QBAConfig, *, interpret: bool = False):
 _VMEM_PREFILTER_BYTES = 64 * 2**20
 
 
-def fits_kernel(cfg: QBAConfig) -> bool:
+def fits_kernel(cfg: QBAConfig, n_recv: int | None = None) -> bool:
     """Loose VMEM pre-filter for the round kernel.
 
     True means "plausibly fits — worth a compile probe", not "fits":
@@ -557,7 +665,11 @@ def fits_kernel(cfg: QBAConfig) -> bool:
     the compile once per config shape and caches the outcome.  False
     configs (e.g. the reference's sizeL=1000 at the default lossless
     slot bound) skip the probe and go straight to the XLA engine.
+    ``n_recv`` estimates the party-sharded local-block variant, whose
+    working set shrinks with the block (smaller grp tiles, an
+    ``[n_pk, n_recv*slots]`` gather scratch).
     """
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
     n_pk = cfg.n_lieutenants * cfg.slots
     tile = 4 * n_pk * cfg.size_l
     # Tile count: mailbox in + out refs (2*max_l), loaded row values and
@@ -566,13 +678,13 @@ def fits_kernel(cfg: QBAConfig) -> bool:
     est = tile * (4 * cfg.max_l + 12)
     # Lane-packed receiver tables (kernel v4): grp copies of the packet
     # tables plus ~6 [n_pk, grp*size_l] group intermediates.
-    grp = _lane_group(cfg)
+    grp = _lane_group(cfg.size_l, n_rv)
     if grp > 1:
         est += tile * grp * (cfg.max_l + 6)
-    # Plus the [n_pk, n_pk] working set of the batched rebuild: the
-    # triangular prefix-sum operand (f32/bf16) and the one-hot gather
-    # scratch.
-    est += n_pk * n_pk * 8
+    # Plus the working set of the batched rebuild: the triangular
+    # prefix-sum operand (f32/bf16, [n_pk, n_pk]) and the one-hot gather
+    # scratch ([n_pk, n_recv*slots]).
+    est += n_pk * n_pk * 4 + n_pk * n_rv * cfg.slots * 4
     # Mosaic stack scaling with the unrolled row loops (worst observed
     # ratio; see the pre-filter note above).
     est = int(est * (1.0 + cfg.max_l / 4.0))
@@ -584,7 +696,7 @@ def fits_kernel(cfg: QBAConfig) -> bool:
 _PROBE_CACHE: dict[tuple, bool] = {}
 
 
-def kernel_compiles(cfg: QBAConfig) -> bool:
+def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
     """Whether the round kernel actually compiles for this config.
 
     Attempts a real (abstract-shape, data-free) compile of one round
@@ -592,30 +704,35 @@ def kernel_compiles(cfg: QBAConfig) -> bool:
     engine gate: Mosaic's scoped-vmem accounting cannot be predicted
     reliably from the outside (see the pre-filter note), and a failed
     probe here is exactly the failure the fallback must avoid at
-    run-trial compile time.
+    run-trial compile time.  ``n_recv`` probes the party-sharded
+    local-block variant instead (see :func:`build_round_step`).
     """
-    key = (cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l, cfg.w)
+    key = (cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l, cfg.w,
+           n_recv)
     hit = _PROBE_CACHE.get(key)
     if hit is not None:
         return hit
-    if not fits_kernel(cfg):
+    if not fits_kernel(cfg, n_recv):
         _PROBE_CACHE[key] = False
         return False
     n_pk = cfg.n_lieutenants * cfg.slots
     n_s, max_l, s, w = cfg.n_lieutenants, cfg.max_l, cfg.size_l, cfg.w
+    n_rv = n_recv if n_recv is not None else n_s
     i32 = jnp.int32
 
     def shp(*dims):
         return jax.ShapeDtypeStruct(dims, i32)
 
     try:
-        step = build_round_step(cfg)
+        step = build_round_step(cfg, n_recv=n_recv)
+        off = () if n_recv is None else (shp(),)
         jax.jit(step).lower(
             shp(),  # round_idx
+            *off,  # recv block offset (local variant)
             shp(max_l, n_pk, s), shp(n_pk, max_l), shp(n_pk, 1),
             shp(n_pk, s), shp(n_pk, 1), shp(n_pk, 1),  # vals..sent
-            shp(n_s, s), shp(n_s, w), shp(n_pk, 1),  # li, vi, honest
-            shp(n_pk, n_s), shp(n_pk, n_s), shp(n_pk, n_s),  # draws
+            shp(n_rv, s), shp(n_rv, w), shp(n_pk, 1),  # li, vi, honest
+            shp(n_pk, n_rv), shp(n_pk, n_rv), shp(n_pk, n_rv),  # draws
         ).compile()
         ok = True
     except Exception as e:  # compile failures only reach here (no execution)

@@ -39,7 +39,13 @@ from qba_tpu.rounds.engine import (
 )
 
 
-def _trial_party_sharded(cfg: QBAConfig, n_tp: int, key: jax.Array) -> TrialResult:
+def _trial_party_sharded(
+    cfg: QBAConfig,
+    n_tp: int,
+    key: jax.Array,
+    engine: str = "xla",
+    vma_axes: frozenset | None = None,
+) -> TrialResult:
     """One trial with lieutenants sharded over the bound ``tp`` mesh axis.
 
     Runs inside ``shard_map`` (and under ``vmap`` over local trials).
@@ -67,34 +73,86 @@ def _trial_party_sharded(cfg: QBAConfig, n_tp: int, key: jax.Array) -> TrialResu
     )
     mb_local = Mailbox(*out_cells)
 
-    def gather_tp(x):
-        return jax.lax.all_gather(x, "tp", axis=0, tiled=True)
+    def gather_tp(x, axis=0):
+        return jax.lax.all_gather(x, "tp", axis=axis, tiled=True)
 
     # Step 3b (tfg.py:337-348): each round's traffic = one all_gather of
     # the local mailbox rows over tp (replaces the reference's Isend
-    # storm + Iprobe drain + Barrier).
-    def round_body(carry, round_idx):
-        vi_l, mb_local = carry
-        mb_full = jax.tree.map(gather_tp, mb_local)
-        k_round = jax.random.fold_in(k_rounds, round_idx)
-        # Same batched round draws as the single-device engines; each
-        # device consumes its own receivers' rows, so placement cannot
-        # change the randomness.
-        draws = sample_attacks_round(cfg, k_round)
-        my_draws = tuple(
-            jax.lax.dynamic_slice_in_dim(d, start, n_local, 1) for d in draws
+    # storm + Iprobe drain + Barrier).  Two bit-identical engines, like
+    # the single-device path: vectorized XLA, or the fused Pallas round
+    # kernel in its party-sharded variant (each device's kernel drains
+    # only its receiver block against the gathered global mailbox).
+    if engine == "pallas":
+        from qba_tpu.ops.round_kernel import (
+            build_round_step,
+            honest_packets,
+            pack_mailbox,
         )
-        vi_l, out_cells, ovf = jax.vmap(
-            lambda d, r, vrow, li: receiver_round(
-                cfg, round_idx, d, r, vrow, li, mb_full, honest
-            ),
-            in_axes=(1, 0, 0, 0),
-        )(my_draws, my_ids, vi_l, my_li)
-        return (vi_l, Mailbox(*out_cells)), jnp.any(ovf)
 
-    (vi_l, _), overflows = jax.lax.scan(
-        round_body, (vi_l, mb_local), jnp.arange(1, cfg.n_rounds + 1)
-    )
+        step = build_round_step(
+            cfg,
+            interpret=jax.default_backend() != "tpu",
+            n_recv=n_local,
+            out_vma=vma_axes,
+        )
+        honest_pk = honest_packets(honest, cfg)
+        n_c = n_local * cfg.slots
+
+        def pack_local(mb):
+            return pack_mailbox(mb, n_c, cfg.max_l, cfg.size_l)
+
+        def round_body(carry, round_idx):
+            vi_i32, packed_local = carry
+            # The gathered global mailbox in kernel layout: device
+            # blocks concatenate in tp order = global packet-major
+            # (sender, slot) order.
+            packed_full = tuple(
+                gather_tp(x, axis=1 if i == 0 else 0)
+                for i, x in enumerate(packed_local)
+            )
+            k_round = jax.random.fold_in(k_rounds, round_idx)
+            draws = sample_attacks_round(cfg, k_round)
+            att, rv, late = (
+                jax.lax.dynamic_slice_in_dim(d, start, n_local, 1)
+                for d in draws
+            )
+            out = step(
+                round_idx, start, *packed_full, my_li, vi_i32, honest_pk,
+                att.astype(jnp.int32), rv.astype(jnp.int32),
+                late.astype(jnp.int32),
+            )
+            return (out[6], tuple(out[:6])), out[7][0, 0] > 0
+
+        init = (vi_l.astype(jnp.int32), pack_local(mb_local))
+        (vi_i32, _), overflows = jax.lax.scan(
+            round_body, init, jnp.arange(1, cfg.n_rounds + 1)
+        )
+        vi_l = vi_i32 != 0
+    else:
+
+        def round_body(carry, round_idx):
+            vi_l, mb_local = carry
+            mb_full = jax.tree.map(gather_tp, mb_local)
+            k_round = jax.random.fold_in(k_rounds, round_idx)
+            # Same batched round draws as the single-device engines; each
+            # device consumes its own receivers' rows, so placement cannot
+            # change the randomness.
+            draws = sample_attacks_round(cfg, k_round)
+            my_draws = tuple(
+                jax.lax.dynamic_slice_in_dim(d, start, n_local, 1)
+                for d in draws
+            )
+            vi_l, out_cells, ovf = jax.vmap(
+                lambda d, r, vrow, li: receiver_round(
+                    cfg, round_idx, d, r, vrow, li, mb_full, honest
+                ),
+                in_axes=(1, 0, 0, 0),
+            )(my_draws, my_ids, vi_l, my_li)
+            return (vi_l, Mailbox(*out_cells)), jnp.any(ovf)
+
+        (vi_l, _), overflows = jax.lax.scan(
+            round_body, (vi_l, mb_local), jnp.arange(1, cfg.n_rounds + 1)
+        )
 
     # Recombine the accepted-sets so every device holds the full decision
     # vector, then decide + verdict as usual.  Scatter-into-zeros + psum
@@ -112,18 +170,34 @@ def _trial_party_sharded(cfg: QBAConfig, n_tp: int, key: jax.Array) -> TrialResu
     return finish_trial(cfg, vi, v_comm, honest, overflow)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _spmd_batch(cfg: QBAConfig, mesh: Mesh, keys: jax.Array) -> TrialResult:
+@functools.partial(jax.jit, static_argnums=(0, 1, 3))
+def _spmd_batch(
+    cfg: QBAConfig, mesh: Mesh, keys: jax.Array, engine: str = "xla"
+) -> TrialResult:
     n_tp = axis_sizes(mesh)["tp"]
     key_spec = P("dp") if "dp" in mesh.axis_names else P()
 
-    def body(local_keys):
-        return jax.vmap(lambda k: _trial_party_sharded(cfg, n_tp, k))(local_keys)
+    vma_axes = frozenset(mesh.axis_names)
 
-    # check_vma stays ON: the trial body ends in psums over tp, which the
-    # replication checker can statically verify (see _trial_party_sharded).
+    def body(local_keys):
+        return jax.vmap(
+            lambda k: _trial_party_sharded(cfg, n_tp, k, engine, vma_axes)
+        )(local_keys)
+
+    # check_vma stays ON for the production paths: the trial body ends in
+    # psums over tp, which the replication checker can statically verify
+    # (see _trial_party_sharded), and on real TPU the pallas round step is
+    # an opaque call with declared output vma.  The one exception is the
+    # kernel's interpret mode (CPU tests): pallas-interpret stages ref
+    # reads as dynamic_slices whose literal indices lack the operand's
+    # vma, which the checker rejects — a JAX limitation its own error
+    # message works around with check_vma=False.
+    use_check_vma = not (
+        engine == "pallas" and jax.default_backend() != "tpu"
+    )
     shard = jax.shard_map(
-        body, mesh=mesh, in_specs=key_spec, out_specs=key_spec
+        body, mesh=mesh, in_specs=key_spec, out_specs=key_spec,
+        check_vma=use_check_vma,
     )
     return shard(keys)
 
@@ -149,4 +223,18 @@ def run_trials_spmd(
     dp, tp = axes.get("dp", 1), axes["tp"]
     require_divisible(keys.shape[0], dp, "trials", "dp")
     require_divisible(cfg.n_lieutenants, tp, "n_lieutenants", "tp")
-    return aggregate(_spmd_batch(cfg, mesh, keys))
+    engine = _resolve_spmd_engine(cfg, cfg.n_lieutenants // tp)
+    return aggregate(_spmd_batch(cfg, mesh, keys, engine))
+
+
+def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:
+    """Engine for the party-sharded round loop: the Pallas kernel's
+    party-sharded variant when forced or when ``auto`` on TPU and the
+    local-block kernel compiles; vectorized XLA otherwise."""
+    if cfg.round_engine == "pallas":
+        return "pallas"
+    if cfg.round_engine != "auto" or jax.default_backend() != "tpu":
+        return "xla"
+    from qba_tpu.ops.round_kernel import kernel_compiles
+
+    return "pallas" if kernel_compiles(cfg, n_recv=n_local) else "xla"

@@ -361,24 +361,19 @@ def run_rounds_pallas(
     (:func:`qba_tpu.ops.round_kernel.build_round_step`): one kernel per
     round per trial, mailbox in VMEM, packets in sublanes.  Bit-identical
     verdicts to :func:`run_rounds_xla` (tests/test_round_kernel.py)."""
-    from qba_tpu.ops.round_kernel import build_round_step
+    from qba_tpu.ops.round_kernel import (
+        build_round_step,
+        honest_packets,
+        pack_mailbox,
+    )
 
     step = build_round_step(cfg, interpret=interpret)
     n_s, slots, max_l, s = cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l
     n_pk = n_s * slots
-
-    senders = jnp.arange(n_pk) // slots
-    honest_pk = honest[senders + 2].astype(jnp.int32)[:, None]  # [n_pk, 1]
+    honest_pk = honest_packets(honest, cfg)  # [n_pk, 1]
 
     def pack(mb):
-        return (
-            mb.vals.reshape(n_pk, max_l, s).transpose(1, 0, 2),
-            mb.lens.reshape(n_pk, max_l),
-            mb.count.reshape(n_pk, 1),
-            mb.p_mask.reshape(n_pk, s).astype(jnp.int32),
-            mb.v.reshape(n_pk, 1),
-            mb.sent.reshape(n_pk, 1).astype(jnp.int32),
-        )
+        return pack_mailbox(mb, n_pk, max_l, s)
 
     def round_body(carry, round_idx):
         vi_i32, packed = carry

@@ -88,6 +88,28 @@ class TestCLI:
         # --jsonl must be honored on every backend
         assert json.loads(jsonl.read_text().splitlines()[0])["phase"] == "config"
 
+    def test_run_quirk_mode_flags(self):
+        # --attack-scope / --racy-mode / --delivery flow into QBAConfig.
+        out = io.StringIO()
+        rc = main(
+            ["run", "--n-parties", "3", "--size-l", "8", "--n-dishonest",
+             "1", "--trials", "1", "--attack-scope", "broadcast",
+             "--delivery", "racy", "--p-late", "0.3", "--racy-mode",
+             "defer", "--backend", "local"],
+            out=out,
+        )
+        assert rc == 0
+        assert "Decisions:" in out.getvalue()
+
+    def test_run_rejects_invalid_quirk_combo(self):
+        out = io.StringIO()
+        rc = main(
+            ["run", "--n-parties", "3", "--size-l", "8", "--trials", "1",
+             "--racy-mode", "defer"],  # defer without --delivery racy
+            out=out,
+        )
+        assert rc != 0
+
     def test_bench_json(self):
         out = io.StringIO()
         rc = main(

@@ -82,6 +82,46 @@ class TestPartySharded:
         spmd = run_trials_spmd(cfg, mesh)
         assert_trials_equal(spmd, ref)
 
+    def test_tp_broadcast_scope_and_racy(self, n_devices):
+        # The scope/racy semantics are folded into the shared draw arrays
+        # BEFORE the per-receiver slicing, so placement cannot change
+        # them; pin it for the non-default modes too.
+        cfg = QBAConfig(
+            n_parties=5, size_l=8, n_dishonest=2, trials=4, seed=12,
+            attack_scope="broadcast", delivery="racy", p_late=0.4,
+        )
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        assert_trials_equal(run_trials_spmd(cfg, mesh), run_trials(cfg))
+
+    def test_tp_pallas_kernel_matches_xla(self, n_devices):
+        # The party-sharded Pallas round-kernel variant (each device's
+        # kernel drains its receiver block against the gathered global
+        # mailbox, block offset as a runtime operand) must be
+        # bit-identical to the single-device XLA engine.  Interpret mode
+        # on the virtual CPU mesh; the same build runs Mosaic on TPU.
+        import dataclasses
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=8, n_dishonest=2, trials=4, seed=11,
+            round_engine="pallas",
+        )
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        ref = run_trials(dataclasses.replace(cfg, round_engine="xla"))
+        spmd = run_trials_spmd(cfg, mesh)
+        assert_trials_equal(spmd, ref)
+
+    def test_tp_pallas_kernel_broadcast_scope(self, n_devices):
+        import dataclasses
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=8, n_dishonest=3, trials=4, seed=3,
+            round_engine="pallas", attack_scope="broadcast",
+        )
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        ref = run_trials(dataclasses.replace(cfg, round_engine="xla"))
+        spmd = run_trials_spmd(cfg, mesh)
+        assert_trials_equal(spmd, ref)
+
     def test_tp4_dishonest_commander_heavy(self, n_devices):
         if n_devices < 4:
             pytest.skip("needs >= 4 devices")

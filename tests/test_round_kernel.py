@@ -51,7 +51,7 @@ class TestKernelEquivalence:
         from qba_tpu.ops.round_kernel import _lane_group
 
         cfg = QBAConfig(n_parties=4, size_l=128, n_dishonest=1)
-        assert _lane_group(cfg) == 1
+        assert _lane_group(cfg.size_l, cfg.n_lieutenants) == 1
         assert_equal(*both(cfg, 5, 4))
 
     def test_tail_overlap_group(self):
@@ -60,7 +60,8 @@ class TestKernelEquivalence:
         from qba_tpu.ops.round_kernel import _lane_group
 
         cfg = QBAConfig(n_parties=6, size_l=48, n_dishonest=2)
-        assert _lane_group(cfg) == 2 and cfg.n_lieutenants % 2 == 1
+        assert _lane_group(cfg.size_l, cfg.n_lieutenants) == 2
+        assert cfg.n_lieutenants % 2 == 1
         assert_equal(*both(cfg, 6, 8))
 
     def test_racy_delivery(self):
