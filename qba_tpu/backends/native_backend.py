@@ -225,12 +225,14 @@ def run_trials_native(
     a host thread pool (``n_threads <= 0`` = hardware concurrency).
     Returns a dict of stacked arrays: ``success [n]``, ``decisions
     [n, n_parties]``, ``honest [n, n_parties]``, ``v_comm [n]``, ``vi
-    [n, n_lieutenants, w]``, ``overflow [n]``, ``success_rate``, plus the
-    presampled ``lists``/``v_sent``.
+    [n, n_lieutenants, w]``, ``overflow [n]``, ``success_rate``.
 
     ``trace`` (int32 ``[cap, 7]``, single-trial batches only) routes the
     run through ``qba_run_trial`` with the C engine's protocol event
-    trail recorded into it; the result then includes ``trace_len``.
+    trail recorded into it; only then does the result also include
+    ``trace_len`` plus the presampled ``lists``/``v_sent`` the trail
+    renderer needs (a plain Monte-Carlo batch would otherwise retain
+    large host arrays nobody reads).
     """
     from qba_tpu.backends.jax_backend import trial_keys
 
@@ -306,9 +308,12 @@ def run_trials_native(
         "vi": vi.astype(bool),
         "overflow": flags[:, 1].astype(bool),
         "success_rate": float(flags[:, 0].mean()),
-        "lists": lists_a,
-        "v_sent": vs_a,
     }
-    if trace_len is not None:
+    if trace is not None:
+        # Only the single-trial trace path reads these; a large
+        # Monte-Carlo batch would otherwise retain
+        # n_trials x (n_parties+1) x size_l of host memory nobody uses.
+        out["lists"] = lists_a
+        out["v_sent"] = vs_a
         out["trace_len"] = trace_len
     return out

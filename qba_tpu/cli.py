@@ -49,9 +49,12 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
         "only; dense_pallas = same on the fused Pallas kernel)",
     )
     p.add_argument(
-        "--round-engine", choices=("auto", "xla", "pallas"), default="auto",
-        help="voting-round engine: auto = fused Pallas kernel on TPU "
-        "when the config fits VMEM, pure XLA otherwise (bit-identical)",
+        "--round-engine",
+        choices=("auto", "xla", "pallas", "pallas_tiled"), default="auto",
+        help="voting-round engine: auto = the fastest engine that "
+        "compiles for this config (fused Pallas kernel, else the "
+        "packet-tiled kernel, else pure XLA); all engines are "
+        "bit-identical",
     )
     p.add_argument(
         "--delivery", choices=("sync", "racy"), default="sync",
@@ -115,13 +118,26 @@ def _parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--max-verdicts", type=int, default=8,
-        help="print at most this many per-trial verdict blocks",
+        help="print at most this many per-trial verdict blocks; with "
+        "--backend native/jax and -v/--jsonl, each displayed trial is "
+        "re-run serially through a message-level engine to collect its "
+        "event trail, so large values cost proportional extra compute",
     )
 
     bench = sub.add_parser("bench", help="time the jitted Monte-Carlo batch")
     _add_config_args(bench, trials_default=256)
     bench.add_argument("--reps", type=int, default=3)
     bench.add_argument("--profile-dir", default=None)
+    bench.add_argument(
+        "--preset", choices=("northstar",), default=None,
+        help="northstar = BASELINE.md config 5 as written: nParties=33, "
+        "sizeL=64, nDishonest=10, 1000 trials (chunked; lossless slots)",
+    )
+    bench.add_argument(
+        "--chunk-trials", type=int, default=None,
+        help="split the batch into chunks of this many trials (HBM-bound "
+        "configs; wall time covers all chunks end to end)",
+    )
 
     sweep = sub.add_parser("sweep", help="chunked checkpoint-resumable sweep")
     _add_config_args(sweep, trials_default=256)
@@ -235,8 +251,33 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
         else:
             from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
 
+            keys = trial_keys(cfg)
             with timers.time("trials"):
-                res = fence(run_trials(cfg, trial_keys(cfg)))
+                res = fence(run_trials(cfg, keys))
+            if args.verbose or args.jsonl:
+                # Trail replay: the vectorized engine cannot cheaply emit
+                # per-packet events, but for a given trial key the
+                # message-level local backend reproduces its decisions
+                # exactly (the three-way differential contract) — so the
+                # displayed trials replay through it for the full trail
+                # (including the racy_mode="defer" mechanism, which the
+                # vectorized engine realizes by its provably-equivalent
+                # loss form; see docs/DIVERGENCES.md D1).  Same serial
+                # re-run cost note as the native path (--max-verdicts).
+                from qba_tpu.backends.local_backend import run_trial_local
+
+                dec = np.asarray(res.trials.decisions)
+                for i in range(min(cfg.trials, args.max_verdicts)):
+                    r = run_trial_local(cfg, keys[i], log=log, trial=i)
+                    if r["decisions"] != [int(x) for x in dec[i]]:
+                        # Unreachable unless the differential contract is
+                        # broken — surface it rather than show a trail
+                        # that doesn't match the printed verdicts.
+                        log.warning(
+                            "decision", "trail replay mismatch", trial=i,
+                            replay=r["decisions"],
+                            vectorized=[int(x) for x in dec[i]],
+                        )
             for i in range(min(cfg.trials, args.max_verdicts)):
                 one = jax.tree.map(lambda x: np.asarray(x)[i], res.trials)
                 print(render_verdict(cfg, one, index=i), file=out)
@@ -255,24 +296,67 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
 
 
 def _cmd_bench(args: argparse.Namespace, out) -> int:
+    import dataclasses
     import json
 
     import jax
+    import jax.numpy as jnp
 
     from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
     from qba_tpu.obs import profile_trace, throughput
+    from qba_tpu.rounds.engine import resolve_round_engine
 
+    if args.reps < 1:
+        raise ValueError("bench: --reps must be >= 1")
     cfg = _config(args)
-    fence(run_trials(cfg, trial_keys(cfg)))  # compile
+    chunk_trials = args.chunk_trials
+    if args.preset == "northstar":
+        # BASELINE.md config 5 as written (1000 trials).  256-trial
+        # chunks: the 33-party lossless pool exceeds HBM in one batch
+        # (docs/PERF.md), and smaller batches measured faster anyway.
+        cfg = dataclasses.replace(
+            cfg, n_parties=33, size_l=64, n_dishonest=10, trials=1000
+        )
+        chunk_trials = chunk_trials or 250
+    chunk_trials = chunk_trials or cfg.trials
+    n_chunks = -(-cfg.trials // chunk_trials)
+    cfg_chunk = dataclasses.replace(cfg, trials=chunk_trials)
+    fence(run_trials(cfg_chunk, trial_keys(cfg_chunk)))  # compile
     best = float("inf")
+    results = None
     with profile_trace(args.profile_dir):
         for rep in range(args.reps):
-            keys = jax.random.split(jax.random.key(cfg.seed + 1 + rep), cfg.trials)
+            keys = jax.random.split(
+                jax.random.key(cfg.seed + 1 + rep),
+                n_chunks * chunk_trials,
+            )
             fence(keys)  # key generation off the clock
             t0 = time.perf_counter()
-            fence(run_trials(cfg, keys))
+            results = [
+                run_trials(
+                    cfg_chunk,
+                    keys[i * chunk_trials : (i + 1) * chunk_trials],
+                )
+                for i in range(n_chunks)
+            ]
+            fence(results)
             best = min(best, time.perf_counter() - t0)
-    th = throughput(cfg, cfg.trials, best)
+    n_run = n_chunks * chunk_trials
+    th = throughput(cfg, n_run, best)
+    overflow = float(
+        jnp.mean(
+            jnp.concatenate(
+                [r.trials.overflow.astype(jnp.float32) for r in results]
+            )
+        )
+    )
+    success = float(
+        jnp.mean(
+            jnp.concatenate(
+                [r.trials.success.astype(jnp.float32) for r in results]
+            )
+        )
+    )
     print(
         json.dumps(
             {
@@ -281,11 +365,15 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
                 "unit": "rounds/s",
                 "trials_per_sec": round(th["trials_per_sec"], 2),
                 "best_s": round(best, 4),
+                "engine": resolve_round_engine(cfg_chunk),
+                "overflow_rate": round(overflow, 4),
+                "success_rate": round(success, 4),
                 "config": {
                     "n_parties": cfg.n_parties,
                     "size_l": cfg.size_l,
                     "n_dishonest": cfg.n_dishonest,
-                    "trials": cfg.trials,
+                    "trials": n_run,
+                    "chunk_trials": chunk_trials,
                 },
             }
         ),

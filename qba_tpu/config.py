@@ -40,11 +40,24 @@ class QBAConfig:
         round). A lieutenant accepts each order value at most once
         (``v not in Vi``, ``tfg.py:294``), so ``w`` is a universal bound;
         smaller values trade memory for a recorded overflow flag.
-      round_engine: "auto" (default — the fused Pallas round kernel on
-        TPU when its per-trial working set fits VMEM, pure XLA
-        otherwise), "xla", or "pallas" (forces the kernel; interpreter
-        mode off-TPU).  Both engines are bit-identical
-        (tests/test_round_kernel.py).
+      round_engine: "auto" (default — the fastest engine that compiles
+        for this config: the fused monolithic Pallas round kernel, else
+        the packet-tiled kernel, else pure XLA), "xla", "pallas"
+        (forces the monolithic kernel; interpreter mode off-TPU), or
+        "pallas_tiled" (forces the tiled engine — lossless at scales
+        the monolithic kernel cannot compile,
+        :mod:`qba_tpu.ops.round_kernel_tiled`).  All engines are
+        bit-identical (tests/test_round_kernel.py,
+        tests/test_round_kernel_tiled.py).
+      tiled_block: explicit packet-block size for the tiled engine
+        (must divide ``n_lieutenants * slots``); None = probe-chosen.
+      max_evidence_rows: static bound on |L| (``max_l``); None = the
+        derived ``n_dishonest + 2``.  Validated ``>= n_rounds + 1`` —
+        the batched engines compute the own-row consistency terms under
+        the invariant that ``append_own`` never drops a row for
+        fullness (``len(L) == round+1`` at acceptance, ``tfg.py:294``),
+        so a smaller bound would silently split them from the
+        ``consistent_after_append`` spec.
       delivery: "sync" (race-free idealization, default) or "racy" —
         model the reference's barrier race (a packet missing its round's
         ``Iprobe`` drain is silently lost, ``tfg.py:294,341``) as an
@@ -84,6 +97,8 @@ class QBAConfig:
     round_engine: str = "auto"
     attack_scope: str = "delivery"
     racy_mode: str = "loss"
+    tiled_block: int | None = None
+    max_evidence_rows: int | None = None
 
     def __post_init__(self) -> None:
         if self.n_parties < 2:
@@ -111,8 +126,25 @@ class QBAConfig:
             raise ValueError("p_late must be in [0, 1]")
         if self.p_late > 0.0 and self.delivery != "racy":
             raise ValueError("p_late > 0 requires delivery='racy'")
-        if self.round_engine not in ("auto", "xla", "pallas"):
+        if self.round_engine not in ("auto", "xla", "pallas", "pallas_tiled"):
             raise ValueError(f"unknown round_engine {self.round_engine!r}")
+        if self.tiled_block is not None:
+            n_pool = self.n_lieutenants * self.slots
+            if self.tiled_block < 1 or n_pool % self.tiled_block:
+                raise ValueError(
+                    f"tiled_block={self.tiled_block} must divide "
+                    f"n_lieutenants * slots = {n_pool}"
+                )
+        if self.max_evidence_rows is not None and (
+            self.max_evidence_rows < self.n_rounds + 1
+        ):
+            raise ValueError(
+                f"max_evidence_rows={self.max_evidence_rows} < n_rounds + 1 "
+                f"= {self.n_rounds + 1}: every engine relies on |L| <= "
+                "round+1 <= max_l (the append_own fullness guard must be "
+                "unreachable, see consistent_after_append); a smaller "
+                "bound would drop evidence rows mid-protocol"
+            )
         if self.attack_scope not in ("delivery", "broadcast"):
             raise ValueError(f"unknown attack_scope {self.attack_scope!r}")
         if self.racy_mode not in ("loss", "defer"):
@@ -149,7 +181,11 @@ class QBAConfig:
     @property
     def max_l(self) -> int:
         """Static bound on |L|: len(L) == round+1 at acceptance
-        (``tfg.py:294``), round <= n_dishonest+1, so |L| <= n_dishonest+2."""
+        (``tfg.py:294``), round <= n_dishonest+1, so |L| <= n_dishonest+2.
+        Overridable upward via ``max_evidence_rows`` (validated
+        ``>= n_rounds + 1`` in ``__post_init__``)."""
+        if self.max_evidence_rows is not None:
+            return self.max_evidence_rows
         return self.n_dishonest + 2
 
     @property

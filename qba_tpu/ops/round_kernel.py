@@ -394,9 +394,7 @@ def build_round_step(
                     > 0
                 )
                 bad_own_g = seg_reduce(bad_own_pos) > 0
-                cond2 = ~(
-                    (~clearl_g & (cont_g | oob)) | bad_own_g
-                )
+                cont_or_oob = ~clearl_g & (cont_g | oob)
             else:
                 contains_g = jnp.zeros((n_pk, grp), jnp.bool_)
                 own_coll_g = jnp.zeros((n_pk, grp), jnp.bool_)
@@ -414,19 +412,24 @@ def build_round_step(
                         > 0
                     )
                 bad_own_g = seg_reduce(bad_own_pos) > 0
-                cond2 = ~(
-                    (~clearl_g & (oob | contains_g)) | bad_own_g
-                )
+                cont_or_oob = ~clearl_g & (oob | contains_g)
 
-            # The min() clamp never fires (see the per-receiver path).
+            # append_own's fullness guard (consistent_after_append): the
+            # own-row terms apply only when the row actually enters L'.
+            # The config invariant max_l >= n_rounds + 1 makes
+            # `appended_g` reduce to `~dup_g` — the guard keeps the
+            # kernel on the spec even if the bound is raised/decoupled
+            # via max_evidence_rows.
+            appended_g = ~dup_g & (count_eff_g < max_l)
+            cond2 = ~(cont_or_oob | (appended_g & bad_own_g))
             new_count_g = jnp.where(
-                dup_g, count_eff_g, jnp.minimum(count_eff_g + 1, max_l)
+                appended_g, count_eff_g + 1, count_eff_g
             )
             cond1 = (clearl_g | ~lens_bad) & (
-                (count_eff_g == 0) | (own_len_g == len0)
+                ~appended_g | (count_eff_g == 0) | (own_len_g == len0)
             )
             cond3 = (clearl_g | ~cells_coll) & (
-                dup_g | ~(~clearl_g & own_coll_g)
+                ~appended_g | ~(~clearl_g & own_coll_g)
             )
             ok_g = (
                 delivered_g
@@ -647,6 +650,69 @@ def build_round_step(
     return step
 
 
+# ---------------------------------------------------------------------------
+# Probe disk cache — shared by every kernel probe in ops/ (the tiled
+# engine imports these).  Probe verdicts persist per (kernel, config
+# shape, jax version, device kind): a failed remote-tunnel compile costs
+# ~2 minutes and Mosaic's scoped-vmem accounting cannot be predicted
+# from outside, so the first process on a machine pays for the search
+# once and every later process reads the answer.  TPU-only (the CPU test
+# suite exercises the probe failure paths deterministically).
+
+import json as _json
+import os as _os
+
+
+def _probe_cache_path() -> str:
+    return _os.environ.get(
+        "QBA_PROBE_CACHE",
+        _os.path.join(
+            _os.path.expanduser("~"), ".cache", "qba_tpu", "probes.json"
+        ),
+    )
+
+
+_PROBE_VERSION = 2  # bump when kernel structure/compiler params change
+
+
+def _probe_disk_key(kernel: str, cfg: QBAConfig, extra: str = "") -> str:
+    dev = jax.devices()[0].device_kind if jax.devices() else "?"
+    return (
+        f"{kernel}v{_PROBE_VERSION}:{cfg.n_lieutenants}:{cfg.slots}:"
+        f"{cfg.max_l}:{cfg.size_l}:{cfg.w}:{extra}:{jax.__version__}:{dev}"
+    )
+
+
+def _probe_disk_get(key: str):
+    if jax.default_backend() != "tpu":  # disk cache is for real-TPU probes
+        return None
+    try:
+        with open(_probe_cache_path()) as f:
+            return _json.load(f).get(key)
+    except Exception:
+        return None
+
+
+def _probe_disk_put(key: str, value) -> None:
+    if jax.default_backend() != "tpu":
+        return
+    path = _probe_cache_path()
+    try:
+        _os.makedirs(_os.path.dirname(path), exist_ok=True)
+        try:
+            with open(path) as f:
+                data = _json.load(f)
+        except Exception:
+            data = {}
+        data[key] = value
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(data, f)
+        _os.replace(tmp, path)
+    except Exception:
+        pass  # cache is best-effort
+
+
 # Pre-filter bound for the compile probe.  The real gate is a one-time
 # compile attempt (kernel_compiles below): Mosaic's scoped-vmem use is
 # hard to model — observed actual/estimate ratios range from ~0.8x
@@ -712,7 +778,27 @@ def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
     hit = _PROBE_CACHE.get(key)
     if hit is not None:
         return hit
+    dkey = _probe_disk_key("fused", cfg, extra=f"recv{n_recv}")
+    disk = _probe_disk_get(dkey)
+    if disk is not None:
+        _PROBE_CACHE[key] = bool(disk)
+        return bool(disk)
     if not fits_kernel(cfg, n_recv):
+        # As loud as a failed probe: the estimate is unreliable (see the
+        # pre-filter note), so a misclassified config silently taking a
+        # slower engine would be invisible to the operator otherwise.
+        fallback = (
+            "the spmd engine will fall back to vectorized XLA"
+            if n_recv is not None
+            else "the auto engine will try the packet-tiled kernel, then XLA"
+        )
+        warnings.warn(
+            "fused round kernel VMEM pre-filter rejected "
+            f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
+            f"slots={cfg.slots}) without a compile probe; " + fallback,
+            RuntimeWarning,
+            stacklevel=2,
+        )
         _PROBE_CACHE[key] = False
         return False
     n_pk = cfg.n_lieutenants * cfg.slots
@@ -749,4 +835,5 @@ def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
         )
         ok = False
     _PROBE_CACHE[key] = ok
+    _probe_disk_put(dkey, int(ok))
     return ok

@@ -230,7 +230,17 @@ def run_trials_spmd(
 def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:
     """Engine for the party-sharded round loop: the Pallas kernel's
     party-sharded variant when forced or when ``auto`` on TPU and the
-    local-block kernel compiles; vectorized XLA otherwise."""
+    local-block kernel compiles; vectorized XLA otherwise.
+
+    ``pallas_tiled`` has no party-sharded variant — an explicit request
+    is refused rather than silently downgraded (an explicit knob must
+    never mean something weaker; cf. racy_mode, docs/DIVERGENCES.md D1).
+    """
+    if cfg.round_engine == "pallas_tiled":
+        raise ValueError(
+            "round_engine='pallas_tiled' has no party-sharded (spmd) "
+            "variant; use 'auto', 'xla', or 'pallas' with run_trials_spmd"
+        )
     if cfg.round_engine == "pallas":
         return "pallas"
     if cfg.round_engine != "auto" or jax.default_backend() != "tpu":

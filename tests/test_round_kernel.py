@@ -116,7 +116,8 @@ class TestEngineSelection:
 
     def test_probe_skipped_when_prefiltered(self, monkeypatch, clean_probe_cache):
         # A config outside the pre-filter must return False without
-        # attempting a compile.
+        # attempting a compile — loudly (ADVICE r2: a silent engine
+        # downgrade from the unreliable estimate must be observable).
         rk = clean_probe_cache
 
         def boom(*a, **k):  # pragma: no cover - failure path
@@ -124,7 +125,8 @@ class TestEngineSelection:
 
         monkeypatch.setattr(rk, "build_round_step", boom)
         cfg = QBAConfig(n_parties=11, size_l=1000, n_dishonest=5)
-        assert rk.kernel_compiles(cfg) is False
+        with pytest.warns(RuntimeWarning, match="pre-filter rejected"):
+            assert rk.kernel_compiles(cfg) is False
 
     def test_probe_result_cached(self, monkeypatch, clean_probe_cache):
         rk = clean_probe_cache
@@ -137,7 +139,11 @@ class TestEngineSelection:
 
         monkeypatch.setattr(rk, "build_round_step", counting)
         cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=1)
-        first = rk.kernel_compiles(cfg)
+        # On the CPU test platform the real-TPU compile fails; the probe
+        # must warn (not raise), cache the verdict, and stay silent on
+        # the cached second call.
+        with pytest.warns(RuntimeWarning, match="compile probe failed"):
+            first = rk.kernel_compiles(cfg)
         second = rk.kernel_compiles(cfg)
         assert first == second
         assert len(calls) == 1  # probe ran exactly once, result cached
