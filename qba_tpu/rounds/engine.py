@@ -178,12 +178,39 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
         in_t_raw & ((vals_f > cfg.w) | (vals_f < 0)) & valid_raw[..., None],
         axis=(1, 2),
     )  # [n_pk]
-    # Value-presence table: presence[pk, x] = some valid row contains x.
-    presence = jnp.any(
-        (vals_f[..., None] == jnp.arange(cfg.w)[None, None, None, :])
-        & (in_t_raw & valid_raw[..., None])[..., None],
-        axis=(1, 2),
-    )  # [n_pk, w]
+    # Value-presence bit planes: bit (x & 31) of plane x >> 5 at
+    # [pk, pos] iff some valid row holds value x there.  Replaces the
+    # one-hot presence table whose construction broadcast a
+    # [n_pk, max_l, size_l, w] compare — the dominant cost of this
+    # engine at w = 64 scale (~100M bools per trial per round at the
+    # 33-party config; docs/PERF.md round 3).  Exact for all queried
+    # values (mailbox v < w, forged v < n_parties+1 <= w, li < w);
+    # distinct values map to distinct (plane, bit) pairs, so stored
+    # garbage cannot alias a query.
+    n_planes = (cfg.w + 31) // 32
+    in_valid = in_t_raw & valid_raw[..., None]
+    pm_pos = []  # per plane: int32[n_pk, size_l]
+    for p_i in range(n_planes):
+        lo = 32 * p_i
+        in_pl = in_valid & (vals_f >= lo) & (vals_f < lo + 32)
+        bits = jnp.where(in_pl, jnp.left_shift(jnp.int32(1), vals_f & 31), 0)
+        acc = bits[:, 0]
+        for r in range(1, max_l):
+            acc = acc | bits[:, r]
+        pm_pos.append(acc)
+    def plane_bit_pos(q):  # int32[n_pk, size_l] query -> bool[n_pk, size_l]
+        sel = pm_pos[0]
+        for p_i in range(1, n_planes):
+            sel = jnp.where((q >> 5) == p_i, pm_pos[p_i], sel)
+        return (jnp.right_shift(sel, q & 31) & 1) != 0
+
+    def plane_bit_any(q):  # int32[n_pk] query -> bool[n_pk]
+        # Boolean any-reduce over the positional planes: an int32
+        # bitwise-or lane reduction for a precomputed "anywhere" plane
+        # lowered to a T(1,128)-layout loop costing ~370 ms/plane per
+        # batch; the boolean reduce vectorizes cleanly.
+        q_pos = jnp.broadcast_to(q[:, None], pm_pos[0].shape)
+        return jnp.any(plane_bit_pos(q_pos), axis=-1)
     cell_lens_ok_raw = jnp.all(
         jnp.where(valid_raw, lens_f == lens_f[:, :1], True), axis=1
     )  # [n_pk]
@@ -224,21 +251,17 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
         ~appended | (count_eff == 0) | (own_len == lens_f[:, 0])
     )
     # Cond 2 (tfg.py:93-94): v2 < w always (mailbox v < w; rand_v < n+1 <= w).
-    bad_cell = ~clear_l & (
-        oob_raw | jnp.take_along_axis(presence, v2[:, None], axis=1)[:, 0]
-    )
+    bad_cell = ~clear_l & (oob_raw | plane_bit_any(v2))
     bad_own = appended & jnp.any(
         p2 & ((own == v2[:, None]) | (own > cfg.w) | (own < 0)), axis=-1
     )
     cond2 = ~(bad_cell | bad_own)
-    # Cond 3 (tfg.py:96-98): cell pairs, and own vs cells when appended.
-    own_collides = jnp.any(
-        valid_raw[..., None]
-        & p2[:, None, :]
-        & in_t_raw
-        & (vals_f == own[:, None, :]),
-        axis=(1, 2),
-    )
+    # Cond 3 (tfg.py:96-98): cell pairs, and own vs cells when appended —
+    # the own-row collision via the per-position presence planes (one
+    # [n_pk, size_l] op instead of max_l of them; own == li on every
+    # p2 position, and the planes already fold in valid/in-tuple).
+    li_q = jnp.broadcast_to(li[None, :].astype(jnp.int32), p2.shape)
+    own_collides = jnp.any(p2 & plane_bit_pos(li_q), axis=-1)
     cond3 = (clear_l | cells_ok_raw) & (~appended | ~(~clear_l & own_collides))
 
     v_all = v2
@@ -250,20 +273,23 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     v_all, ok_all = jax.lax.optimization_barrier((v_all, ok_all))
 
     # Acceptance with first-occurrence-wins dedup against Vi (tfg.py:294):
-    # for each order value, only the first candidate packet carrying it is
-    # accepted — O(w * n_pk), not an n_pk x n_pk matrix.
-    cand = ok_all & ~vi_row[v_all]
+    # for each order value, only the first candidate packet carrying it
+    # is accepted — O(w * n_pk) one-hot algebra, not an n_pk x n_pk
+    # matrix, and no advanced indexing: the previous `vi_row[v_all]` /
+    # `first_idx[v_all]` per-element gathers lowered to serialized TPU
+    # gather loops that dominated the whole engine at scale (2 x ~2.2 s
+    # of a 7.9 s 33-party batch; docs/PERF.md round 3).
+    onehot_v = v_all[:, None] == jnp.arange(cfg.w)[None, :]  # [n_pk, w]
+    cand = ok_all & ~jnp.any(onehot_v & vi_row[None, :], axis=1)
     cand_idx = jnp.where(cand, idxs, n_pk)
     first_idx = jnp.min(
-        jnp.where(
-            v_all[None, :] == jnp.arange(cfg.w)[:, None], cand_idx[None, :], n_pk
-        ),
-        axis=1,
+        jnp.where(onehot_v, cand_idx[:, None], n_pk), axis=0
     )  # [w] — first candidate index per value
-    acc = cand & (first_idx[v_all] == idxs)
-    vi_row = vi_row | jnp.any(
-        acc[:, None] & (v_all[:, None] == jnp.arange(cfg.w)[None, :]), axis=0
-    )
+    first_b = jnp.min(
+        jnp.where(onehot_v, first_idx[None, :], n_pk), axis=1
+    )  # [n_pk] — that index, spread back per packet
+    acc = cand & (first_b == idxs)
+    vi_row = vi_row | jnp.any(acc[:, None] & onehot_v, axis=0)
 
     # Rebroadcast while round <= nDishonest (tfg.py:298-299); outgoing slot
     # = exclusive prefix count, overflow recorded past the static bound.
