@@ -122,6 +122,13 @@ def _emit_trace(cfg: QBAConfig, log, trial: int, recs: np.ndarray) -> None:
             log.debug("round", "send", trial=trial, round=rnd,
                       sender=sender, v=v, p_size=a, l_size=b,
                       broadcast=True)
+        elif kind == 9:  # deferred receive (racy_mode="defer", D1)
+            log.debug("round", "receive", trial=trial, round=rnd,
+                      sender=sender, recv=recv, v=v, accepted=bool(a),
+                      reason=_REASONS[b], deferred=True)
+        elif kind == 10:  # packet queued for the next round (D1)
+            log.debug("round", "late defer", trial=trial, round=rnd,
+                      sender=sender, recv=recv)
     flush_pending()
 
 
@@ -148,7 +155,7 @@ def run_trial_native(
         # per receiver, each <= 3 records, + vi snapshot headers and up to
         # w value records per rank.
         n_lieu = cfg.n_lieutenants
-        per_round = n_lieu * (n_lieu * cfg.slots * 3 + 1 + cfg.w)
+        per_round = n_lieu * (n_lieu * cfg.slots * 4 + 1 + cfg.w)
         trace = np.zeros(
             ((2 * n_lieu + cfg.n_rounds * per_round), _TRACE_REC),
             dtype=np.int32,
@@ -267,6 +274,7 @@ def run_trials_native(
             cfg.n_dishonest,
             w,
             cfg.slots,
+            int(cfg.racy_mode == "defer"),
             honest_p,
             lists_p,
             vs_p,
@@ -288,6 +296,7 @@ def run_trials_native(
             cfg.n_dishonest,
             w,
             cfg.slots,
+            int(cfg.racy_mode == "defer"),
             honest_p,
             lists_p,
             vs_p,

@@ -77,12 +77,16 @@ class QBAConfig:
         the next round's drain, where ``len(L) == round+1``
         (``tfg.py:294``) necessarily rejects it.  Provably
         decision-equivalent (a once-deferred packet can never satisfy
-        the evidence-length check); "defer" is implemented in the
-        message-level local backend so the event trail shows the real
-        wrong-evidence-len rejections, while the vectorized/native
-        engines keep the equivalent loss semantics —
-        ``tests/test_racy.py`` pins the cross-mode decision match.
-        See docs/DIVERGENCES.md D1.
+        the evidence-length check).  BOTH message-level engines (local
+        Python and the C++ runtime) execute the mechanism — deferred
+        queues, next-round re-drain, the deferred deliveries in the
+        event trail; the vectorized jax engines realize it through the
+        equivalence (computing the always-rejected re-deliveries would
+        be dead code), and ``run -v`` on the jax backend replays
+        displayed trials through the local backend so the trail still
+        shows the mechanism.  ``tests/test_racy.py`` pins the
+        cross-mode and cross-backend decision match.  See
+        docs/DIVERGENCES.md D1.
     """
 
     n_parties: int

@@ -74,14 +74,14 @@ def load() -> ctypes.CDLL:
         lib.qba_run_trial.restype = ctypes.c_int
         lib.qba_run_trial.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int32,
-            ctypes.c_int, _u8p, _i32p, _i32p, ctypes.c_int32, _i32p, _i32p,
-            _u8p, _i32p, _i32p, ctypes.c_int32, _i32p,
+            ctypes.c_int, ctypes.c_int, _u8p, _i32p, _i32p, ctypes.c_int32,
+            _i32p, _i32p, _u8p, _i32p, _i32p, ctypes.c_int32, _i32p,
         ]
         lib.qba_run_trials.restype = ctypes.c_int
         lib.qba_run_trials.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int32, ctypes.c_int, _u8p, _i32p, _i32p,
-            _i32p, _i32p, _i32p, _u8p, _i32p,
+            ctypes.c_int, ctypes.c_int32, ctypes.c_int, ctypes.c_int, _u8p,
+            _i32p, _i32p, _i32p, _i32p, _i32p, _u8p, _i32p,
         ]
         _lib = lib
         return _lib

@@ -168,8 +168,14 @@ int qba_decode_pvl(const int32_t* buf, int len, int32_t* p_out, int np_cap,
 //              forge-v, bit2 clear-P, bit3 clear-L) with the configured
 //              attack scope already folded in, so this engine is
 //              scope-agnostic; `late` = 1 -> the delivery is silently
-//              lost before any corruption (the barrier-race model of
-//              docs/DIVERGENCES.md D1; all 0 under delivery="sync")
+//              late: under racy_defer=0 the delivery is silently lost
+//              before any corruption; under racy_defer=1 the corrupted
+//              packet is instead delivered at the start of the NEXT
+//              round's drain, where the evidence-length check
+//              necessarily rejects it — the reference's actual race
+//              mechanism (the barrier-race model of
+//              docs/DIVERGENCES.md D1; late is all 0 under
+//              delivery="sync")
 //   decisions_out : int32[n_parties] (index 0 = commander)
 //   vi_out   : uint8[n_lieu * w] accepted-set masks
 //   flags_out: int32[2] = {success, overflow}
@@ -186,6 +192,11 @@ int qba_decode_pvl(const int32_t* buf, int len, int32_t* p_out, int np_cap,
 //                kind 4 attack           (a=edit bitmask)  tfg.py:275-284
 //                kind 5 round receive    (a=accepted, b=reason) tfg.py:294
 //                kind 6 rebroadcast      (a=|P|, b=|L|)        tfg.py:229
+//                kind 9 deferred receive (a=accepted, b=reason) — a
+//                       kind-5 delivery that arrived one round late
+//                       (racy_defer)                      DIVERGENCES D1
+//                kind 10 late defer      — the packet was queued for
+//                       the next round                    DIVERGENCES D1
 //                kind 7 vi snapshot header (a=|Vi|), followed by |Vi|
 //                       kind 8 records {8, round, rank, 0, value, 0, 0}
 //                       — value list form, exact for any w
@@ -197,7 +208,8 @@ int qba_decode_pvl(const int32_t* buf, int len, int32_t* p_out, int np_cap,
 // decode on delivery) — the in-process analog of the reference's tagged
 // MPI transport.  Returns 0, or -1 on a codec capacity/format error.
 int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
-                  int slots, const uint8_t* honest, const int32_t* lists,
+                  int slots, int racy_defer, const uint8_t* honest,
+                  const int32_t* lists,
                   const int32_t* v_sent, int32_t v_comm,
                   const int32_t* attacks, int32_t* decisions_out,
                   uint8_t* vi_out, int32_t* flags_out,
@@ -268,9 +280,49 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
     }
   }
 
-  // Step 3b (tfg.py:337-348): synchronous rounds.
+  // Step 3b (tfg.py:337-348): synchronous rounds.  Under racy_defer,
+  // late packets carry over one round (corrupted with the ORIGINAL
+  // round's draws — the reference corrupts at send time, before the
+  // race) and are drained first, where the evidence-length check
+  // necessarily rejects them (docs/DIVERGENCES.md D1).
+  struct Late { int sender_rank; Packet pk; };
+  std::vector<std::vector<Late>> deferred(n_lieu);
   for (int rnd = 1; rnd <= n_rounds; ++rnd) {
     std::vector<std::vector<Wire>> out(n_lieu);
+    std::vector<std::vector<Late>> next_deferred(n_lieu);
+    // lieu_receive (tfg.py:289-300), shared by deferred + fresh traffic.
+    auto lieu_receive = [&](int recv, int sender_rank, Packet& pk,
+                            bool was_deferred) -> int {
+      pk.L.insert(own_sublist(recv, pk.p));
+      int32_t reason;
+      if (!consistent(pk.v, pk.L, w)) reason = 1;
+      else if (vi[recv].count(pk.v)) reason = 2;
+      else if (static_cast<int>(pk.L.size()) != rnd + 1) reason = 3;
+      else reason = 0;
+      trace(was_deferred ? 9 : 5, rnd, sender_rank, recv + 2, pk.v,
+            reason == 0 ? 1 : 0, reason);
+      if (reason == 0) {
+        vi[recv].insert(pk.v);
+        if (rnd <= n_dishonest) {
+          if (static_cast<int>(out[recv].size()) < slots) {
+            trace(6, rnd, recv + 2, 0, pk.v,
+                  static_cast<int32_t>(pk.p.size()),
+                  static_cast<int32_t>(pk.L.size()));
+            if (push(&out[recv], pk) < 0) return -1;
+          } else {
+            overflow = true;
+          }
+        }
+      }
+      return 0;
+    };
+    // Deferred arrivals from the previous round drain first (they were
+    // in the queue before this round's traffic; deterministic order).
+    for (int recv = 0; recv < n_lieu; ++recv) {
+      for (Late& d : deferred[recv]) {
+        if (lieu_receive(recv, d.sender_rank, d.pk, true) < 0) return -1;
+      }
+    }
     for (int recv = 0; recv < n_lieu; ++recv) {
       for (int sender = 0; sender < n_lieu; ++sender) {
         int n_slots = std::min<int>(slots, mailbox[sender].size());
@@ -285,7 +337,7 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
               attacks + (((rnd - 1) * n_lieu + recv) * n_lieu * slots +
                          sender * slots + slot) *
                             3;
-          if (a[2]) {  // racy late loss (DIVERGENCES.md D1)
+          if (a[2] && !racy_defer) {  // racy late loss (DIVERGENCES.md D1)
             trace(3, rnd, sender + 2, recv + 2, 0, 0, 0);
             continue;
           }
@@ -296,28 +348,12 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
             if (a[0] & 4) pk.p.clear();   // clear P
             if (a[0] & 8) pk.L.clear();   // clear L
           }
-          // lieu_receive (tfg.py:289-300)
-          pk.L.insert(own_sublist(recv, pk.p));
-          int32_t reason;
-          if (!consistent(pk.v, pk.L, w)) reason = 1;
-          else if (vi[recv].count(pk.v)) reason = 2;
-          else if (static_cast<int>(pk.L.size()) != rnd + 1) reason = 3;
-          else reason = 0;
-          trace(5, rnd, sender + 2, recv + 2, pk.v, reason == 0 ? 1 : 0,
-                reason);
-          if (reason == 0) {
-            vi[recv].insert(pk.v);
-            if (rnd <= n_dishonest) {
-              if (static_cast<int>(out[recv].size()) < slots) {
-                trace(6, rnd, recv + 2, 0, pk.v,
-                      static_cast<int32_t>(pk.p.size()),
-                      static_cast<int32_t>(pk.L.size()));
-                if (push(&out[recv], pk) < 0) return -1;
-              } else {
-                overflow = true;
-              }
-            }
+          if (a[2]) {  // racy_defer: queue for the next round's drain
+            trace(10, rnd, sender + 2, recv + 2, 0, 0, 0);
+            next_deferred[recv].push_back(Late{sender + 2, std::move(pk)});
+            continue;
           }
+          if (lieu_receive(recv, sender + 2, pk, false) < 0) return -1;
         }
       }
     }
@@ -326,6 +362,7 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
       for (int32_t x : vi[i]) trace(8, rnd, i + 2, 0, x, 0, 0);
     }
     mailbox = std::move(out);
+    deferred = std::move(next_deferred);
   }
 
   // Decision + verdict (tfg.py:303-306,351-363; empty-Vi sentinel = w,
@@ -358,7 +395,7 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
 // Returns 0, or one failing trial's nonzero error code (the first store
 // wins; which trial that is depends on thread scheduling).
 int qba_run_trials(int n_trials, int n_threads, int n_parties, int size_l,
-                   int n_dishonest, int32_t w, int slots,
+                   int n_dishonest, int32_t w, int slots, int racy_defer,
                    const uint8_t* honest, const int32_t* lists,
                    const int32_t* v_sent, const int32_t* v_comm,
                    const int32_t* attacks, int32_t* decisions_out,
@@ -386,7 +423,8 @@ int qba_run_trials(int n_trials, int n_threads, int n_parties, int size_l,
       const int t = cursor.fetch_add(1);
       if (t >= n_trials) return;
       const int r = qba_run_trial(
-          n_parties, size_l, n_dishonest, w, slots, honest + t * honest_s,
+          n_parties, size_l, n_dishonest, w, slots, racy_defer,
+          honest + t * honest_s,
           lists + t * lists_s, v_sent + t * vsent_s, v_comm[t],
           attacks + t * att_s, decisions_out + t * dec_s, vi_out + t * vi_s,
           flags_out + t * 2, nullptr, 0, nullptr);

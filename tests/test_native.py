@@ -207,6 +207,13 @@ class TestNativeEventTrail:
                 n_parties=4, size_l=8, n_dishonest=1,
                 delivery="racy", p_late=0.4,
             ),
+            # The defer mechanism (VERDICT r2 item 5): late packets
+            # carry over a round in BOTH message-level engines; the
+            # trails must match including the deferred re-deliveries.
+            QBAConfig(
+                n_parties=5, size_l=16, n_dishonest=2,
+                delivery="racy", p_late=0.5, racy_mode="defer",
+            ),
             # w = 32 exceeds a 31-bit vi mask: pins the list-form
             # kind-7/8 snapshot records.
             QBAConfig(n_parties=16, size_l=8, n_dishonest=2),

@@ -114,6 +114,30 @@ class TestDeferMode:
             a = run_trial(cfg_loss, k)
             assert [int(x) for x in a.decisions] == d["decisions"]
 
+    def test_native_engine_runs_defer_mechanism(self):
+        # VERDICT r2 item 5: the C++ engine executes the defer mechanism
+        # (deferred queues, next-round re-drain) rather than remapping to
+        # loss — decisions match the local defer run and the trail shows
+        # the deferred deliveries.
+        from qba_tpu.backends.local_backend import run_trial_local
+        from qba_tpu.backends.native_backend import run_trial_native
+        from qba_tpu.obs import EventLog, Level
+
+        cfg = self._cfg(racy_mode="defer")
+        saw_deferred = False
+        for seed in range(6):
+            k = jax.random.key(seed)
+            log = EventLog(Level.DEBUG)
+            rn = run_trial_native(cfg, k, log=log)
+            rl = run_trial_local(cfg, k)
+            assert rn["decisions"] == rl["decisions"]
+            assert rn["vi"] == rl["vi"]
+            for e in log.events:
+                if e.fields.get("deferred"):
+                    saw_deferred = True
+                    assert not e.fields["accepted"]  # D1 invariant
+        assert saw_deferred
+
     def test_deferred_packets_never_accepted(self):
         # Deferred re-deliveries carry deferred=True in the trail; the
         # D1 invariant is that NONE is ever accepted, and the mechanism
