@@ -15,6 +15,10 @@ The reference's backend boundary is the MPI rank: one OS process per party
   message-level semantics with every packet passing through the PvL wire
   codec, closing a three-way differential triangle with the other two.
   Imported lazily (needs the native toolchain at first use).
+* ``mp`` — the reference's actual runtime shape: one OS process per
+  party over a Unix-socket mesh, every packet through the C++ PvL codec
+  across a real process boundary (:mod:`qba_tpu.backends.mp_backend`;
+  imported lazily).  Fourth corner of the differential.
 """
 
 from qba_tpu.backends.jax_backend import MonteCarloResult, run_trials

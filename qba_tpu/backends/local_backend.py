@@ -55,6 +55,53 @@ def _consistent(v: int, L: set, w: int) -> bool:
     )
 
 
+def presample_trial(cfg: QBAConfig, key: jax.Array):
+    """The message-level backends' shared per-trial randomness: the
+    identical key tree every engine consumes (dishonesty, lists,
+    commander orders, and the rounds key for the per-cell attack
+    draws).  Returns ``(honest, lists, v_sent, v_comm, k_rounds)`` as
+    host values (numpy / Python ints)."""
+    k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
+    honest = np.asarray(assign_dishonest(cfg, k_dis))
+    lists = np.asarray(generate_lists_for(cfg, k_lists)[0])
+    v_sent_arr, v_comm = commander_orders(
+        cfg, k_comm, jax.numpy.asarray(bool(honest[1]))
+    )
+    v_sent = [int(x) for x in np.asarray(v_sent_arr)]
+    return honest, lists, v_sent, int(v_comm), k_rounds
+
+
+def emit_host_phases(cfg: QBAConfig, log, trial, honest, lists, v_comm,
+                     v_sent) -> None:
+    """The host-side (rank-0-visible) trail phases shared by the
+    message-level backends: per-party dishonesty (``tfg.py:124``),
+    particle lists (``tfg.py:159-162``), commander state + equivocation
+    (``tfg.py:328-330,169-181``)."""
+    for rank in range(1, cfg.n_parties + 1):
+        log.debug("dishonesty", "party role", trial=trial, rank=rank,
+                  honest=bool(honest[rank]))
+    for rank in range(cfg.n_parties + 1):
+        row = [int(x) for x in lists[rank][:16]]
+        log.debug("particles", "list received", trial=trial, rank=rank,
+                  head=row, size_l=cfg.size_l)
+    n_qcorr = int(np.sum(lists[0] != lists[1]))
+    log.info("step2", "commander order", trial=trial, v=v_comm,
+             n_qcorr=n_qcorr, commander_honest=bool(honest[1]))
+    if len(set(v_sent)) > 1:
+        log.info("step2", "commander equivocates", trial=trial,
+                 orders=sorted(set(v_sent)))
+
+
+def emit_verdict(log, trial, decisions, honest_parties, success) -> None:
+    """The rank-0 verdict triple (``tfg.py:360-363``), shared trail
+    tail of the message-level backends."""
+    log.info(
+        "decision", "verdict", trial=trial, decisions=decisions,
+        dishonest=[i + 1 for i, h in enumerate(honest_parties) if not h],
+        success=success,
+    )
+
+
 def run_trial_local(
     cfg: QBAConfig,
     key: jax.Array,
@@ -74,15 +121,7 @@ def run_trial_local(
     and the final decision summary (``tfg.py:360-363``).  Phase
     summaries are INFO; per-packet events are DEBUG.
     """
-    k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
-
-    honest = np.asarray(assign_dishonest(cfg, k_dis))
-    lists = np.asarray(generate_lists_for(cfg, k_lists)[0])
-    v_sent_arr, v_comm = commander_orders(
-        cfg, k_comm, jax.numpy.asarray(bool(honest[1]))
-    )
-    v_sent = [int(x) for x in np.asarray(v_sent_arr)]
-    v_comm = int(v_comm)
+    honest, lists, v_sent, v_comm, k_rounds = presample_trial(cfg, key)
 
     n_lieu, w, slots = cfg.n_lieutenants, cfg.w, cfg.slots
     li = [[int(x) for x in lists[i + 2]] for i in range(n_lieu)]
@@ -90,50 +129,11 @@ def run_trial_local(
     overflow = False
 
     if log:
-        # tfg.py:124 — every rank announces its honesty.
-        for rank in range(1, cfg.n_parties + 1):
-            log.debug(
-                "dishonesty",
-                "party role",
-                trial=trial,
-                rank=rank,
-                honest=bool(honest[rank]),
-            )
-        # tfg.py:159-162 — received particle lists (head only; full lists
-        # can be size_l=1000 long).
-        for rank in range(cfg.n_parties + 1):
-            row = [int(x) for x in lists[rank][:16]]
-            log.debug(
-                "particles",
-                "list received",
-                trial=trial,
-                rank=rank,
-                head=row,
-                size_l=cfg.size_l,
-            )
+        emit_host_phases(cfg, log, trial, honest, lists, v_comm, v_sent)
 
     # Step 1b: the commander's recovered Q-correlated positions
     # (tfg.py:325-328).
     isq = {k for k in range(cfg.size_l) if lists[0][k] != lists[1][k]}
-
-    if log:
-        # tfg.py:328-330 — commander state; equivocation shows as
-        # distinct per-lieutenant orders (tfg.py:169-181).
-        log.info(
-            "step2",
-            "commander order",
-            trial=trial,
-            v=v_comm,
-            n_qcorr=len(isq),
-            commander_honest=bool(honest[1]),
-        )
-        if len(set(v_sent)) > 1:
-            log.info(
-                "step2",
-                "commander equivocates",
-                trial=trial,
-                orders=sorted(set(v_sent)),
-            )
 
     # Step 2 + 3a (tfg.py:166-196): per-sender packet lists; the list index
     # is the mailbox slot (same numbering as the dense mailbox tensor).
@@ -300,15 +300,8 @@ def run_trial_local(
     honest_parties = [bool(h) for h in honest[1:]]
     filtered = {d for d, h in zip(decisions, honest_parties) if h}
     if log:
-        # tfg.py:360-363 — the rank-0 verdict triple.
-        log.info(
-            "decision",
-            "verdict",
-            trial=trial,
-            decisions=decisions,
-            dishonest=[i + 1 for i, h in enumerate(honest_parties) if not h],
-            success=len(filtered) == 1,
-        )
+        emit_verdict(log, trial, decisions, honest_parties,
+                     len(filtered) == 1)
     return {
         "success": len(filtered) == 1,
         "decisions": decisions,

@@ -1,0 +1,190 @@
+"""Multi-process message-level backend — backend=mp.
+
+The reference's only runtime is one OS process per party exchanging
+tagged MPI messages (``mpiexec -n <nParties+1> python tfg.py``,
+``README.md:3-4``, ``tfg.py:310-314``).  This backend reproduces that
+runtime *shape*: the coordinator (this process — the QSD/rank-0 role,
+``tfg.py:103-104,351-363``) presamples the trial's randomness with the
+identical key tree every other backend consumes, then spawns one OS
+process per protocol party (:mod:`qba_tpu.backends.mp_party`, jax-free).
+The parties self-assemble a full point-to-point Unix-socket mesh and run
+the protocol for real: every packet crosses a process boundary through
+the C++ PvL wire codec, rounds synchronize by message completion (BSP),
+and each lieutenant decides locally before reporting back — after which
+the coordinator collects decisions and prints the verdict exactly as
+rank 0 does in the reference.
+
+Decisions, accepted-sets and overflow are bit-identical to the other
+three backends for the same trial key, and the event trail (reassembled
+from per-party event streams by a canonical deterministic sort) is
+event-for-event identical to the local backend's
+(``tests/test_mp.py``).
+
+Note: party processes start via the multiprocessing ``spawn`` method
+(they must stay jax-free), so scripts calling :func:`run_trial_mp` need
+the standard ``if __name__ == "__main__":`` guard.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import tempfile
+from typing import TYPE_CHECKING
+
+import jax
+import numpy as np
+
+from qba_tpu.adversary import sample_attacks_round
+from qba_tpu.backends.local_backend import (
+    emit_host_phases,
+    emit_verdict,
+    presample_trial,
+)
+from qba_tpu.config import QBAConfig
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from qba_tpu.obs import EventLog
+
+
+def _native_so_path() -> str:
+    """Build (if needed) and return the native library path — in the
+    coordinator, so party processes never compile."""
+    from qba_tpu import native
+
+    native.load()
+    return native._SO
+
+
+def run_trial_mp(
+    cfg: QBAConfig,
+    key: jax.Array,
+    log: "EventLog | None" = None,
+    trial: int = 0,
+) -> dict:
+    """One protocol execution across real OS processes; returns the
+    rank-0 summary dict (same shape as ``run_trial_local``)."""
+    honest, lists, v_sent, v_comm, k_rounds = presample_trial(cfg, key)
+    w = cfg.w
+    # Per-round effective draws, identical arrays to every other engine.
+    attacks = np.stack(
+        [
+            np.stack(
+                [
+                    np.asarray(d)
+                    for d in sample_attacks_round(
+                        cfg, jax.random.fold_in(k_rounds, r)
+                    )
+                ],
+                axis=-1,
+            )
+            for r in range(1, cfg.n_rounds + 1)
+        ]
+    )  # [n_rounds, n_cells, n_lieu, 3]
+
+    so_path = _native_so_path()
+    ctx = mp.get_context("spawn")
+    common = dict(
+        n_parties=cfg.n_parties,
+        size_l=cfg.size_l,
+        n_dishonest=cfg.n_dishonest,
+        w=w,
+        slots=cfg.slots,
+        n_rounds=cfg.n_rounds,
+        max_l=cfg.max_l,
+        racy_defer=cfg.racy_mode == "defer",
+    )
+
+    from qba_tpu.backends import mp_party
+
+    with tempfile.TemporaryDirectory(prefix="qba_mp_") as sock_dir:
+        procs, pipes = [], {}
+        try:
+            for rank in range(1, cfg.n_parties + 1):
+                parent_conn, child_conn = ctx.Pipe(duplex=False)
+                if rank == 1:
+                    params = dict(
+                        common,
+                        list0=[int(x) for x in lists[0]],
+                        list1=[int(x) for x in lists[1]],
+                        v_sent=v_sent,
+                    )
+                    target = mp_party.commander_main
+                else:
+                    params = dict(
+                        common,
+                        honest=tuple(bool(h) for h in honest),
+                        list=[int(x) for x in lists[rank]],
+                        attacks=attacks[:, :, rank - 2, :],
+                    )
+                    target = mp_party.lieutenant_main
+                p = ctx.Process(
+                    target=target,
+                    args=(rank, sock_dir, so_path, child_conn, params),
+                    daemon=True,
+                )
+                p.start()
+                child_conn.close()
+                procs.append(p)
+                pipes[rank] = parent_conn
+
+            results = {}
+            for rank, conn in pipes.items():
+                status, payload = conn.recv()
+                if status != "ok":
+                    raise RuntimeError(
+                        f"mp party rank {rank} failed: {payload}"
+                    )
+                results[rank] = payload
+        finally:
+            for p in procs:
+                p.join(timeout=30)
+                if p.is_alive():  # pragma: no cover - hang safety
+                    p.terminate()
+
+    decisions = [v_comm] + [
+        results[r]["decision"] for r in range(2, cfg.n_parties + 1)
+    ]
+    vi = [
+        set(results[r]["vi"]) for r in range(2, cfg.n_parties + 1)
+    ]
+    overflow = any(
+        results[r]["overflow"] for r in range(2, cfg.n_parties + 1)
+    )
+    honest_parties = [bool(h) for h in honest[1:]]
+    filtered = {
+        d for d, h in zip(decisions, honest_parties) if h
+    }
+    success = len(filtered) == 1
+
+    if log is not None:
+        _emit_trail(
+            cfg, log, trial, honest, lists, v_comm, v_sent, results,
+            decisions, honest_parties, success,
+        )
+
+    return {
+        "success": success,
+        "decisions": decisions,
+        "honest": honest_parties,
+        "v_comm": v_comm,
+        "vi": vi,
+        "overflow": overflow,
+    }
+
+
+def _emit_trail(cfg, log, trial, honest, lists, v_comm, v_sent, results,
+                decisions, honest_parties, success) -> None:
+    """Reassemble the per-party event streams into the local backend's
+    exact event order: host-side phases, then the (round, stage,
+    receiver, sequence)-sorted protocol events, then the verdict.  The
+    sort is deterministic because each party's per-(round, stage) order
+    is — concurrency cannot reorder the rendered trail."""
+    emit_host_phases(cfg, log, trial, honest, lists, v_comm, v_sent)
+    merged = []
+    for payload in results.values():
+        merged.extend(payload["events"])
+    merged.sort(key=lambda e: e[0])
+    for _key, phase, message, fields in merged:
+        log.debug(phase, message, trial=trial, **fields)
+    emit_verdict(log, trial, decisions, honest_parties, success)

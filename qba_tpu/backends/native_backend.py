@@ -152,8 +152,10 @@ def run_trial_native(
     trace = None
     if log is not None:
         # Capacity: step2+3a (2/lieutenant) + per round: <= n_pk deliveries
-        # per receiver, each <= 3 records, + vi snapshot headers and up to
-        # w value records per rank.
+        # per receiver, each <= 4 records (attack + late-defer in the
+        # original round, the deferred kind-9 re-delivery in the next,
+        # or attack + receive + rebroadcast), + vi snapshot headers and
+        # up to w value records per rank.
         n_lieu = cfg.n_lieutenants
         per_round = n_lieu * (n_lieu * cfg.slots * 4 + 1 + cfg.w)
         trace = np.zeros(
@@ -174,34 +176,24 @@ def run_trial_native(
         "overflow": bool(res["overflow"][0]),
     }
     if log is not None:
-        honest = res["honest"][0]
-        # tfg.py:124 — per-rank honesty (host-side phase, like local).
-        for rank in range(1, cfg.n_parties + 1):
-            log.debug("dishonesty", "party role", trial=trial, rank=rank,
-                      honest=bool(honest[rank - 1]))
-        for rank in range(cfg.n_parties + 1):
-            row = [int(x) for x in res["lists"][0][rank][:16]]
-            log.debug("particles", "list received", trial=trial, rank=rank,
-                      head=row, size_l=cfg.size_l)
-        n_qcorr = int(
-            (res["lists"][0][0] != res["lists"][0][1]).sum()
+        from qba_tpu.backends.local_backend import (
+            emit_host_phases,
+            emit_verdict,
         )
-        log.info("step2", "commander order", trial=trial,
-                 v=out["v_comm"], n_qcorr=n_qcorr,
-                 commander_honest=bool(honest[0]))
-        v_sent = set(int(x) for x in res["v_sent"][0])
-        if len(v_sent) > 1:
-            log.info("step2", "commander equivocates", trial=trial,
-                     orders=sorted(v_sent))
+
+        # Host-side phases from the presampled arrays, via the shared
+        # emitters (rank-indexed honesty like the other backends).
+        honest_r = np.concatenate(
+            [[True], res["honest"][0].astype(bool)]
+        )
+        v_sent_l = [int(x) for x in res["v_sent"][0]]
+        emit_host_phases(cfg, log, trial, honest_r, res["lists"][0],
+                         out["v_comm"], v_sent_l)
         if res["trace_len"][0] >= trace.shape[0]:
             log.warning("round", "trace truncated", trial=trial)
         _emit_trace(cfg, log, trial, trace[: res["trace_len"][0]])
-        log.info(
-            "decision", "verdict", trial=trial,
-            decisions=out["decisions"],
-            dishonest=[i + 1 for i, h in enumerate(out["honest"]) if not h],
-            success=out["success"],
-        )
+        emit_verdict(log, trial, out["decisions"], out["honest"],
+                     out["success"])
     return out
 
 

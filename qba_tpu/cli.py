@@ -103,9 +103,12 @@ def _parser() -> argparse.ArgumentParser:
     run = sub.add_parser("run", help="run trials, print verdicts")
     _add_config_args(run, trials_default=1)
     run.add_argument(
-        "--backend", choices=("jax", "local", "native"), default="jax",
+        "--backend", choices=("jax", "local", "native", "mp"),
+        default="jax",
         help="jax = vectorized TPU path; local = message-level pure-Python "
-        "path; native = C++ host runtime (qba_tpu/native)",
+        "path; native = C++ host runtime (qba_tpu/native); mp = one OS "
+        "process per party over Unix-socket mesh + the C++ PvL wire "
+        "codec (the reference's mpiexec runtime shape)",
     )
     run.add_argument(
         "-v", "--verbose", action="store_true", help="debug-level event log"
@@ -220,9 +223,15 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
                 print(render_verdict(cfg, trial, index=i), file=out)
             any_overflow = bool(np.any(res["overflow"]))
             success_rate = res["success_rate"]
-        elif args.backend == "local":
+        elif args.backend in ("local", "mp"):
             from qba_tpu.backends.jax_backend import trial_keys
-            from qba_tpu.backends.local_backend import run_trial_local
+
+            if args.backend == "mp":
+                from qba_tpu.backends.mp_backend import (
+                    run_trial_mp as run_trial_local,
+                )
+            else:
+                from qba_tpu.backends.local_backend import run_trial_local
 
             keys = trial_keys(cfg)
             successes = 0
