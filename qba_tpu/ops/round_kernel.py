@@ -47,6 +47,10 @@ from qba_tpu.adversary import (
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
+from qba_tpu.ops.verdict_algebra import (
+    VerdictAlgebra,
+    accept_first_per_value,
+)
 
 
 def _cumsum_exclusive(col: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -206,42 +210,6 @@ def build_round_step(
         valid = [count > r for r in range(max_l)]  # each [n_pk, 1]
         len0 = lens[:, 0:1]  # [n_pk, 1]
 
-        # ---- Receiver-independent raw-mailbox facts ----------------------
-        false_col = jnp.zeros((n_pk, 1), jnp.bool_)
-        oob = false_col
-        lens_bad = false_col
-        cells_coll = false_col
-        for r in range(max_l):
-            row_bad = jnp.any(
-                in_t[r] & ((vals[r] > w) | (vals[r] < 0)), axis=1, keepdims=True
-            )
-            oob |= valid[r] & row_bad
-            lens_bad |= valid[r] & (lens[:, r : r + 1] != len0)
-            for s in range(r + 1, max_l):
-                hit = jnp.any(
-                    in_t[r] & in_t[s] & (vals[r] == vals[s]),
-                    axis=1,
-                    keepdims=True,
-                )
-                cells_coll |= valid[s] & hit
-
-        # Per-position value-presence bitmask (w <= 32 only): bit x of
-        # ``pm[pk, j]`` is set iff some valid evidence row holds value x at
-        # position j.  Turns the per-receiver contains-v2 / own-collision
-        # row loops (O(max_l) [n_pk, size_l] reductions each) into single
-        # vector shifts against this shared table — the receiver unroll is
-        # the kernel's hot loop, so receiver-independent precompute is
-        # nearly free by comparison.
-        use_bitmask = w <= 32
-        if use_bitmask:
-            pm = jnp.zeros((n_pk, size_l), jnp.int32)
-            for r in range(max_l):
-                in_range = (vals[r] >= 0) & (vals[r] <= 31)
-                pm |= jnp.where(
-                    valid[r] & in_t[r] & in_range,
-                    jnp.left_shift(jnp.int32(1), vals[r] & 31),
-                    0,
-                )
         li_all = li_ref[:]  # [n_lieu, size_l] (rebuild's li_exp below)
 
         ovi_ref[:] = vi_ref[:]
@@ -268,177 +236,37 @@ def build_round_step(
         count_eff_all = jnp.where(clearl_all, 0, count)
 
         def accept_and_store(recv, ok, dup, own_len):
-            """Per-receiver acceptance: first-candidate-per-order dedup
-            against Vi (tfg.py:294), vi update, and the scratch columns
+            """Per-receiver acceptance (shared first-candidate dedup,
+            ops/verdict_algebra.py), vi update, and the scratch columns
             for the batched rebuild.  NOT idempotent (reads ovi_ref) —
             must run exactly once per receiver."""
-            v2 = v2_all[:, recv : recv + 1]
-            vi_row = ovi_ref[recv : recv + 1, :]  # [1, w]
-            iota_w = jax.lax.broadcasted_iota(jnp.int32, (n_pk, w), 1)
-            onehot = v2 == iota_w  # [n_pk, w]
-            in_vi = jnp.any(
-                onehot & (vi_row != 0), axis=1, keepdims=True
-            )  # [n_pk, 1]
-            cand = ok & ~in_vi
-            masked_idx = jnp.where(onehot & cand, idx_col, n_pk)
-            first = jnp.min(masked_idx, axis=0, keepdims=True)  # [1, w]
-            first_b = jnp.min(
-                jnp.where(onehot, jnp.broadcast_to(first, (n_pk, w)), n_pk),
-                axis=1,
-                keepdims=True,
-            )  # [n_pk, 1]
-            acc = cand & (first_b == idx_col)
-
-            new_vi = (vi_row != 0) | jnp.any(acc & onehot, axis=0, keepdims=True)
+            acc, new_vi = accept_first_per_value(
+                ok, v2_all[:, recv : recv + 1],
+                ovi_ref[recv : recv + 1, :], idx_col, n_pk, w,
+            )
             ovi_ref[recv : recv + 1, :] = new_vi.astype(jnp.int32)
-
             acc_scr[:, recv : recv + 1] = acc.astype(jnp.int32)
             dup_scr[:, recv : recv + 1] = dup.astype(jnp.int32)
             olen_scr[:, recv : recv + 1] = own_len
 
-        # ---- Loop A, lane-packed: grp receivers per tile ----------
-        # (grp == 1 degenerates to per-receiver processing through
-        # the same algebra — ONE maintained implementation.)
-        if grp > 1:  # unused by the grp == 1 primitives below
-            e_mat = e_ref[:].astype(gdt)  # [grp, seg_l] segment one-hot
-
-        def as_gdt(x):
-            # Mosaic rejects the i1 vector relayout an astype from
-            # bool can pick (bitcast_vreg i1->i32 on narrow tiles);
-            # a select against float constants lowers cleanly.
-            if x.dtype == jnp.bool_:
-                return jnp.where(x, 1.0, 0.0).astype(gdt)
-            return x.astype(gdt)
-
-        # The two segment primitives; everything downstream is ONE
-        # algebra over them.  grp == 1 degenerates both to plain
-        # broadcast / axis reduction (Mosaic cannot lower the
-        # 1-wide-output matmul, and there is nothing to pack anyway).
-        if grp == 1:
-
-            def expand(cols):  # [n_pk, 1] -> [n_pk, seg_l]
-                return jnp.broadcast_to(
-                    as_gdt(cols).astype(jnp.float32), (n_pk, seg_l)
-                )
-
-            def seg_reduce(lanes):  # [n_pk, seg_l] -> [n_pk, 1] counts
-                return jnp.sum(
-                    as_gdt(lanes).astype(jnp.float32),
-                    axis=1,
-                    keepdims=True,
-                )
-
-        else:
-
-            def expand(cols):  # [n_pk, grp] -> [n_pk, seg_l] per segment
-                return jax.lax.dot_general(
-                    as_gdt(cols), e_mat,
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-
-            def seg_reduce(lanes):  # [n_pk, seg_l] -> [n_pk, grp] counts
-                return jax.lax.dot_general(
-                    as_gdt(lanes), e_mat,
-                    (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-
-        # Receiver-independent lane tiles, built once: grp copies of
-        # the packet tables side by side.
-        vals_t = [
-            jnp.concatenate([vals[r]] * grp, axis=1) for r in range(max_l)
-        ]
-        # Concatenate the int32 table and compare after: an i1-vector
-        # concat trips the same Mosaic relayout as the astype above.
-        p_tile = jnp.concatenate([p_ref[:]] * grp, axis=1) != 0
-        if use_bitmask:
-            pm_t = jnp.concatenate([pm] * grp, axis=1)
-        else:
-            in_t_t = [vals_t[r] != SENTINEL for r in range(max_l)]
-
+        # ---- Loop A: the shared per-group acceptance flag algebra ------
+        # (ops/verdict_algebra.py — one implementation for both Pallas
+        # kernels; lane-packs grp receivers per tile, value-presence as
+        # bit planes for w <= 64, per-row loops beyond.)
+        va = VerdictAlgebra(
+            n_p=n_pk, grp=grp, seg_l=seg_l, max_l=max_l,
+            size_l=size_l, w=w, gdt=gdt,
+            vals=vals, lens=lens, count=count, p_i32=p_ref[:],
+            e_vals=e_ref[:], lip_vals=lip_ref[:],
+            lioob_vals=lioob_ref[:], r_idx=r_idx,
+        )
         done: set[int] = set()
         for gi, r0 in enumerate(r0_list):
             sl = slice(r0, r0 + grp)
-            clearl_g = clearl_all[:, sl]  # [n_pk, grp]
-            count_eff_g = count_eff_all[:, sl]
-            delivered_g = delivered_all[:, sl]
-
-            v2_lanes = expand(v2_all[:, sl]).astype(jnp.int32)
-            clearp_lanes = expand(clearp_all[:, sl]) != 0
-            p2_lanes = p_tile & ~clearp_lanes  # [n_pk, seg_l]
-            li_row = lip_ref[gi : gi + 1, :]  # [1, seg_l]
-            li_bc = jnp.broadcast_to(li_row, (n_pk, seg_l))
-            own_lanes = jnp.where(p2_lanes, li_bc, SENTINEL)
-
-            dup_g = jnp.zeros((n_pk, grp), jnp.bool_)
-            for r in range(max_l):
-                mism = seg_reduce(vals_t[r] != own_lanes)
-                dup_g |= valid[r] & (mism == 0)
-            dup_g &= ~clearl_g
-            own_len_g = seg_reduce(p2_lanes).astype(jnp.int32)
-
-            bad_own_pos = p2_lanes & (
-                (li_bc == v2_lanes) | (lioob_ref[gi : gi + 1, :] != 0)
+            ok_g, dup_g, own_len_g = va.group(
+                gi, v2_all[:, sl], clearp_all[:, sl], clearl_all[:, sl],
+                count_eff_all[:, sl], delivered_all[:, sl],
             )
-            if use_bitmask:
-                contains_pos = (
-                    jnp.right_shift(pm_t, v2_lanes) & 1
-                ) != 0
-                cont_g = seg_reduce(contains_pos) > 0
-                own_coll_g = (
-                    seg_reduce(
-                        p2_lanes
-                        & ((jnp.right_shift(pm_t, li_bc) & 1) != 0)
-                    )
-                    > 0
-                )
-                bad_own_g = seg_reduce(bad_own_pos) > 0
-                cont_or_oob = ~clearl_g & (cont_g | oob)
-            else:
-                contains_g = jnp.zeros((n_pk, grp), jnp.bool_)
-                own_coll_g = jnp.zeros((n_pk, grp), jnp.bool_)
-                for r in range(max_l):
-                    contains_g |= valid[r] & (
-                        seg_reduce(in_t_t[r] & (vals_t[r] == v2_lanes))
-                        > 0
-                    )
-                    own_coll_g |= valid[r] & (
-                        seg_reduce(
-                            p2_lanes
-                            & in_t_t[r]
-                            & (vals_t[r] == own_lanes)
-                        )
-                        > 0
-                    )
-                bad_own_g = seg_reduce(bad_own_pos) > 0
-                cont_or_oob = ~clearl_g & (oob | contains_g)
-
-            # append_own's fullness guard (consistent_after_append): the
-            # own-row terms apply only when the row actually enters L'.
-            # The config invariant max_l >= n_rounds + 1 makes
-            # `appended_g` reduce to `~dup_g` — the guard keeps the
-            # kernel on the spec even if the bound is raised/decoupled
-            # via max_evidence_rows.
-            appended_g = ~dup_g & (count_eff_g < max_l)
-            cond2 = ~(cont_or_oob | (appended_g & bad_own_g))
-            new_count_g = jnp.where(
-                appended_g, count_eff_g + 1, count_eff_g
-            )
-            cond1 = (clearl_g | ~lens_bad) & (
-                ~appended_g | (count_eff_g == 0) | (own_len_g == len0)
-            )
-            cond3 = (clearl_g | ~cells_coll) & (
-                ~appended_g | ~(~clearl_g & own_coll_g)
-            )
-            ok_g = (
-                delivered_g
-                & cond1
-                & cond2
-                & cond3
-                & (new_count_g == r_idx + 1)
-            )
-
             for j in range(grp):
                 recv = r0 + j
                 if recv in done:  # tail-group overlap: already done
@@ -599,6 +427,14 @@ def build_round_step(
             pltpu.VMEM((n_pk, n_rv), jnp.int32),  # olen_scr
             pltpu.VMEM((n_pk, n_c), gdt),  # g_scr
         ],
+        compiler_params=pltpu.CompilerParams(
+            # Raise Mosaic's ~16 MB default scoped-vmem cap toward the
+            # physical VMEM: large vmap batches multi-buffer operands
+            # (see round_kernel_tiled.py), and configs like the
+            # reference's sizeL=1000 at the lossless slot bound compile
+            # comfortably under the real limit.
+            vmem_limit_bytes=100 * 2**20,
+        ),
         interpret=interpret,
     )
 
@@ -672,7 +508,7 @@ def _probe_cache_path() -> str:
     )
 
 
-_PROBE_VERSION = 2  # bump when kernel structure/compiler params change
+_PROBE_VERSION = 3  # bump when kernel structure/compiler params change
 
 
 def _probe_disk_key(kernel: str, cfg: QBAConfig, extra: str = "") -> str:
@@ -720,7 +556,7 @@ def _probe_disk_put(key: str, value) -> None:
 # ~3.7x (nParties=33, sizeL=64, slots=8: est 6.8 MB, OOM at 25.45 MB) —
 # so the estimate only screens out hopeless configs before paying for a
 # doomed compile.
-_VMEM_PREFILTER_BYTES = 64 * 2**20
+_VMEM_PREFILTER_BYTES = 128 * 2**20
 
 
 def fits_kernel(cfg: QBAConfig, n_recv: int | None = None) -> bool:
@@ -811,14 +647,25 @@ def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
 
     try:
         step = build_round_step(cfg, n_recv=n_recv)
-        off = () if n_recv is None else (shp(),)
-        jax.jit(step).lower(
-            shp(),  # round_idx
+        n_in = 12  # operands after the round-idx scalar
+        off = ()
+        in_axes = (None,) + (0,) * n_in
+        if n_recv is not None:
+            off = (jax.ShapeDtypeStruct((), i32),)
+            in_axes = (None, None) + (0,) * n_in
+
+        def bshp(*dims):
+            # Probe under a small vmap: batching multi-buffers operands
+            # (see round_kernel_tiled.py's probe note).
+            return jax.ShapeDtypeStruct((2,) + dims, i32)
+
+        jax.jit(jax.vmap(step, in_axes=in_axes)).lower(
+            jax.ShapeDtypeStruct((), i32),  # round_idx
             *off,  # recv block offset (local variant)
-            shp(max_l, n_pk, s), shp(n_pk, max_l), shp(n_pk, 1),
-            shp(n_pk, s), shp(n_pk, 1), shp(n_pk, 1),  # vals..sent
-            shp(n_rv, s), shp(n_rv, w), shp(n_pk, 1),  # li, vi, honest
-            shp(n_pk, n_rv), shp(n_pk, n_rv), shp(n_pk, n_rv),  # draws
+            bshp(max_l, n_pk, s), bshp(n_pk, max_l), bshp(n_pk, 1),
+            bshp(n_pk, s), bshp(n_pk, 1), bshp(n_pk, 1),  # vals..sent
+            bshp(n_rv, s), bshp(n_rv, w), bshp(n_pk, 1),  # li, vi, honest
+            bshp(n_pk, n_rv), bshp(n_pk, n_rv), bshp(n_pk, n_rv),  # draws
         ).compile()
         ok = True
     except Exception as e:  # compile failures only reach here (no execution)

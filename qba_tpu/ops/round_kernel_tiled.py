@@ -68,6 +68,10 @@ from qba_tpu.adversary import (
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
 from qba_tpu.ops.round_kernel import _lane_group
+from qba_tpu.ops.verdict_algebra import (
+    VerdictAlgebra,
+    accept_first_per_value,
+)
 
 
 def build_verdict_kernel(
@@ -110,12 +114,6 @@ def build_verdict_kernel(
     for j in range(grp):
         e_np[j, j * size_l : (j + 1) * size_l] = 1.0
 
-    # Value-presence bit planes: plane p, bit b set at (pk, pos) iff some
-    # valid evidence row holds value 32*p + b there.  Exact for queries
-    # < w (mailbox v < w; forged v < n_parties+1 <= w; li values < w).
-    n_planes = (w + 31) // 32
-    use_bitmask = w <= 64
-
     def kernel(round_ref, *refs):
         (
             vals_ref, lens_ref, count_ref, p_ref, v_ref, sent_ref,
@@ -150,50 +148,11 @@ def build_verdict_kernel(
         def _verdict():
             idx_col = jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
             sender_col = cell_ref[:] // slots  # [blk, 1]
-
             vals = [
                 vals_ref[r].astype(jnp.int32) for r in range(max_l)
             ]  # each [blk, size_l]
-            in_t = [vals[r] != SENTINEL for r in range(max_l)]
-            lens = lens_ref[:]  # [blk, max_l]
-            count = count_ref[:]  # [blk, 1]
-            v_in = v_ref[:]  # [blk, 1]
             sent = sent_ref[:] != 0  # [blk, 1]
             biz = honest_ref[:] == 0  # [blk, 1]
-            valid = [count > r for r in range(max_l)]
-            len0 = lens[:, 0:1]
-
-            # ---- Receiver-independent raw-pool facts ---------------------
-            false_col = jnp.zeros((blk, 1), jnp.bool_)
-            oob = false_col
-            lens_bad = false_col
-            cells_coll = false_col
-            for r in range(max_l):
-                row_bad = jnp.any(
-                    in_t[r] & ((vals[r] > w) | (vals[r] < 0)),
-                    axis=1, keepdims=True,
-                )
-                oob |= valid[r] & row_bad
-                lens_bad |= valid[r] & (lens[:, r : r + 1] != len0)
-                for s in range(r + 1, max_l):
-                    hit = jnp.any(
-                        in_t[r] & in_t[s] & (vals[r] == vals[s]),
-                        axis=1, keepdims=True,
-                    )
-                    cells_coll |= valid[s] & hit
-
-            if use_bitmask:
-                pm = [jnp.zeros((blk, size_l), jnp.int32)
-                      for _ in range(n_planes)]
-                for r in range(max_l):
-                    for p_i in range(n_planes):
-                        lo, hi = 32 * p_i, 32 * (p_i + 1)
-                        in_pl = (vals[r] >= lo) & (vals[r] < hi)
-                        pm[p_i] |= jnp.where(
-                            valid[r] & in_t[r] & in_pl,
-                            jnp.left_shift(jnp.int32(1), vals[r] & 31),
-                            0,
-                        )
 
             # ---- All-receiver flag algebra -------------------------------
             act_all = act_ref[:]  # [blk, n_rv] (pool-ordered draws)
@@ -202,177 +161,46 @@ def build_verdict_kernel(
             lane_recv = jax.lax.broadcasted_iota(jnp.int32, (blk, n_rv), 1)
             dropped_all = biz & ((act_all & DROP_BIT) != 0)
             v2_all = jnp.where(biz & ((act_all & FORGE_BIT) != 0),
-                               rv_all, v_in)
+                               rv_all, v_ref[:])
             clearp_all = biz & ((act_all & CLEAR_P_BIT) != 0)
             clearl_all = biz & ((act_all & CLEAR_L_BIT) != 0)
             delivered_all = (
                 ~dropped_all & (late_all == 0) & sent
                 & (sender_col != lane_recv)
             )
-            count_eff_all = jnp.where(clearl_all, 0, count)
+            count_eff_all = jnp.where(clearl_all, 0, count_ref[:])
 
-            def accept_and_store(recv, ok):
-                """First-candidate-per-order dedup against Vi
-                (tfg.py:294) within this block; vi carries across blocks
-                via the revisited ovi output.  NOT idempotent — runs
-                exactly once per receiver per block."""
-                v2 = v2_all[:, recv : recv + 1]
-                vi_row = ovi_ref[recv : recv + 1, :]  # [1, w]
-                iota_w = jax.lax.broadcasted_iota(jnp.int32, (blk, w), 1)
-                onehot = v2 == iota_w
-                in_vi = jnp.any(onehot & (vi_row != 0), axis=1,
-                                keepdims=True)
-                cand = ok & ~in_vi
-                masked_idx = jnp.where(onehot & cand, idx_col, blk)
-                first = jnp.min(masked_idx, axis=0, keepdims=True)
-                first_b = jnp.min(
-                    jnp.where(onehot, jnp.broadcast_to(first, (blk, w)),
-                              blk),
-                    axis=1, keepdims=True,
-                )
-                acc = cand & (first_b == idx_col)
-                new_vi = (vi_row != 0) | jnp.any(
-                    acc & onehot, axis=0, keepdims=True
-                )
-                ovi_ref[recv : recv + 1, :] = new_vi.astype(jnp.int32)
-                acc_ref[:, recv : recv + 1] = acc.astype(jnp.int32)
-
-            # ---- Lane-packed verdict loop (see round_kernel.py) ----------
-            if grp > 1:
-                e_mat = e_ref[:].astype(gdt)
-
-            def as_gdt(x):
-                if x.dtype == jnp.bool_:
-                    return jnp.where(x, 1.0, 0.0).astype(gdt)
-                return x.astype(gdt)
-
-            if grp == 1:
-
-                def expand(cols):
-                    return jnp.broadcast_to(
-                        as_gdt(cols).astype(jnp.float32), (blk, seg_l)
-                    )
-
-                def seg_reduce(lanes):
-                    return jnp.sum(
-                        as_gdt(lanes).astype(jnp.float32),
-                        axis=1, keepdims=True,
-                    )
-
-            else:
-
-                def expand(cols):
-                    return jax.lax.dot_general(
-                        as_gdt(cols), e_mat,
-                        (((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-
-                def seg_reduce(lanes):
-                    return jax.lax.dot_general(
-                        as_gdt(lanes), e_mat,
-                        (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32,
-                    )
-
-            vals_t = [
-                jnp.concatenate([vals[r]] * grp, axis=1)
-                for r in range(max_l)
-            ]
-            # int8 compares produce masks in the narrow tiling whose
-            # relayout Mosaic rejects — widen first.
-            p_i32 = p_ref[:].astype(jnp.int32)
-            p_tile = jnp.concatenate([p_i32] * grp, axis=1) != 0
-            if use_bitmask:
-                pm_t = [jnp.concatenate([pm[p_i]] * grp, axis=1)
-                        for p_i in range(n_planes)]
-            else:
-                in_t_t = [vals_t[r] != SENTINEL for r in range(max_l)]
-
-            def plane_bit(planes_t, q_lanes):
-                """Presence bit of query value ``q_lanes`` (< w) at each
-                (packet, position): select the plane by q >> 5, shift by
-                q & 31."""
-                sel = planes_t[0]
-                for p_i in range(1, n_planes):
-                    sel = jnp.where((q_lanes >> 5) == p_i,
-                                    planes_t[p_i], sel)
-                return (jnp.right_shift(sel, q_lanes & 31) & 1) != 0
-
+            # The shared per-group acceptance flag algebra
+            # (ops/verdict_algebra.py — one implementation for both
+            # Pallas kernels).
+            va = VerdictAlgebra(
+                n_p=blk, grp=grp, seg_l=seg_l, max_l=max_l,
+                size_l=size_l, w=w, gdt=gdt,
+                vals=vals, lens=lens_ref[:], count=count_ref[:],
+                p_i32=p_ref[:].astype(jnp.int32),
+                e_vals=e_ref[:], lip_vals=lip_ref[:],
+                lioob_vals=lioob_ref[:], r_idx=r_idx,
+            )
             done: set[int] = set()
             for gi, r0 in enumerate(r0_list):
                 sl = slice(r0, r0 + grp)
-                clearl_g = clearl_all[:, sl]
-                count_eff_g = count_eff_all[:, sl]
-                delivered_g = delivered_all[:, sl]
-
-                v2_lanes = expand(v2_all[:, sl]).astype(jnp.int32)
-                clearp_lanes = expand(clearp_all[:, sl]) != 0
-                p2_lanes = p_tile & ~clearp_lanes
-                li_row = lip_ref[gi : gi + 1, :]
-                li_bc = jnp.broadcast_to(li_row, (blk, seg_l))
-                own_lanes = jnp.where(p2_lanes, li_bc, SENTINEL)
-
-                dup_g = jnp.zeros((blk, grp), jnp.bool_)
-                for r in range(max_l):
-                    mism = seg_reduce(vals_t[r] != own_lanes)
-                    dup_g |= valid[r] & (mism == 0)
-                dup_g &= ~clearl_g
-                own_len_g = seg_reduce(p2_lanes).astype(jnp.int32)
-
-                bad_own_pos = p2_lanes & (
-                    (li_bc == v2_lanes) | (lioob_ref[gi : gi + 1, :] != 0)
+                ok_g, _dup_g, _olen_g = va.group(
+                    gi, v2_all[:, sl], clearp_all[:, sl],
+                    clearl_all[:, sl], count_eff_all[:, sl],
+                    delivered_all[:, sl],
                 )
-                if use_bitmask:
-                    cont_g = seg_reduce(plane_bit(pm_t, v2_lanes)) > 0
-                    own_coll_g = (
-                        seg_reduce(p2_lanes & plane_bit(pm_t, li_bc)) > 0
-                    )
-                    bad_own_g = seg_reduce(bad_own_pos) > 0
-                    cont_or_oob = ~clearl_g & (cont_g | oob)
-                else:
-                    contains_g = jnp.zeros((blk, grp), jnp.bool_)
-                    own_coll_g = jnp.zeros((blk, grp), jnp.bool_)
-                    for r in range(max_l):
-                        contains_g |= valid[r] & (
-                            seg_reduce(in_t_t[r] & (vals_t[r] == v2_lanes))
-                            > 0
-                        )
-                        own_coll_g |= valid[r] & (
-                            seg_reduce(
-                                p2_lanes & in_t_t[r]
-                                & (vals_t[r] == own_lanes)
-                            )
-                            > 0
-                        )
-                    bad_own_g = seg_reduce(bad_own_pos) > 0
-                    cont_or_oob = ~clearl_g & (oob | contains_g)
-
-                # append_own's fullness guard — see round_kernel.py; the
-                # config invariant max_l >= n_rounds + 1 makes it
-                # reduce to ~dup_g.
-                appended_g = ~dup_g & (count_eff_g < max_l)
-                cond2 = ~(cont_or_oob | (appended_g & bad_own_g))
-                new_count_g = jnp.where(
-                    appended_g, count_eff_g + 1, count_eff_g
-                )
-                cond1 = (clearl_g | ~lens_bad) & (
-                    ~appended_g | (count_eff_g == 0) | (own_len_g == len0)
-                )
-                cond3 = (clearl_g | ~cells_coll) & (
-                    ~appended_g | ~(~clearl_g & own_coll_g)
-                )
-                ok_g = (
-                    delivered_g & cond1 & cond2 & cond3
-                    & (new_count_g == r_idx + 1)
-                )
-
                 for j in range(grp):
                     recv = r0 + j
-                    if recv in done:
+                    if recv in done:  # tail-group overlap: already done
                         continue
                     done.add(recv)
-                    accept_and_store(recv, ok_g[:, j : j + 1])
+                    acc, new_vi = accept_first_per_value(
+                        ok_g[:, j : j + 1],
+                        v2_all[:, recv : recv + 1],
+                        ovi_ref[recv : recv + 1, :], idx_col, blk, w,
+                    )
+                    ovi_ref[recv : recv + 1, :] = new_vi.astype(jnp.int32)
+                    acc_ref[:, recv : recv + 1] = acc.astype(jnp.int32)
 
     grid = (n_blocks,)
 

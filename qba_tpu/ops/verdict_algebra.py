@@ -1,0 +1,258 @@
+"""The shared per-packet acceptance flag algebra of the Pallas round
+kernels.
+
+Both TPU kernels — the fused monolithic kernel
+(:mod:`qba_tpu.ops.round_kernel`) and the packet-tiled verdict kernel
+(:mod:`qba_tpu.ops.round_kernel_tiled`) — evaluate the same batched form
+of ``lieu_receive``'s consistency verdict (``tfg.py:289-300``,
+executable spec: :func:`qba_tpu.core.consistent.consistent_after_append`)
+over lane-packed receiver groups.  This module holds that algebra ONCE:
+the kernels keep their own layouts, scheduling, and rebuild phases, but
+the flag math a spec change must touch lives here — previously it
+existed as three hand-synchronized copies (the XLA engine's batched form
+remains in :mod:`qba_tpu.rounds.engine`; the kernels' two copies are
+unified here), and the ``appended`` guard of round 3 had to be applied
+to each one separately.
+
+Conventions (see round_kernel.py's layout notes): packets fill sublanes
+(``n_p`` of them — the whole mailbox or one tile block), list positions
+fill lanes, receivers are lane-packed ``grp`` per tile with per-segment
+reductions as exact bf16/f32 MXU matmuls against a segment one-hot.
+Value-presence tests use per-position bit-plane masks (``ceil(w/32)``
+int32 planes, exact for all queried values < w) when ``w <= 64``; wider
+order spaces fall back to per-row loops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qba_tpu.core.types import SENTINEL
+
+
+class VerdictAlgebra:
+    """Per-kernel-invocation instance: precomputes the receiver-
+    independent raw-packet facts and lane tiles from loaded values, then
+    evaluates per-receiver-group verdicts.
+
+    Args (values, already loaded from refs — all int32 unless noted):
+      vals: list of ``max_l`` evidence-row tiles ``[n_p, size_l]``.
+      lens: ``[n_p, max_l]``; count: ``[n_p, 1]``.
+      p_i32: ``[n_p, size_l]`` P-mask as 0/1 int32.
+      e_vals: segment one-hot ``[grp, seg_l]`` (ignored when grp == 1).
+      lip_vals / lioob_vals: lane-packed receiver lists / out-of-bound
+        flags ``[n_groups, seg_l]``.
+      r_idx: the round index (traced scalar).
+    """
+
+    def __init__(self, *, n_p, grp, seg_l, max_l, size_l, w, gdt,
+                 vals, lens, count, p_i32, e_vals, lip_vals, lioob_vals,
+                 r_idx):
+        self.n_p, self.grp, self.seg_l = n_p, grp, seg_l
+        self.max_l, self.size_l, self.w, self.gdt = max_l, size_l, w, gdt
+        self.lip_vals, self.lioob_vals = lip_vals, lioob_vals
+        self.r_idx = r_idx
+        self.lens, self.count = lens, count
+        self.len0 = lens[:, 0:1]
+        self.vals = vals
+        in_t = [vals[r] != SENTINEL for r in range(max_l)]
+        self.valid = [count > r for r in range(max_l)]
+
+        # ---- Receiver-independent raw-packet facts (tfg.py:87-98) ----
+        false_col = jnp.zeros((n_p, 1), jnp.bool_)
+        oob = false_col
+        lens_bad = false_col
+        cells_coll = false_col
+        for r in range(max_l):
+            row_bad = jnp.any(
+                in_t[r] & ((vals[r] > w) | (vals[r] < 0)),
+                axis=1, keepdims=True,
+            )
+            oob |= self.valid[r] & row_bad
+            lens_bad |= self.valid[r] & (lens[:, r : r + 1] != self.len0)
+            for s in range(r + 1, max_l):
+                hit = jnp.any(
+                    in_t[r] & in_t[s] & (vals[r] == vals[s]),
+                    axis=1, keepdims=True,
+                )
+                cells_coll |= self.valid[s] & hit
+        self.oob, self.lens_bad, self.cells_coll = oob, lens_bad, cells_coll
+
+        # Value-presence bit planes: bit (x & 31) of plane x >> 5 set at
+        # (packet, position) iff some valid evidence row holds value x
+        # there.  Exact for queries < w (mailbox v < w, forged v <
+        # n_parties+1 <= w, li values < w); stored out-of-range garbage
+        # cannot alias a query (distinct (plane, bit) per value).
+        self.n_planes = (w + 31) // 32
+        self.use_bitmask = w <= 64
+        if self.use_bitmask:
+            pm = [jnp.zeros((n_p, size_l), jnp.int32)
+                  for _ in range(self.n_planes)]
+            for r in range(max_l):
+                for p_i in range(self.n_planes):
+                    lo, hi = 32 * p_i, 32 * (p_i + 1)
+                    in_pl = (vals[r] >= lo) & (vals[r] < hi)
+                    pm[p_i] |= jnp.where(
+                        self.valid[r] & in_t[r] & in_pl,
+                        jnp.left_shift(jnp.int32(1), vals[r] & 31),
+                        0,
+                    )
+
+        # ---- Lane-packed tiles: grp copies of the packet tables ------
+        if grp > 1:
+            self._e_mat = e_vals.astype(gdt)
+        self.vals_t = [
+            jnp.concatenate([vals[r]] * grp, axis=1) for r in range(max_l)
+        ]
+        self.p_tile = jnp.concatenate([p_i32] * grp, axis=1) != 0
+        if self.use_bitmask:
+            self.pm_t = [jnp.concatenate([pm[p_i]] * grp, axis=1)
+                         for p_i in range(self.n_planes)]
+        else:
+            self.in_t_t = [self.vals_t[r] != SENTINEL
+                           for r in range(max_l)]
+
+    # The two segment primitives; everything downstream is ONE algebra
+    # over them.  grp == 1 degenerates both to plain broadcast / axis
+    # reduction (Mosaic cannot lower a 1-wide-output matmul, and there
+    # is nothing to pack anyway).
+    def _as_gdt(self, x):
+        # Mosaic rejects the i1 vector relayout an astype from bool can
+        # pick (bitcast_vreg i1->i32 on narrow tiles); a select against
+        # float constants lowers cleanly.
+        if x.dtype == jnp.bool_:
+            return jnp.where(x, 1.0, 0.0).astype(self.gdt)
+        return x.astype(self.gdt)
+
+    def expand(self, cols):
+        """[n_p, grp] per-receiver columns -> [n_p, seg_l] lanes."""
+        if self.grp == 1:
+            return jnp.broadcast_to(
+                self._as_gdt(cols).astype(jnp.float32),
+                (self.n_p, self.seg_l),
+            )
+        return jax.lax.dot_general(
+            self._as_gdt(cols), self._e_mat,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def seg_reduce(self, lanes):
+        """[n_p, seg_l] lanes -> [n_p, grp] per-segment counts."""
+        if self.grp == 1:
+            return jnp.sum(
+                self._as_gdt(lanes).astype(jnp.float32),
+                axis=1, keepdims=True,
+            )
+        return jax.lax.dot_general(
+            self._as_gdt(lanes), self._e_mat,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def _plane_bit(self, q_lanes):
+        """Presence bit of query value ``q_lanes`` (< w) at each
+        (packet, position): select the plane by q >> 5, shift by
+        q & 31."""
+        sel = self.pm_t[0]
+        for p_i in range(1, self.n_planes):
+            sel = jnp.where((q_lanes >> 5) == p_i, self.pm_t[p_i], sel)
+        return (jnp.right_shift(sel, q_lanes & 31) & 1) != 0
+
+    def group(self, gi, v2_g, clearp_g, clearl_g, count_eff_g,
+              delivered_g):
+        """One receiver group's verdicts (group ``gi`` = the ``gi``-th
+        contiguous receiver slice): returns ``(ok_g, dup_g,
+        own_len_g)``, each ``[n_p, grp]`` (``own_len_g`` int32); the
+        arguments are the group's per-receiver columns ``[n_p, grp]``
+        (post-corruption order value, clear-P/clear-L flags, effective
+        evidence count, delivery mask).
+
+        Mirrors ``consistent_after_append``'s decomposition, including
+        the round-3 ``appended`` fullness guard (reducible to ``~dup``
+        under the config invariant ``max_l >= n_rounds + 1``, kept so
+        the kernels stay on the spec if the bound is ever raised)."""
+        max_l, n_p, grp = self.max_l, self.n_p, self.grp
+        v2_lanes = self.expand(v2_g).astype(jnp.int32)
+        clearp_lanes = self.expand(clearp_g) != 0
+        p2_lanes = self.p_tile & ~clearp_lanes
+        li_row = self.lip_vals[gi : gi + 1, :]
+        li_bc = jnp.broadcast_to(li_row, (n_p, self.seg_l))
+        own_lanes = jnp.where(p2_lanes, li_bc, SENTINEL)
+
+        dup_g = jnp.zeros((n_p, grp), jnp.bool_)
+        for r in range(max_l):
+            mism = self.seg_reduce(self.vals_t[r] != own_lanes)
+            dup_g |= self.valid[r] & (mism == 0)
+        dup_g &= ~clearl_g
+        own_len_g = self.seg_reduce(p2_lanes).astype(jnp.int32)
+
+        bad_own_pos = p2_lanes & (
+            (li_bc == v2_lanes)
+            | (self.lioob_vals[gi : gi + 1, :] != 0)
+        )
+        if self.use_bitmask:
+            cont_g = self.seg_reduce(self._plane_bit(v2_lanes)) > 0
+            own_coll_g = (
+                self.seg_reduce(p2_lanes & self._plane_bit(li_bc)) > 0
+            )
+            bad_own_g = self.seg_reduce(bad_own_pos) > 0
+            cont_or_oob = ~clearl_g & (cont_g | self.oob)
+        else:
+            contains_g = jnp.zeros((n_p, grp), jnp.bool_)
+            own_coll_g = jnp.zeros((n_p, grp), jnp.bool_)
+            for r in range(max_l):
+                contains_g |= self.valid[r] & (
+                    self.seg_reduce(
+                        self.in_t_t[r] & (self.vals_t[r] == v2_lanes)
+                    )
+                    > 0
+                )
+                own_coll_g |= self.valid[r] & (
+                    self.seg_reduce(
+                        p2_lanes
+                        & self.in_t_t[r]
+                        & (self.vals_t[r] == own_lanes)
+                    )
+                    > 0
+                )
+            bad_own_g = self.seg_reduce(bad_own_pos) > 0
+            cont_or_oob = ~clearl_g & (self.oob | contains_g)
+
+        appended_g = ~dup_g & (count_eff_g < max_l)
+        cond2 = ~(cont_or_oob | (appended_g & bad_own_g))
+        new_count_g = jnp.where(appended_g, count_eff_g + 1, count_eff_g)
+        cond1 = (clearl_g | ~self.lens_bad) & (
+            ~appended_g | (count_eff_g == 0) | (own_len_g == self.len0)
+        )
+        cond3 = (clearl_g | ~self.cells_coll) & (
+            ~appended_g | ~(~clearl_g & own_coll_g)
+        )
+        ok_g = (
+            delivered_g & cond1 & cond2 & cond3
+            & (new_count_g == self.r_idx + 1)
+        )
+        return ok_g, dup_g, own_len_g
+
+
+def accept_first_per_value(ok, v2, vi_row, idx_col, n_p, w):
+    """First-candidate-per-order dedup against Vi (``tfg.py:294``) for
+    one receiver: among packets with ``ok`` carrying the same order
+    value, the lowest index wins, and values already in ``vi_row`` are
+    excluded.  Returns ``(acc [n_p, 1] bool, new_vi_row [1, w] bool)``.
+    NOT idempotent at the caller (the vi update must land exactly once
+    per receiver)."""
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (n_p, w), 1)
+    onehot = v2 == iota_w  # [n_p, w]
+    in_vi = jnp.any(onehot & (vi_row != 0), axis=1, keepdims=True)
+    cand = ok & ~in_vi
+    masked_idx = jnp.where(onehot & cand, idx_col, n_p)
+    first = jnp.min(masked_idx, axis=0, keepdims=True)  # [1, w]
+    first_b = jnp.min(
+        jnp.where(onehot, jnp.broadcast_to(first, (n_p, w)), n_p),
+        axis=1, keepdims=True,
+    )
+    acc = cand & (first_b == idx_col)
+    new_vi = (vi_row != 0) | jnp.any(acc & onehot, axis=0, keepdims=True)
+    return acc, new_vi

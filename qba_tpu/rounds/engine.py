@@ -491,24 +491,31 @@ def run_rounds_tiled(
 
 
 def resolve_round_engine(cfg: QBAConfig) -> str:
-    """``auto`` -> the fastest engine that compiles for this config:
-    the fused monolithic Pallas kernel
-    (:func:`qba_tpu.ops.round_kernel.kernel_compiles`), else the
-    packet-tiled kernel
-    (:func:`qba_tpu.ops.round_kernel_tiled.tiled_kernel_plan` — lossless
-    at scale), else pure XLA.  Both gates are cached one-time compile
-    probes behind loose VMEM pre-filters."""
+    """``auto`` -> the fastest engine that compiles for this config.
+
+    Preference order (all gates are cached one-time compile probes
+    behind loose VMEM pre-filters): at ``size_l < 256`` the fused
+    monolithic kernel (:func:`qba_tpu.ops.round_kernel.kernel_compiles`)
+    beats the tiled engine by ~5-10% (measured at the headline config,
+    docs/PERF.md), so it goes first; at wide position axes the order
+    flips — per-packet tiles are large, so the tiled engine's
+    skip-empty-blocks structure wins (~11% at the reference's
+    sizeL=1000) and is preferred when it compiles
+    (:func:`qba_tpu.ops.round_kernel_tiled.tiled_kernel_plan`).  Pure
+    XLA is the final fallback."""
     if cfg.round_engine != "auto":
         return cfg.round_engine
     if jax.default_backend() != "tpu":
         return "xla"
     from qba_tpu.ops.round_kernel import kernel_compiles
-
-    if kernel_compiles(cfg):
-        return "pallas"
     from qba_tpu.ops.round_kernel_tiled import tiled_kernel_plan
 
-    if tiled_kernel_plan(cfg) is not None:
+    wide = cfg.size_l >= 256
+    if wide and tiled_kernel_plan(cfg) is not None:
+        return "pallas_tiled"
+    if kernel_compiles(cfg):
+        return "pallas"
+    if not wide and tiled_kernel_plan(cfg) is not None:
         return "pallas_tiled"
     return "xla"
 

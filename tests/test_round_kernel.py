@@ -102,8 +102,14 @@ class TestEngineSelection:
                 max_accepts_per_round=4,
             )
         )
+        # The reference's sizeL=1000 at the lossless bound now passes
+        # the pre-filter (and compiles, with the raised vmem cap —
+        # docs/PERF.md round 3); the hopeless case is the 33-party
+        # lossless mailbox, whose whole-mailbox-in-VMEM working set is
+        # beyond physical VMEM (the tiled engine owns that config).
+        assert fits_kernel(QBAConfig(n_parties=11, size_l=1000, n_dishonest=5))
         assert not fits_kernel(
-            QBAConfig(n_parties=11, size_l=1000, n_dishonest=5)
+            QBAConfig(n_parties=33, size_l=64, n_dishonest=10)
         )
 
     @pytest.fixture
@@ -124,7 +130,7 @@ class TestEngineSelection:
             raise AssertionError("probe compiled a prefiltered config")
 
         monkeypatch.setattr(rk, "build_round_step", boom)
-        cfg = QBAConfig(n_parties=11, size_l=1000, n_dishonest=5)
+        cfg = QBAConfig(n_parties=33, size_l=64, n_dishonest=10)
         with pytest.warns(RuntimeWarning, match="pre-filter rejected"):
             assert rk.kernel_compiles(cfg) is False
 
