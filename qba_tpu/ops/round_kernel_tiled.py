@@ -80,12 +80,15 @@ def build_verdict_kernel(
     """Compile phase 1: the blocked acceptance-verdict kernel.
 
     Returns ``verdict(round_idx, vals, lens, count, p, v, sent, cell,
-    li, vi, honest_pk, attack, rand_v, late) -> (acc, vi')`` where the
-    pool operands are ``[.., n_pool, ..]`` in compacted packet order,
-    ``cell`` is each packet's mailbox cell id, the draw operands are
-    pre-gathered into pool order, and ``acc`` is the int32 ``[n_pool,
-    n_lieutenants]`` acceptance matrix.  jit/vmap-safe (vmap over trials
-    prepends the Pallas grid).
+    li, vi, honest_cells, attack, rand_v, late) -> (acc, vi')`` where
+    the pool operands are ``[.., n_pool, ..]`` in compacted packet
+    order, ``cell`` is each packet's mailbox cell id, the draw operands
+    stay **mailbox-cell-ordered** ``[n_cells, n_rv]`` (the kernel
+    selects each block's rows with a one-hot MXU matmul against the
+    cell ids — XLA-side pool-order gathers processed every pool row
+    each round; in-kernel selection is paid only by live blocks), and
+    ``acc`` is the int32 ``[n_pool, n_lieutenants]`` acceptance matrix.
+    jit/vmap-safe (vmap over trials prepends the Pallas grid).
 
     A block skips all verdict compute when its ``sent`` flags are all
     zero — the pool is compacted, so occupancy concentrates in the
@@ -147,17 +150,34 @@ def build_verdict_kernel(
         @pl.when(block_live)
         def _verdict():
             idx_col = jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
-            sender_col = cell_ref[:] // slots  # [blk, 1]
+            cell_col = cell_ref[:]  # [blk, 1]
+            sender_col = cell_col // slots  # [blk, 1]
             vals = [
                 vals_ref[r].astype(jnp.int32) for r in range(max_l)
             ]  # each [blk, size_l]
             sent = sent_ref[:] != 0  # [blk, 1]
-            biz = honest_ref[:] == 0  # [blk, 1]
+
+            # ---- Draw selection: cell-ordered -> this block's rows -------
+            # One-hot over mailbox cell ids (exact: ids < n_pool; values
+            # <= 15 / < w / 0-1 are gdt-exact), like the rebuild kernel.
+            iota_cells = jax.lax.broadcasted_iota(
+                jnp.int32, (blk, n_pool), 1
+            )
+            oh_cell = jnp.where(iota_cells == cell_col, 1.0, 0.0).astype(gdt)
+
+            def cell_mm(tbl):
+                return jax.lax.dot_general(
+                    oh_cell, tbl.astype(gdt),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            biz = cell_mm(honest_ref[:]).astype(jnp.int32) == 0  # [blk, 1]
 
             # ---- All-receiver flag algebra -------------------------------
-            act_all = act_ref[:]  # [blk, n_rv] (pool-ordered draws)
-            rv_all = rv_ref[:]
-            late_all = late_ref[:]
+            act_all = cell_mm(act_ref[:]).astype(jnp.int32)  # [blk, n_rv]
+            rv_all = cell_mm(rv_ref[:]).astype(jnp.int32)
+            late_all = cell_mm(late_ref[:]).astype(jnp.int32)
             lane_recv = jax.lax.broadcasted_iota(jnp.int32, (blk, n_rv), 1)
             dropped_all = biz & ((act_all & DROP_BIT) != 0)
             v2_all = jnp.where(biz & ((act_all & FORGE_BIT) != 0),
@@ -217,10 +237,10 @@ def build_verdict_kernel(
         pl.BlockSpec((blk, 1), blkmap),  # sent
         pl.BlockSpec((blk, 1), blkmap),  # cell
         pl.BlockSpec((n_rv, w), lambda i: (0, 0)),  # vi
-        pl.BlockSpec((blk, 1), blkmap),  # honest_pk
-        pl.BlockSpec((blk, n_rv), blkmap),  # attack
-        pl.BlockSpec((blk, n_rv), blkmap),  # rand_v
-        pl.BlockSpec((blk, n_rv), blkmap),  # late
+        pl.BlockSpec((n_pool, 1), lambda i: (0, 0)),  # honest_cells
+        pl.BlockSpec((n_pool, n_rv), lambda i: (0, 0)),  # attack (cells)
+        pl.BlockSpec((n_pool, n_rv), lambda i: (0, 0)),  # rand_v (cells)
+        pl.BlockSpec((n_pool, n_rv), lambda i: (0, 0)),  # late (cells)
         pl.BlockSpec((grp, seg_l), lambda i: (0, 0)),  # e_mat
         pl.BlockSpec((len(r0_list), seg_l), lambda i: (0, 0)),  # lip
         pl.BlockSpec((len(r0_list), seg_l), lambda i: (0, 0)),  # lioob

@@ -458,28 +458,28 @@ def run_rounds_tiled(
         vi_i32, pool = carry
         k_round = jax.random.fold_in(k_rounds, round_idx)
         attack, rand_v, late = sample_attacks_round(cfg, k_round)
-        # Draws keep their mailbox-cell identity: gather each pool
-        # entry's row so the randomness matches every other engine.
-        cell = pool[6][:, 0]
-        att_p = jnp.take(attack, cell, axis=0).astype(jnp.int32)
-        rv_p = jnp.take(rand_v, cell, axis=0).astype(jnp.int32)
-        late_p = jnp.take(late, cell, axis=0).astype(jnp.int32)
-        honest_p = jnp.take(honest_cells, cell, axis=0)
+        # Draws stay mailbox-cell-ordered — both kernels select each
+        # pool entry's row in-kernel by its cell id (one-hot MXU), so
+        # the randomness keeps its identity without XLA-side gathers.
+        att_c = attack.astype(jnp.int32)
+        rv_c = rand_v.astype(jnp.int32)
         acc, vi_i32 = verdict(
             round_idx, *pool[:6], pool[6], lieu_lists, vi_i32,
-            honest_p, att_p, rv_p, late_p,
+            honest_cells, att_c, rv_c, late.astype(jnp.int32),
         )
         if rebuild_k is not None:
             pool_new, ovf = rebuild_k(
                 round_idx, pool[0], pool[1], pool[2], pool[3], pool[4],
-                pool[6], lieu_lists, acc,
-                attack.astype(jnp.int32), rand_v.astype(jnp.int32),
-                honest_cells,
+                pool[6], lieu_lists, acc, att_c, rv_c, honest_cells,
             )
         else:
+            # The XLA fallback consumes pool-ordered draws.
+            cell = pool[6][:, 0]
             pool_new, ovf = rebuild_pool(
                 cfg, round_idx, pool, lieu_lists, acc,
-                att_p, rv_p, honest_p,
+                jnp.take(att_c, cell, axis=0),
+                jnp.take(rv_c, cell, axis=0),
+                jnp.take(honest_cells, cell, axis=0),
             )
         return (vi_i32, pool_new), ovf
 
