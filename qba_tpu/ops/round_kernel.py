@@ -508,7 +508,7 @@ def _probe_cache_path() -> str:
     )
 
 
-_PROBE_VERSION = 4  # bump when kernel structure/compiler params change
+_PROBE_VERSION = 5  # bump when kernel structure/compiler params change
 
 
 def _probe_disk_key(kernel: str, cfg: QBAConfig, extra: str = "") -> str:

@@ -808,17 +808,40 @@ def _block_estimate(cfg: QBAConfig, blk: int) -> int:
     return est
 
 
+def _preferred_block(cfg: QBAConfig) -> int:
+    """Measured sweet spot for the packet-block size: roughly the
+    typical number of LIVE pool entries per round (~2 accepts per
+    receiver), floored at a tile-friendly 32.  Block-size sweeps at the
+    33-party north star and the reference's sizeL=1000 config both
+    peaked near this value and lost 10-16% at the largest compiling
+    candidate (docs/PERF.md round 3): the per-step fixed cost is small,
+    so finer blocks skip dead pool capacity more precisely."""
+    return max(2 * cfg.n_lieutenants, 32)
+
+
+def _order_candidates(cands: list[int], preferred: int) -> list[int]:
+    import math
+
+    return sorted(
+        cands, key=lambda b: abs(math.log2(b) - math.log2(preferred))
+    )
+
+
 def block_candidates(cfg: QBAConfig) -> list[int]:
-    """Descending candidate block sizes: divisors of the pool capacity,
-    multiples of 8 where possible, within the VMEM pre-filter, capped at
-    ``_MAX_PROBE_CANDIDATES`` (each failed remote compile probe costs
-    minutes; the disk cache makes even that a one-time cost)."""
+    """Candidate block sizes: divisors of the pool capacity, multiples
+    of 8 where possible, within the VMEM pre-filter, ordered by
+    closeness to the measured sweet spot (:func:`_preferred_block`) and
+    capped at ``_MAX_PROBE_CANDIDATES`` (each failed remote compile
+    probe costs minutes; the disk cache makes even that a one-time
+    cost)."""
     n_pool = cfg.n_lieutenants * cfg.slots
     divs = [d for d in range(n_pool, 0, -1) if n_pool % d == 0]
     cands = [d for d in divs if d % 8 == 0] or divs
     ok = [b for b in cands if _block_estimate(cfg, b)
           <= _TILED_PREFILTER_BYTES]
-    return ok[:_MAX_PROBE_CANDIDATES]
+    return _order_candidates(ok, _preferred_block(cfg))[
+        :_MAX_PROBE_CANDIDATES
+    ]
 
 
 def _rebuild_estimate(cfg: QBAConfig, blk_d: int) -> int:
@@ -850,12 +873,16 @@ _REBUILD_BUDGET = 24 * 2**20
 
 
 def rebuild_candidates(cfg: QBAConfig) -> list[int]:
-    """Candidate destination block sizes for the rebuild kernel."""
+    """Candidate destination block sizes for the rebuild kernel — same
+    sweet-spot ordering as :func:`block_candidates` (dead destination
+    blocks skip like dead packet blocks)."""
     n_pool = cfg.n_lieutenants * cfg.slots
     divs = [d for d in range(n_pool, 0, -1) if n_pool % d == 0]
     cands = [d for d in divs if d % 8 == 0] or divs
     ok = [b for b in cands if _rebuild_estimate(cfg, b) <= _REBUILD_BUDGET]
-    return ok[:_MAX_PROBE_CANDIDATES]
+    return _order_candidates(ok, _preferred_block(cfg))[
+        :_MAX_PROBE_CANDIDATES
+    ]
 
 
 _TILED_PROBE_CACHE: dict[tuple, int | None] = {}
