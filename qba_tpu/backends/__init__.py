@@ -21,7 +21,24 @@ The reference's backend boundary is the MPI rank: one OS process per party
   imported lazily).  Fourth corner of the differential.
 """
 
-from qba_tpu.backends.jax_backend import MonteCarloResult, run_trials
-from qba_tpu.backends.local_backend import run_trial_local
+# Lazy exports: the mp backend's party processes import
+# qba_tpu.backends.mp_party (jax-free) through this package; an eager
+# jax_backend import here cost every spawned party ~2-3 s of jax import
+# it never uses (33 parties = a minute of pure spawn overhead).
+_EXPORTS = {
+    "MonteCarloResult": ("qba_tpu.backends.jax_backend", "MonteCarloResult"),
+    "run_trials": ("qba_tpu.backends.jax_backend", "run_trials"),
+    "run_trial_local": ("qba_tpu.backends.local_backend", "run_trial_local"),
+    "run_trial_mp": ("qba_tpu.backends.mp_backend", "run_trial_mp"),
+}
 
-__all__ = ["MonteCarloResult", "run_trials", "run_trial_local"]
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        module, attr = _EXPORTS[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

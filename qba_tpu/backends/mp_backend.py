@@ -99,6 +99,13 @@ def run_trial_mp(
 
     with tempfile.TemporaryDirectory(prefix="qba_mp_") as sock_dir:
         procs, pipes = [], {}
+        # Party processes receive sys.path through the spawn preparation
+        # data, so PYTHONPATH is cleared for the spawn window: it only
+        # serves to inject sitecustomize hooks at interpreter start (the
+        # dev box's remote-TPU plugin costs ~2 s per child — a minute of
+        # pure overhead at 33 parties), none of which the jax-free party
+        # code uses.
+        saved_pp = os.environ.pop("PYTHONPATH", None)
         try:
             for rank in range(1, cfg.n_parties + 1):
                 parent_conn, child_conn = ctx.Pipe(duplex=False)
@@ -128,6 +135,9 @@ def run_trial_mp(
                 procs.append(p)
                 pipes[rank] = parent_conn
 
+            if saved_pp is not None:
+                os.environ["PYTHONPATH"] = saved_pp
+                saved_pp = None
             results = {}
             for rank, conn in pipes.items():
                 status, payload = conn.recv()
@@ -137,6 +147,8 @@ def run_trial_mp(
                     )
                 results[rank] = payload
         finally:
+            if saved_pp is not None:
+                os.environ["PYTHONPATH"] = saved_pp
             for p in procs:
                 p.join(timeout=30)
                 if p.is_alive():  # pragma: no cover - hang safety
