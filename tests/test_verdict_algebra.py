@@ -1,0 +1,180 @@
+"""The shared kernel flag algebra vs the executable spec.
+
+:mod:`qba_tpu.ops.verdict_algebra` is the one implementation of the
+batched acceptance verdict both Pallas kernels trace
+(``lieu_receive``'s consistency check, ``tfg.py:289-300``).  It is plain
+``jax.numpy``, so beyond the kernel equivalence suites it can be pinned
+*directly* against the single-packet executable spec
+:func:`qba_tpu.core.consistent.consistent_after_append` on randomized
+evidence — including adversarial states (cleared rows, out-of-range
+values, duplicate rows) the protocol reaches only rarely.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.core.consistent import consistent_after_append
+from qba_tpu.core.types import SENTINEL, Evidence
+from qba_tpu.ops.round_kernel import _lane_group
+from qba_tpu.ops.verdict_algebra import (
+    VerdictAlgebra,
+    accept_first_per_value,
+)
+
+
+def _random_state(rng, cfg, n_p):
+    """Random packet pool + per-receiver flags, protocol-plausible but
+    adversarially noisy (SENTINEL patterns, cleared rows, stray values
+    at and beyond w)."""
+    max_l, s, w = cfg.max_l, cfg.size_l, cfg.w
+    n_rv = cfg.n_lieutenants
+    li = rng.integers(0, w, size=(n_rv, s)).astype(np.int32)
+    vals = np.full((max_l, n_p, s), SENTINEL, np.int32)
+    lens = np.zeros((n_p, max_l), np.int32)
+    count = rng.integers(0, max_l + 1, size=(n_p, 1)).astype(np.int32)
+    p = (rng.random((n_p, s)) < 0.5).astype(np.int32)
+    for pk in range(n_p):
+        row_mask = rng.random(s) < 0.5
+        for r in range(int(count[pk, 0])):
+            # Mostly share the packet's P-shaped support; sometimes not.
+            mask = row_mask if rng.random() < 0.7 else rng.random(s) < 0.5
+            row = rng.integers(0, w + 2, size=s) - (rng.random(s) < 0.05)
+            vals[r, pk, mask] = row[mask].astype(np.int32)
+            lens[pk, r] = int(mask.sum()) if rng.random() < 0.8 else int(
+                rng.integers(0, s + 1)
+            )
+    v = rng.integers(0, w, size=(n_p, 1)).astype(np.int32)
+    v2 = np.where(
+        rng.random((n_p, n_rv)) < 0.3,
+        rng.integers(0, cfg.n_parties + 1, size=(n_p, n_rv)),
+        v,
+    ).astype(np.int32)
+    clearp = (rng.random((n_p, n_rv)) < 0.2)
+    clearl = (rng.random((n_p, n_rv)) < 0.2)
+    delivered = (rng.random((n_p, n_rv)) < 0.8)
+    return li, vals, lens, count, p, v2, clearp, clearl, delivered
+
+
+def _spec_ok(cfg, li, vals, lens, count, p, v2, clearp, clearl,
+             delivered, r_idx):
+    """Reference verdict per (packet, receiver) via the single-packet
+    spec: corruption applied to the evidence/P first, then
+    consistent_after_append + the evidence-length acceptance rule."""
+    n_p = vals.shape[1]
+    n_rv = cfg.n_lieutenants
+    out = np.zeros((n_p, n_rv), bool)
+    for pk in range(n_p):
+        for rv in range(n_rv):
+            if clearl[pk, rv]:
+                ev = Evidence(
+                    vals=jnp.full((cfg.max_l, cfg.size_l), SENTINEL,
+                                  jnp.int32),
+                    lens=jnp.zeros((cfg.max_l,), jnp.int32),
+                    count=jnp.asarray(0),
+                )
+            else:
+                ev = Evidence(
+                    vals=jnp.asarray(vals[:, pk]),
+                    lens=jnp.asarray(lens[pk]),
+                    count=jnp.asarray(count[pk, 0]),
+                )
+            p_mask = jnp.asarray(
+                (p[pk] != 0) & (not clearp[pk, rv])
+            )
+            okc, new_count = consistent_after_append(
+                jnp.asarray(v2[pk, rv]), ev, p_mask,
+                jnp.asarray(li[rv]), cfg.w,
+            )
+            out[pk, rv] = bool(
+                delivered[pk, rv]
+                and bool(okc)
+                and int(new_count) == r_idx + 1
+            )
+    return out
+
+
+@pytest.mark.parametrize(
+    "cfg,n_p",
+    [
+        (QBAConfig(n_parties=5, size_l=16, n_dishonest=2), 12),
+        (QBAConfig(n_parties=4, size_l=48, n_dishonest=1), 8),
+        # two presence planes (w = 64)
+        (QBAConfig(n_parties=33, size_l=8, n_dishonest=1), 6),
+    ],
+    ids=("w8", "w4-tail-group", "w64-two-planes"),
+)
+def test_group_verdict_matches_spec(cfg, n_p):
+    rng = np.random.default_rng(7)
+    n_rv, s, w = cfg.n_lieutenants, cfg.size_l, cfg.w
+    grp = _lane_group(s, n_rv)
+    seg_l = grp * s
+    r0_list = list(range(0, n_rv - grp + 1, grp))
+    if n_rv % grp:
+        r0_list.append(n_rv - grp)
+    e = np.zeros((grp, seg_l), np.float32)
+    for j in range(grp):
+        e[j, j * s : (j + 1) * s] = 1.0
+
+    for r_idx in (1, 2):
+        (li, vals, lens, count, p, v2, clearp, clearl,
+         delivered) = _random_state(rng, cfg, n_p)
+        lip = np.stack([li[r0 : r0 + grp].reshape(-1) for r0 in r0_list])
+        lioob = ((lip > w) | (lip < 0)).astype(np.int32)
+        count_eff = np.where(clearl, 0, count)
+
+        va = VerdictAlgebra(
+            n_p=n_p, grp=grp, seg_l=seg_l, max_l=cfg.max_l, size_l=s,
+            w=w, gdt=jnp.float32,
+            vals=[jnp.asarray(vals[r]) for r in range(cfg.max_l)],
+            lens=jnp.asarray(lens), count=jnp.asarray(count),
+            p_i32=jnp.asarray(p), e_vals=jnp.asarray(e),
+            lip_vals=jnp.asarray(lip), lioob_vals=jnp.asarray(lioob),
+            r_idx=jnp.asarray(r_idx),
+        )
+        got = np.zeros((n_p, n_rv), bool)
+        seen = set()
+        for gi, r0 in enumerate(r0_list):
+            sl = slice(r0, r0 + grp)
+            ok_g, _, _ = va.group(
+                gi, jnp.asarray(v2[:, sl]), jnp.asarray(clearp[:, sl]),
+                jnp.asarray(clearl[:, sl]),
+                jnp.asarray(count_eff[:, sl]),
+                jnp.asarray(delivered[:, sl]),
+            )
+            for j in range(grp):
+                if r0 + j not in seen:
+                    seen.add(r0 + j)
+                    got[:, r0 + j] = np.asarray(ok_g[:, j])
+
+        want = _spec_ok(cfg, li, vals, lens, count, p, v2, clearp,
+                        clearl, delivered, r_idx)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_accept_first_per_value_semantics():
+    # Sequential reference: walk packets in order, accept the first ok
+    # candidate per order value not already in Vi (tfg.py:294).
+    rng = np.random.default_rng(3)
+    n_p, w = 24, 8
+    for _ in range(20):
+        ok = rng.random(n_p) < 0.5
+        v2 = rng.integers(0, w, size=n_p)
+        vi0 = rng.random(w) < 0.3
+        want_acc = np.zeros(n_p, bool)
+        vi_seq = vi0.copy()
+        for i in range(n_p):
+            if ok[i] and not vi_seq[v2[i]]:
+                want_acc[i] = True
+                vi_seq[v2[i]] = True
+        acc, new_vi = accept_first_per_value(
+            jnp.asarray(ok[:, None]), jnp.asarray(v2[:, None]),
+            jnp.asarray(vi0[None, :].astype(np.int32)),
+            jnp.arange(n_p)[:, None], n_p, w,
+        )
+        np.testing.assert_array_equal(np.asarray(acc[:, 0]), want_acc)
+        np.testing.assert_array_equal(
+            np.asarray(new_vi[0]) != 0, vi_seq
+        )
