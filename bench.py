@@ -8,6 +8,14 @@ on host CPU — the in-repo stand-in for the reference's ``mpiexec`` run
 (the reference itself publishes no numbers and needs MPI + qsimov,
 neither available here; BASELINE.md).
 
+The single JSON line is variance-aware: it carries every rep's wall time
+(``rep_seconds``) plus the median-derived value next to the best-of
+headline, so round-over-round drift can be distinguished from the
+documented 10-15% tunnel noise without cross-referencing docs/PERF.md.
+It also embeds the north-star gate metric (BASELINE.md config 5:
+nParties=33, sizeL=64, nDishonest=10, 1000 trials, lossless) under
+``northstar`` — both gate metrics land in ``BENCH_r*.json`` each round.
+
 Usage: ``python bench.py`` (env ``QBA_BENCH_QUICK=1`` for a small dev run).
 """
 
@@ -15,29 +23,19 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
-import time
 
 
-def _measure_jax(cfg, reps: int = 5) -> float:
-    """Best wall-clock seconds for one full Monte-Carlo batch.
+def _measure_jax(cfg, reps: int, chunk_trials: int | None = None):
+    """Per-rep wall seconds + actual trial count for one Monte-Carlo
+    batch — the shared chunk/key/fence recipe
+    (:func:`qba_tpu.benchmark.measure_batch`; fresh keys per rep defeat
+    the tunnel's result cache, chunking respects the HBM ceiling)."""
+    from qba_tpu.benchmark import measure_batch
 
-    Each rep uses fresh trial keys so a result-caching backend (the axon
-    tunnel dedupes identical computations) cannot fake a 0-second run.
-    """
-    import jax
-
-    from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
-
-    fence(run_trials(cfg, trial_keys(cfg)))  # compile
-    best = float("inf")
-    for r in range(reps):
-        keys = jax.random.split(jax.random.key(cfg.seed + 1 + r), cfg.trials)
-        fence(keys)  # key generation off the clock
-        t0 = time.perf_counter()
-        fence(run_trials(cfg, keys))
-        best = min(best, time.perf_counter() - t0)
-    return best
+    times, n_run, _results = measure_batch(cfg, reps, chunk_trials)
+    return times, n_run
 
 
 def _measure_local(cfg, n_trials: int) -> float:
@@ -77,6 +75,19 @@ print((time.perf_counter() - t0) / {n_trials})
     return float(proc.stdout.strip().splitlines()[-1])
 
 
+def _rps_stats(cfg, times: list[float], n_run: int) -> dict:
+    """Best/median rounds-per-second view of one rep series."""
+    total_rounds = n_run * cfg.n_rounds
+    best = min(times)
+    med = statistics.median(times)
+    return {
+        "value": round(total_rounds / best, 2),
+        "median_value": round(total_rounds / med, 2),
+        "reps": len(times),
+        "rep_seconds": [round(t, 4) for t in times],
+    }
+
+
 def main() -> None:
     from qba_tpu.compile_cache import enable_compile_cache
     from qba_tpu.config import QBAConfig
@@ -91,21 +102,25 @@ def main() -> None:
         trials=64 if quick else 1000,
         seed=0,
     )
-    rounds_per_trial = cfg.n_rounds
 
     # 8 reps: the remote-tunnel result fetch has ~30 ms of run-to-run
     # jitter on top of a ~60 ms floor (and the floor itself drifts by
     # tens of ms over minutes on the shared tunnel), so extra full-work
-    # reps make
-    # the best-of estimate much less noisy.
-    dt = _measure_jax(cfg, reps=2 if quick else 8)
-    rps = cfg.trials * rounds_per_trial / dt
-    print(f"jax: {cfg.trials} trials in {dt:.3f}s -> {rps:.1f} rounds/s", file=sys.stderr)
+    # reps make the best-of estimate much less noisy — and the full rep
+    # series now lands in the JSON so the artifact shows the spread.
+    times, n_run = _measure_jax(cfg, reps=2 if quick else 8)
+    stats = _rps_stats(cfg, times, n_run)
+    rps = stats["value"]
+    print(
+        f"jax: {cfg.trials} trials best {min(times):.3f}s -> {rps:.1f} "
+        f"rounds/s (median {stats['median_value']:.1f})",
+        file=sys.stderr,
+    )
 
     baseline_trials = 2 if quick else 4
     try:
         per_trial = _measure_local(cfg, baseline_trials)
-        baseline_rps = rounds_per_trial / per_trial
+        baseline_rps = cfg.n_rounds / per_trial
         print(
             f"local baseline: {per_trial:.3f}s/trial -> {baseline_rps:.2f} rounds/s",
             file=sys.stderr,
@@ -114,11 +129,46 @@ def main() -> None:
         print(f"baseline measurement failed: {e!r}", file=sys.stderr)
         baseline_rps = None
 
+    # North-star gate metric (BASELINE.md config 5, lossless) — skipped
+    # in quick mode: off-TPU the 33-party config runs the XLA engine at
+    # CPU speed, minutes of pure wait in a dev loop.
+    import jax
+
+    northstar = None
+    if not quick and jax.default_backend() == "tpu":
+        from qba_tpu.benchmark import NORTHSTAR, NORTHSTAR_CHUNK
+
+        ns_cfg = QBAConfig(**NORTHSTAR, seed=0)
+        try:
+            from qba_tpu.rounds.engine import resolve_round_engine
+
+            ns_times, ns_run = _measure_jax(
+                ns_cfg, reps=4, chunk_trials=NORTHSTAR_CHUNK
+            )
+            northstar = dict(
+                _rps_stats(ns_cfg, ns_times, ns_run),
+                metric="northstar_rounds_per_sec_n33_l64_d10_t1000",
+                engine=resolve_round_engine(ns_cfg),
+                chunk_trials=NORTHSTAR_CHUNK,
+            )
+            print(
+                f"northstar: best -> {northstar['value']:.1f} rounds/s "
+                f"({northstar['engine']})",
+                file=sys.stderr,
+            )
+        except Exception as e:  # headline metric must still flow
+            print(f"northstar measurement failed: {e!r}", file=sys.stderr)
+            northstar = {"error": repr(e)[:300]}
+
     out = {
         "metric": f"protocol_rounds_per_sec_n11_l64_t{cfg.trials}",
-        "value": round(rps, 2),
+        "value": rps,
         "unit": "rounds/s",
         "vs_baseline": round(rps / baseline_rps, 2) if baseline_rps else None,
+        "median_value": stats["median_value"],
+        "reps": stats["reps"],
+        "rep_seconds": stats["rep_seconds"],
+        "northstar": northstar,
     }
     print(json.dumps(out))
 

@@ -79,6 +79,14 @@ def fence(res):
     so timings "measure" only the enqueue (observed: identical sub-ms
     times for any batch size).  Fetching one result to the host is the
     only reliable barrier.  Returns ``res`` unchanged.
+
+    The LAST leaf is fetched so chunked dispatch fences correctly: for a
+    list of per-chunk results the last leaf belongs to the last-enqueued
+    computation, and the device executes enqueued programs in order, so
+    its readback implies every earlier chunk finished (fetching the
+    first leaf would stop the clock after chunk 0 with the rest still in
+    flight).  Within one computation any leaf is equivalent — outputs
+    materialize together at program completion.
     """
-    jax.device_get(jax.tree.leaves(res)[0])
+    jax.device_get(jax.tree.leaves(res)[-1])
     return res

@@ -28,8 +28,11 @@ the standard ``if __name__ == "__main__":`` guard.
 from __future__ import annotations
 
 import multiprocessing as mp
+import multiprocessing.connection as mp_conn
 import os
 import tempfile
+import threading
+import time
 from typing import TYPE_CHECKING
 
 import jax
@@ -56,14 +59,104 @@ def _native_so_path() -> str:
     return native._SO
 
 
+# The spawn window below mutates process-global os.environ (PYTHONPATH is
+# popped so children skip sitecustomize hooks); serialize concurrent
+# run_trial_mp callers so an interleaved second call cannot observe or
+# clobber the saved value.
+_SPAWN_ENV_LOCK = threading.Lock()
+
+
+def _recv_deadline(conn, remaining: float):
+    """``conn.recv()`` with a hard deadline.  ``Connection.recv`` has no
+    timeout and ``poll`` only reports readability — a party wedged
+    mid-send (partial multi-chunk payload written, then stuck) would
+    make a bare ``recv`` block forever.  The recv runs in a daemon
+    thread; on timeout the thread is abandoned (it dies with the
+    process) and the caller raises."""
+    out: dict = {}
+
+    def _r():
+        try:
+            out["value"] = conn.recv()
+        except BaseException as e:  # pragma: no cover - re-raised below
+            out["error"] = e
+
+    t = threading.Thread(target=_r, daemon=True)
+    t.start()
+    t.join(max(0.0, remaining))
+    if t.is_alive():
+        raise RuntimeError("party wedged mid-report (recv deadline)")
+    if "error" in out:
+        raise out["error"]
+    return out["value"]
+
+
+def _collect_results(procs, pipes, timeout: float) -> dict:
+    """Drain every party's report pipe without ever blocking
+    indefinitely: waits on the pipes AND the process sentinels with a
+    shared deadline, so a party that dies without writing its pipe (hard
+    kill, native-codec crash) — or wedges mid-send — raises instead of
+    hanging the trial."""
+    deadline = time.monotonic() + timeout
+    pending = set(pipes)  # ranks still owing a report
+    results = {}
+    while pending:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RuntimeError(
+                f"mp trial timed out after {timeout:.0f}s; ranks still "
+                f"pending: {sorted(pending)}"
+            )
+        conns = {pipes[r]: r for r in pending}
+        sentinels = {procs[r - 1].sentinel: r for r in pending}
+        ready = mp_conn.wait(
+            list(conns) + list(sentinels), timeout=remaining
+        )
+        for obj in ready:
+            rank = conns.get(obj)
+            if rank is None:  # a sentinel: the party process exited
+                rank = sentinels[obj]
+                if rank not in pending:
+                    continue  # its report arrived in this same batch
+                # Exit is fine iff the report was already written.
+                if not pipes[rank].poll(0.1):
+                    procs[rank - 1].join(timeout=1)  # reap -> exitcode
+                    raise RuntimeError(
+                        f"mp party rank {rank} exited (code "
+                        f"{procs[rank - 1].exitcode}) without reporting"
+                    )
+            if rank not in pending:
+                continue
+            try:
+                status, payload = _recv_deadline(
+                    pipes[rank], deadline - time.monotonic()
+                )
+            except EOFError:
+                procs[rank - 1].join(timeout=1)  # reap -> exitcode
+                raise RuntimeError(
+                    f"mp party rank {rank} closed its pipe without "
+                    f"reporting (exit code {procs[rank - 1].exitcode})"
+                ) from None
+            if status != "ok":
+                raise RuntimeError(f"mp party rank {rank} failed: {payload}")
+            results[rank] = payload
+            pending.discard(rank)
+    return results
+
+
 def run_trial_mp(
     cfg: QBAConfig,
     key: jax.Array,
     log: "EventLog | None" = None,
     trial: int = 0,
+    timeout: float = 300.0,
 ) -> dict:
     """One protocol execution across real OS processes; returns the
-    rank-0 summary dict (same shape as ``run_trial_local``)."""
+    rank-0 summary dict (same shape as ``run_trial_local``).
+
+    ``timeout`` bounds the whole collection phase: a party process that
+    dies without reporting (or a wedged mesh) raises a ``RuntimeError``
+    instead of blocking forever (see :func:`_collect_results`)."""
     honest, lists, v_sent, v_comm, k_rounds = presample_trial(cfg, key)
     w = cfg.w
     # Per-round effective draws, identical arrays to every other engine.
@@ -99,60 +192,61 @@ def run_trial_mp(
 
     with tempfile.TemporaryDirectory(prefix="qba_mp_") as sock_dir:
         procs, pipes = [], {}
-        # Party processes receive sys.path through the spawn preparation
-        # data, so PYTHONPATH is cleared for the spawn window: it only
-        # serves to inject sitecustomize hooks at interpreter start (the
-        # dev box's remote-TPU plugin costs ~2 s per child — a minute of
-        # pure overhead at 33 parties), none of which the jax-free party
-        # code uses.
-        saved_pp = os.environ.pop("PYTHONPATH", None)
         try:
-            for rank in range(1, cfg.n_parties + 1):
-                parent_conn, child_conn = ctx.Pipe(duplex=False)
-                if rank == 1:
-                    params = dict(
-                        common,
-                        list0=[int(x) for x in lists[0]],
-                        list1=[int(x) for x in lists[1]],
-                        v_sent=v_sent,
-                    )
-                    target = mp_party.commander_main
-                else:
-                    params = dict(
-                        common,
-                        honest=tuple(bool(h) for h in honest),
-                        list=[int(x) for x in lists[rank]],
-                        attacks=attacks[:, :, rank - 2, :],
-                    )
-                    target = mp_party.lieutenant_main
-                p = ctx.Process(
-                    target=target,
-                    args=(rank, sock_dir, so_path, child_conn, params),
-                    daemon=True,
-                )
-                p.start()
-                child_conn.close()
-                procs.append(p)
-                pipes[rank] = parent_conn
+            # Party processes receive sys.path through the spawn
+            # preparation data, so PYTHONPATH is cleared for the spawn
+            # window: it only serves to inject sitecustomize hooks at
+            # interpreter start (the dev box's remote-TPU plugin costs
+            # ~2 s per child — a minute of pure overhead at 33
+            # parties), none of which the jax-free party code uses.
+            # The lock serializes the process-global env mutation.
+            with _SPAWN_ENV_LOCK:
+                saved_pp = os.environ.pop("PYTHONPATH", None)
+                try:
+                    for rank in range(1, cfg.n_parties + 1):
+                        parent_conn, child_conn = ctx.Pipe(duplex=False)
+                        if rank == 1:
+                            params = dict(
+                                common,
+                                list0=[int(x) for x in lists[0]],
+                                list1=[int(x) for x in lists[1]],
+                                v_sent=v_sent,
+                            )
+                            target = mp_party.commander_main
+                        else:
+                            params = dict(
+                                common,
+                                honest=tuple(bool(h) for h in honest),
+                                list=[int(x) for x in lists[rank]],
+                                attacks=attacks[:, :, rank - 2, :],
+                            )
+                            target = mp_party.lieutenant_main
+                        p = ctx.Process(
+                            target=target,
+                            args=(rank, sock_dir, so_path, child_conn, params),
+                            daemon=True,
+                        )
+                        p.start()
+                        child_conn.close()
+                        procs.append(p)
+                        pipes[rank] = parent_conn
+                finally:
+                    if saved_pp is not None:
+                        os.environ["PYTHONPATH"] = saved_pp
 
-            if saved_pp is not None:
-                os.environ["PYTHONPATH"] = saved_pp
-                saved_pp = None
-            results = {}
-            for rank, conn in pipes.items():
-                status, payload = conn.recv()
-                if status != "ok":
-                    raise RuntimeError(
-                        f"mp party rank {rank} failed: {payload}"
-                    )
-                results[rank] = payload
+            results = _collect_results(procs, pipes, timeout)
         finally:
-            if saved_pp is not None:
-                os.environ["PYTHONPATH"] = saved_pp
+            # Bounded cleanup: 30 s TOTAL for graceful exits (not per
+            # process — a wedged 33-party mesh must not stack another
+            # n_parties * 30 s of joins on top of the collection
+            # timeout), then terminate whatever is left.
+            stop = time.monotonic() + 30
             for p in procs:
-                p.join(timeout=30)
+                p.join(timeout=max(0.0, stop - time.monotonic()))
+            for p in procs:
                 if p.is_alive():  # pragma: no cover - hang safety
                     p.terminate()
+                    p.join(timeout=5)
 
     decisions = [v_comm] + [
         results[r]["decision"] for r in range(2, cfg.n_parties + 1)

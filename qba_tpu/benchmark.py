@@ -1,0 +1,75 @@
+"""Shared Monte-Carlo measurement harness.
+
+Used by both benchmark surfaces — ``python -m qba_tpu bench`` (the CLI)
+and the repo-root ``bench.py`` gate script — so the chunk-split /
+key-split / fence-at-end timing recipe exists exactly once.  The recipe
+matters: on remote-tunnel backends only a host readback is a fence
+(:func:`qba_tpu.backends.jax_backend.fence`), keys are regenerated per
+rep so a result-caching backend cannot fake a 0-second run, and chunked
+dispatch both respects the HBM ceiling of large configs and pipelines
+better (docs/PERF.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from qba_tpu.config import QBAConfig
+
+# BASELINE.md config 5 as written (the "north star": nParties=33,
+# sizeL=64, nDishonest=10, lossless), 1000 trials — THE shared literal
+# for both gate surfaces (cli `--preset northstar` and bench.py's
+# embedded gate metric).  250-trial chunks: the 33-party lossless pool
+# exceeds HBM in one batch (docs/PERF.md), and measured throughput is
+# flat across 125/250/500 chunks (~6.2k rounds/s, honest fence).
+NORTHSTAR = dict(n_parties=33, size_l=64, n_dishonest=10, trials=1000)
+NORTHSTAR_CHUNK = 250
+
+
+def measure_batch(
+    cfg: QBAConfig,
+    reps: int,
+    chunk_trials: int | None = None,
+    *,
+    warmup: bool = True,
+):
+    """Time ``reps`` full Monte-Carlo batches of ``cfg.trials`` trials.
+
+    ``chunk_trials`` splits each batch into sequential same-shape chunks
+    (one compiled program); a partial final chunk rounds UP — the actual
+    trial count is returned, and throughput must be computed against it.
+
+    Returns ``(rep_seconds, n_run, results)``: per-rep wall times, the
+    actual trials per rep, and the last rep's list of per-chunk
+    :class:`~qba_tpu.backends.jax_backend.MonteCarloResult`.
+
+    ``warmup=False`` skips the untimed compile/warmup batch — for
+    callers that already warmed the jit cache and must keep the extra
+    execution out of a profiler trace (see cli ``--profile-dir``).
+    """
+    import jax
+
+    from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
+
+    if reps < 1:
+        raise ValueError("reps must be >= 1")
+    chunk = chunk_trials or cfg.trials
+    n_chunks = -(-cfg.trials // chunk)
+    cfg_chunk = dataclasses.replace(cfg, trials=chunk)
+    if warmup:
+        fence(run_trials(cfg_chunk, trial_keys(cfg_chunk)))  # compile
+    times, results = [], None
+    for rep in range(reps):
+        keys = jax.random.split(
+            jax.random.key(cfg.seed + 1 + rep), n_chunks * chunk
+        )
+        fence(keys)  # key generation off the clock
+        t0 = time.perf_counter()
+        results = [
+            run_trials(cfg_chunk, keys[i * chunk : (i + 1) * chunk])
+            for i in range(n_chunks)
+        ]
+        fence(results)  # last leaf = last chunk -> all chunks done
+        times.append(time.perf_counter() - t0)
+    return times, n_chunks * chunk, results

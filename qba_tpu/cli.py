@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 from typing import Sequence
 
 from qba_tpu.config import QBAConfig
@@ -52,8 +51,9 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
         "--round-engine",
         choices=("auto", "xla", "pallas", "pallas_tiled"), default="auto",
         help="voting-round engine: auto = the fastest engine that "
-        "compiles for this config (fused Pallas kernel, else the "
-        "packet-tiled kernel, else pure XLA); all engines are "
+        "compiles for this config (packet-tiled kernel first at "
+        "size_l >= 256, fused monolithic kernel first below that, "
+        "pure XLA as the final fallback); all engines are "
         "bit-identical",
     )
     p.add_argument(
@@ -307,11 +307,11 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
 def _cmd_bench(args: argparse.Namespace, out) -> int:
     import dataclasses
     import json
+    import statistics
 
-    import jax
     import jax.numpy as jnp
 
-    from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
+    from qba_tpu.benchmark import NORTHSTAR, NORTHSTAR_CHUNK, measure_batch
     from qba_tpu.obs import profile_trace, throughput
     from qba_tpu.rounds.engine import resolve_round_engine
 
@@ -320,37 +320,24 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
     cfg = _config(args)
     chunk_trials = args.chunk_trials
     if args.preset == "northstar":
-        # BASELINE.md config 5 as written (1000 trials).  256-trial
-        # chunks: the 33-party lossless pool exceeds HBM in one batch
-        # (docs/PERF.md), and smaller batches measured faster anyway.
-        cfg = dataclasses.replace(
-            cfg, n_parties=33, size_l=64, n_dishonest=10, trials=1000
+        # The shared gate literals (qba_tpu.benchmark.NORTHSTAR).
+        cfg = dataclasses.replace(cfg, **NORTHSTAR)
+        chunk_trials = chunk_trials or NORTHSTAR_CHUNK
+    if args.profile_dir:
+        # Compile + steady-state warmup OUTSIDE the trace so the
+        # profile holds only the timed reps.  Shifted seed: the warmup
+        # rep must not reuse the traced run's rep-0 keys, or the
+        # tunnel's result cache serves that rep in ~0 s (the same
+        # dedupe the per-rep fresh keys exist to defeat).
+        measure_batch(
+            dataclasses.replace(cfg, seed=cfg.seed + 10_000),
+            1, chunk_trials,
         )
-        chunk_trials = chunk_trials or 250
-    chunk_trials = chunk_trials or cfg.trials
-    n_chunks = -(-cfg.trials // chunk_trials)
-    cfg_chunk = dataclasses.replace(cfg, trials=chunk_trials)
-    fence(run_trials(cfg_chunk, trial_keys(cfg_chunk)))  # compile
-    best = float("inf")
-    results = None
     with profile_trace(args.profile_dir):
-        for rep in range(args.reps):
-            keys = jax.random.split(
-                jax.random.key(cfg.seed + 1 + rep),
-                n_chunks * chunk_trials,
-            )
-            fence(keys)  # key generation off the clock
-            t0 = time.perf_counter()
-            results = [
-                run_trials(
-                    cfg_chunk,
-                    keys[i * chunk_trials : (i + 1) * chunk_trials],
-                )
-                for i in range(n_chunks)
-            ]
-            fence(results)
-            best = min(best, time.perf_counter() - t0)
-    n_run = n_chunks * chunk_trials
+        rep_seconds, n_run, results = measure_batch(
+            cfg, args.reps, chunk_trials, warmup=not args.profile_dir
+        )
+    best = min(rep_seconds)
     th = throughput(cfg, n_run, best)
     overflow = float(
         jnp.mean(
@@ -374,7 +361,9 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
                 "unit": "rounds/s",
                 "trials_per_sec": round(th["trials_per_sec"], 2),
                 "best_s": round(best, 4),
-                "engine": resolve_round_engine(cfg_chunk),
+                "median_s": round(statistics.median(rep_seconds), 4),
+                "rep_seconds": [round(t, 4) for t in rep_seconds],
+                "engine": resolve_round_engine(cfg),
                 "overflow_rate": round(overflow, 4),
                 "success_rate": round(success, 4),
                 "config": {
@@ -382,7 +371,7 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
                     "size_l": cfg.size_l,
                     "n_dishonest": cfg.n_dishonest,
                     "trials": n_run,
-                    "chunk_trials": chunk_trials,
+                    "chunk_trials": chunk_trials or cfg.trials,
                 },
             }
         ),

@@ -41,11 +41,16 @@ class QBAConfig:
         (``v not in Vi``, ``tfg.py:294``), so ``w`` is a universal bound;
         smaller values trade memory for a recorded overflow flag.
       round_engine: "auto" (default — the fastest engine that compiles
-        for this config: the fused monolithic Pallas round kernel, else
-        the packet-tiled kernel, else pure XLA), "xla", "pallas"
-        (forces the monolithic kernel; interpreter mode off-TPU), or
-        "pallas_tiled" (forces the tiled engine — lossless at scales
-        the monolithic kernel cannot compile,
+        for this config; the preference order depends on the position
+        axis: at ``size_l >= 256`` the packet-tiled kernel goes first
+        (its skip-empty-blocks structure wins on wide lists, ~11% at
+        the reference's sizeL=1000), below that the fused monolithic
+        Pallas round kernel goes first (~5-10% faster at the headline
+        config); pure XLA is always the final fallback — see
+        :func:`qba_tpu.rounds.engine.resolve_round_engine`), "xla",
+        "pallas" (forces the monolithic kernel; interpreter mode
+        off-TPU), or "pallas_tiled" (forces the tiled engine —
+        lossless at scales the monolithic kernel cannot compile,
         :mod:`qba_tpu.ops.round_kernel_tiled`).  All engines are
         bit-identical (tests/test_round_kernel.py,
         tests/test_round_kernel_tiled.py).

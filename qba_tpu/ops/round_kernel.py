@@ -529,22 +529,41 @@ def _probe_disk_get(key: str):
         return None
 
 
+try:  # POSIX file locking for the probe cache; absent -> lock-free write
+    import fcntl as _fcntl
+except ImportError:  # pragma: no cover - non-POSIX host
+    _fcntl = None
+
+
 def _probe_disk_put(key: str, value) -> None:
     if jax.default_backend() != "tpu":
         return
     path = _probe_cache_path()
     try:
         _os.makedirs(_os.path.dirname(path), exist_ok=True)
-        try:
-            with open(path) as f:
-                data = _json.load(f)
-        except Exception:
-            data = {}
-        data[key] = value
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            _json.dump(data, f)
-        _os.replace(tmp, path)
+        # Serialize the read-modify-write across processes: without the
+        # lock two concurrent probes each read, add their own key, and
+        # the second replace drops the first writer's verdict (a lost
+        # verdict re-probes later — a failed remote compile costs ~2
+        # minutes).  Lock acquisition is itself best-effort (flock can
+        # fail on e.g. NFS): the write must still happen unlocked then,
+        # and the per-pid tmp name keeps it from interleaving.
+        with open(path + ".lock", "w") as lock_f:
+            if _fcntl is not None:
+                try:
+                    _fcntl.flock(lock_f, _fcntl.LOCK_EX)
+                except OSError:  # pragma: no cover - odd filesystems
+                    pass
+            try:
+                with open(path) as f:
+                    data = _json.load(f)
+            except Exception:
+                data = {}
+            data[key] = value
+            tmp = f"{path}.{_os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                _json.dump(data, f)
+            _os.replace(tmp, path)
     except Exception:
         pass  # cache is best-effort
 
