@@ -104,6 +104,32 @@ def honest_packets(honest, cfg: QBAConfig):
     return honest[senders + 2].astype(jnp.int32)[:, None]
 
 
+# Shared vma plumbing for every Pallas kernel builder that can run
+# under shard_map's replication checker (this module's monolithic round
+# step and both tiled kernels import these — ONE copy of the promotion
+# rule, not three hand-synchronized closures).
+
+def promote_vma(out_vma, x):
+    """Promote ``x`` to carry every axis in ``out_vma``: under the
+    replication checker every pallas operand must match the declared
+    vma; constants and replicated values get pcast explicitly.
+    No-op when ``out_vma`` is None (checker off)."""
+    if out_vma is None:
+        return x
+    have = getattr(jax.typeof(x), "vma", frozenset())
+    need = tuple(a for a in out_vma if a not in have)
+    return jax.lax.pcast(x, need, to="varying") if need else x
+
+
+def vma_struct(out_vma, dims, dt=jnp.int32):
+    """``ShapeDtypeStruct`` carrying the declared output vma (pallas
+    outputs must state which mesh axes they vary over under the
+    replication checker)."""
+    if out_vma is None:
+        return jax.ShapeDtypeStruct(dims, dt)
+    return jax.ShapeDtypeStruct(dims, dt, vma=out_vma)
+
+
 def build_round_step(
     cfg: QBAConfig,
     *,
@@ -382,9 +408,7 @@ def build_round_step(
     # must declare which mesh axes they vary over (out_vma; the
     # party-sharded spmd engine passes its mesh axes).
     def oshp(*dims):
-        if out_vma is None:
-            return jax.ShapeDtypeStruct(dims, jnp.int32)
-        return jax.ShapeDtypeStruct(dims, jnp.int32, vma=out_vma)
+        return vma_struct(out_vma, dims)
 
     out_shapes = (
         oshp(max_l, n_c, size_l),  # vals
@@ -439,14 +463,7 @@ def build_round_step(
     )
 
     def _pv(x):
-        # Under shard_map's replication checker every pallas operand must
-        # carry the declared vma; constants (E, the scalar round index)
-        # and replicated values get promoted explicitly.
-        if out_vma is None:
-            return x
-        have = getattr(jax.typeof(x), "vma", frozenset())
-        need = tuple(a for a in out_vma if a not in have)
-        return jax.lax.pcast(x, need, to="varying") if need else x
+        return promote_vma(out_vma, x)
 
     def _tail(li):
         # Lane-packed receiver tables (cheap XLA reshapes outside the

@@ -75,7 +75,12 @@ from qba_tpu.ops.verdict_algebra import (
 
 
 def build_verdict_kernel(
-    cfg: QBAConfig, blk: int, *, interpret: bool = False
+    cfg: QBAConfig,
+    blk: int,
+    *,
+    interpret: bool = False,
+    n_recv: int | None = None,
+    out_vma: frozenset | None = None,
 ):
     """Compile phase 1: the blocked acceptance-verdict kernel.
 
@@ -95,10 +100,25 @@ def build_verdict_kernel(
     leading blocks and trailing blocks cost only their DMA.  (The skip
     reads the block's own data rather than an ``n_sent`` scalar: a
     per-trial scalar operand cannot be batched into SMEM under vmap.)
+
+    ``n_recv`` builds the party-sharded variant for
+    :mod:`qba_tpu.parallel.spmd` (mirroring the monolithic kernel's
+    ``build_round_step(n_recv=...)``): the kernel drains a contiguous
+    block of ``n_recv`` receivers against the FULL gathered pool —
+    which is then per-device compacted (contiguous live prefix per
+    ``tp`` segment), preserving the global (sender, slot) packet order
+    D5 needs, with dead inter-segment capacity skipped by the same
+    block-skip test.  ``step`` gains a runtime ``recv_off`` operand
+    (every device runs one program under shard_map), the
+    receiver-indexed operands hold only the local block's rows/columns,
+    and ``out_vma`` declares the mesh axes the outputs vary over
+    (required under shard_map's replication checker).
     """
-    n_rv, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
+    n_rv_glob, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
     size_l, w = cfg.size_l, cfg.w
-    n_pool = n_rv * slots
+    n_pool = n_rv_glob * slots  # the GLOBAL pool capacity / cell space
+    local = n_recv is not None
+    n_rv = n_recv if local else n_rv_glob  # receivers this kernel drains
     if n_pool % blk:
         raise ValueError(f"blk={blk} must divide n_pool={n_pool}")
     n_blocks = n_pool // blk
@@ -118,12 +138,6 @@ def build_verdict_kernel(
         e_np[j, j * size_l : (j + 1) * size_l] = 1.0
 
     def kernel(round_ref, *refs):
-        (
-            vals_ref, lens_ref, count_ref, p_ref, v_ref, sent_ref,
-            cell_ref, vi_ref, honest_ref, act_ref, rv_ref,
-            late_ref, e_ref, lip_ref, lioob_ref, acc_ref, ovi_ref,
-        ) = refs
-
         def scalar_read(ref):
             # Interpret mode under shard_map's replication checker: a
             # full load + squeeze avoids the literal-index dynamic_slice
@@ -131,6 +145,17 @@ def build_verdict_kernel(
             if interpret:
                 return ref[:].reshape(())
             return ref[0]
+
+        if local:
+            off_ref, *refs = refs
+            r_off = scalar_read(off_ref)  # block's first receiver
+        else:
+            r_off = 0
+        (
+            vals_ref, lens_ref, count_ref, p_ref, v_ref, sent_ref,
+            cell_ref, vi_ref, honest_ref, act_ref, rv_ref,
+            late_ref, e_ref, lip_ref, lioob_ref, acc_ref, ovi_ref,
+        ) = refs
 
         r_idx = scalar_read(round_ref)
         blk_id = pl.program_id(0)
@@ -178,7 +203,12 @@ def build_verdict_kernel(
             act_all = cell_mm(act_ref[:]).astype(jnp.int32)  # [blk, n_rv]
             rv_all = cell_mm(rv_ref[:]).astype(jnp.int32)
             late_all = cell_mm(late_ref[:]).astype(jnp.int32)
-            lane_recv = jax.lax.broadcasted_iota(jnp.int32, (blk, n_rv), 1)
+            # Global receiver ids (r_off = 0 single-device): sender_col
+            # is a global sender index, so self-delivery must compare
+            # against global receiver ids too.
+            lane_recv = (
+                jax.lax.broadcasted_iota(jnp.int32, (blk, n_rv), 1) + r_off
+            )
             dropped_all = biz & ((act_all & DROP_BIT) != 0)
             v2_all = jnp.where(biz & ((act_all & FORGE_BIT) != 0),
                                rv_all, v_ref[:])
@@ -229,6 +259,9 @@ def build_verdict_kernel(
 
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # round_idx
+    ] + (
+        [pl.BlockSpec(memory_space=pltpu.SMEM)] if local else []  # recv_off
+    ) + [
         pl.BlockSpec((max_l, blk, size_l), lambda i: (0, i, 0)),  # vals
         pl.BlockSpec((blk, max_l), blkmap),  # lens
         pl.BlockSpec((blk, 1), blkmap),  # count
@@ -249,12 +282,15 @@ def build_verdict_kernel(
         pl.BlockSpec((blk, n_rv), blkmap),  # acc
         pl.BlockSpec((n_rv, w), lambda i: (0, 0)),  # ovi (revisited)
     )
+
+    from qba_tpu.ops.round_kernel import promote_vma, vma_struct
+
     call = pl.pallas_call(
         kernel,
         grid=grid,
         out_shape=(
-            jax.ShapeDtypeStruct((n_pool, n_rv), jnp.int32),
-            jax.ShapeDtypeStruct((n_rv, w), jnp.int32),
+            vma_struct(out_vma, (n_pool, n_rv)),
+            vma_struct(out_vma, (n_rv, w)),
         ),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -266,6 +302,9 @@ def build_verdict_kernel(
         interpret=interpret,
     )
 
+    def _pv(x):
+        return promote_vma(out_vma, x)
+
     def _tail(li):
         li_pack = jnp.stack(
             [li[r0 : r0 + grp].reshape(-1) for r0 in r0_list]
@@ -273,16 +312,32 @@ def build_verdict_kernel(
         li_oob_pack = ((li_pack > w) | (li_pack < 0)).astype(jnp.int32)
         return jnp.asarray(e_np), li_pack, li_oob_pack
 
-    def verdict(round_idx, vals, lens, count, p, v, sent, cell,
-                li, vi, honest_pk, attack, rand_v, late):
-        # li itself is consumed host-side (the lane-packed lip/lioob
-        # tables carry its data); the kernel takes only the tables.
-        e_mat, lip, lioob = _tail(li)
-        return call(
-            jnp.asarray([round_idx], jnp.int32),
-            vals, lens, count, p, v, sent, cell, vi, honest_pk,
-            attack, rand_v, late, e_mat, lip, lioob,
-        )
+    if local:
+
+        def verdict(round_idx, recv_off, vals, lens, count, p, v, sent,
+                    cell, li, vi, honest_pk, attack, rand_v, late):
+            # Pool operands are GLOBAL; li/vi/draw columns are the local
+            # receiver block's; recv_off is its first receiver.
+            args = (
+                jnp.asarray([round_idx], jnp.int32),
+                jnp.asarray(recv_off, jnp.int32).reshape(1),
+                vals, lens, count, p, v, sent, cell, vi, honest_pk,
+                attack, rand_v, late, *_tail(li),
+            )
+            return call(*map(_pv, args))
+
+    else:
+
+        def verdict(round_idx, vals, lens, count, p, v, sent, cell,
+                    li, vi, honest_pk, attack, rand_v, late):
+            # li itself is consumed host-side (the lane-packed lip/lioob
+            # tables carry its data); the kernel takes only the tables.
+            e_mat, lip, lioob = _tail(li)
+            return call(
+                jnp.asarray([round_idx], jnp.int32),
+                vals, lens, count, p, v, sent, cell, vi, honest_pk,
+                attack, rand_v, late, e_mat, lip, lioob,
+            )
 
     return verdict
 
@@ -298,36 +353,59 @@ def pool_vals_dtype(cfg: QBAConfig):
     return jnp.bfloat16 if cfg.w <= 256 else jnp.int32
 
 
-def empty_pool(cfg: QBAConfig):
+def honest_cells(honest, cfg: QBAConfig):
+    """Per-cell sender-honesty column ``[n_cells, 1]`` from the
+    rank-indexed honesty mask (cells are static per trial: the cell's
+    sender lieutenant is ``cell // slots``, rank ``+ 2``).  The tiled
+    analog of :func:`qba_tpu.ops.round_kernel.honest_packets` — shared
+    by the single-device and party-sharded callers."""
+    n_cells = cfg.n_lieutenants * cfg.slots
+    return honest[
+        jnp.arange(n_cells) // cfg.slots + 2
+    ].astype(jnp.int32)[:, None]
+
+
+def empty_pool(cfg: QBAConfig, n_recv: int | None = None):
     """The compacted packet pool: ``(vals, lens, count, p, v, sent,
     cell)``, capacity ``n_lieutenants * slots`` (the lossless bound —
-    each receiver accepts at most ``slots <= w`` packets per round)."""
-    n_rv, slots, max_l, s = (
-        cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l,
-    )
-    n_pool = n_rv * slots
+    each receiver accepts at most ``slots <= w`` packets per round).
+    ``n_recv`` sizes a party-sharded LOCAL pool (capacity
+    ``n_recv * slots`` — one device's senders)."""
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
+    slots, max_l, s = cfg.slots, cfg.max_l, cfg.size_l
+    cap = n_rv * slots
     vdt = pool_vals_dtype(cfg)
     return (
-        jnp.full((max_l, n_pool, s), SENTINEL, vdt),
-        jnp.zeros((n_pool, max_l), jnp.int32),
-        jnp.zeros((n_pool, 1), jnp.int32),
-        jnp.zeros((n_pool, s), vdt),
-        jnp.zeros((n_pool, 1), jnp.int32),
-        jnp.zeros((n_pool, 1), jnp.int32),
-        jnp.zeros((n_pool, 1), jnp.int32),
+        jnp.full((max_l, cap, s), SENTINEL, vdt),
+        jnp.zeros((cap, max_l), jnp.int32),
+        jnp.zeros((cap, 1), jnp.int32),
+        jnp.zeros((cap, s), vdt),
+        jnp.zeros((cap, 1), jnp.int32),
+        jnp.zeros((cap, 1), jnp.int32),
+        jnp.zeros((cap, 1), jnp.int32),
     )
 
 
-def pool_from_step3a(cfg: QBAConfig, out_cells):
+def pool_from_step3a(cfg: QBAConfig, out_cells, *, start=None,
+                     n_recv: int | None = None):
     """Compact step 3a's per-lieutenant broadcast (slot 0 of each sender
-    row, ``tfg.py:185-196``) into the pool."""
+    row, ``tfg.py:185-196``) into the pool.
+
+    Party-sharded callers pass their receiver-block rows plus
+    ``start`` (the block's first GLOBAL receiver, traced) and
+    ``n_recv``: the result is the device's LOCAL pool — locally
+    compacted, carrying GLOBAL cell ids, so the per-round ``tp``
+    all_gather concatenates segments in global (sender, slot) order.
+    """
     o_vals, o_lens, o_count, o_p, o_v, o_sent = out_cells
-    n_rv, slots = cfg.n_lieutenants, cfg.slots
-    n_pool = n_rv * slots
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
+    slots = cfg.slots
+    cap = n_rv * slots
+    base = 0 if start is None else start
     sent0 = o_sent[:, 0]  # bool[n_rv]
     offs = jnp.cumsum(sent0.astype(jnp.int32)) - sent0.astype(jnp.int32)
-    dst = jnp.where(sent0, offs, n_pool)
-    pool = empty_pool(cfg)
+    dst = jnp.where(sent0, offs, cap)
+    pool = empty_pool(cfg, n_recv)
 
     def scat(tgt, src):  # scatter rows of src[n_rv, ...] to dst positions
         return tgt.at[dst].set(src, mode="drop")
@@ -336,6 +414,7 @@ def pool_from_step3a(cfg: QBAConfig, out_cells):
     vals_p = pool[0].transpose(1, 0, 2).at[dst].set(
         o_vals[:, 0].astype(vdt), mode="drop"
     ).transpose(1, 0, 2)
+    cell_ids = (base + jnp.arange(n_rv, dtype=jnp.int32)) * slots
     return (
         vals_p,
         scat(pool[1], o_lens[:, 0]),
@@ -343,22 +422,31 @@ def pool_from_step3a(cfg: QBAConfig, out_cells):
         scat(pool[3], o_p[:, 0].astype(vdt)),
         scat(pool[4], o_v[:, 0][:, None]),
         scat(pool[5], jnp.ones((n_rv, 1), jnp.int32)),
-        scat(pool[6], (jnp.arange(n_rv, dtype=jnp.int32) * slots)[:, None]),
+        scat(pool[6], cell_ids[:, None]),
     )
 
 
 def rebuild_pool(cfg: QBAConfig, round_idx, pool, li, acc,
-                 attack_pool, rand_v_pool, honest_pool):
+                 attack_pool, rand_v_pool, honest_pool, *, start=None,
+                 n_recv: int | None = None):
     """Phase 2 (XLA): slot allocation + next-round pool construction.
 
     Mirrors the monolithic kernel's rebuild tail (``tfg.py:298-299`` slot
     allocation, ``lieu_receive``'s evidence append) over the compacted
     pool.  Returns ``(pool', overflow)``.
+
+    Party-sharded callers pass ``n_recv`` + ``start``: ``pool`` is then
+    the FULL gathered pool, ``li``/``acc`` and the per-receiver draw
+    columns hold only the local receiver block, and the result is the
+    device's LOCAL pool (capacity ``n_recv * slots``, global cell ids).
     """
-    n_rv, slots, max_l, s = (
+    n_rv_glob, slots, max_l, s = (
         cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l,
     )
-    n_pool = n_rv * slots
+    n_pool = n_rv_glob * slots  # gathered/global pool capacity
+    n_rv = n_recv if n_recv is not None else n_rv_glob
+    n_out = n_rv * slots  # this block's output pool capacity
+    base = 0 if start is None else start
     vals, lens, count, p, v, sent, _cell = pool
     biz = honest_pool == 0  # [n_pool, 1]
     clear_p = biz & ((attack_pool & CLEAR_P_BIT) != 0)  # [n_pool, n_rv]
@@ -383,31 +471,33 @@ def rebuild_pool(cfg: QBAConfig, round_idx, pool, li, acc,
     src_r = -top  # ascending pool index; `big` marks empty slots
     has_r = src_r < n_pool  # [n_rv, slots]
 
-    # Global compacted destination: receiver-major (sender, slot) order
-    # — compaction preserves D5 packet order.
+    # Compacted destination: receiver-major (sender, slot) order —
+    # compaction preserves D5 packet order (per device block in the
+    # party-sharded case; segments concatenate in global order).
     k_r = jnp.sum(write.astype(jnp.int32), axis=0)  # [n_rv]
     offs = jnp.cumsum(k_r) - k_r  # exclusive
     dst = jnp.where(
-        has_r, offs[:, None] + jnp.arange(slots)[None, :], n_pool
+        has_r, offs[:, None] + jnp.arange(slots)[None, :], n_out
     )  # [n_rv, slots]
     dst_f = dst.reshape(-1)
     src_f = jnp.minimum(src_r.reshape(-1), n_pool - 1)
 
     # src_pool[d] = pool index feeding compacted position d.
-    src_pool = jnp.full((n_pool,), n_pool, jnp.int32).at[dst_f].set(
+    src_pool = jnp.full((n_out,), n_pool, jnp.int32).at[dst_f].set(
         src_f.astype(jnp.int32), mode="drop"
     )
     new_sent = (src_pool < n_pool).astype(jnp.int32)[:, None]
     srcc = jnp.minimum(src_pool, n_pool - 1)
-    # cell id = sender(=accepting receiver) * slots + per-receiver slot.
+    # cell id = sender(=accepting receiver) * slots + per-receiver slot
+    # — GLOBAL receiver index (base + local).
     cell_f = (
-        jnp.arange(n_rv, dtype=jnp.int32)[:, None] * slots
+        (base + jnp.arange(n_rv, dtype=jnp.int32))[:, None] * slots
         + jnp.arange(slots, dtype=jnp.int32)[None, :]
     ).reshape(-1)
-    new_cell = jnp.zeros((n_pool,), jnp.int32).at[dst_f].set(
+    new_cell = jnp.zeros((n_out,), jnp.int32).at[dst_f].set(
         cell_f, mode="drop"
     )[:, None]
-    recv_c = jnp.minimum(new_cell[:, 0] // slots, n_rv - 1)
+    recv_c = jnp.clip(new_cell[:, 0] // slots - base, 0, n_rv - 1)
 
     # Gather source fields + the (src, recv) corruption flags.
     vals_g = jnp.take(vals, srcc, axis=1)  # [max_l, n_pool, s]
@@ -461,7 +551,12 @@ def rebuild_pool(cfg: QBAConfig, round_idx, pool, li, acc,
 
 
 def build_rebuild_kernel(
-    cfg: QBAConfig, blk_d: int, *, interpret: bool = False
+    cfg: QBAConfig,
+    blk_d: int,
+    *,
+    interpret: bool = False,
+    n_recv: int | None = None,
+    out_vma: frozenset | None = None,
 ):
     """Compile phase 2 as a Pallas kernel — the fast path; the XLA
     :func:`rebuild_pool` is the fallback when this shape doesn't compile.
@@ -498,18 +593,38 @@ def build_rebuild_kernel(
     o_p, o_v, o_sent, o_cell, overflow)`` with ``attack``/``rand_v``
     mailbox-cell-ordered ``[n_cells, n_rv]`` (NOT pool-gathered) and
     ``honest_cells`` the per-cell sender honesty column.
+
+    ``n_recv`` builds the party-sharded variant (see
+    :func:`build_verdict_kernel`): the source pool is the FULL gathered
+    pool, the receiver-indexed operands hold the local block only, the
+    destination pool has capacity ``n_recv * slots``, output cell ids
+    are global (``recv_off`` runtime operand), and ``out_vma`` declares
+    the outputs' mesh axes for shard_map's replication checker.
     """
-    n_rv, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
+    n_rv_glob, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
     size_l, w = cfg.size_l, cfg.w
-    n_pool = n_rv * slots
+    n_pool = n_rv_glob * slots  # gathered/global source pool capacity
+    local = n_recv is not None
+    n_rv = n_recv if local else n_rv_glob
+    n_out = n_rv * slots  # this block's destination pool capacity
     n_dis = cfg.n_dishonest
-    if n_pool % blk_d:
-        raise ValueError(f"blk_d={blk_d} must divide n_pool={n_pool}")
-    n_blocks = n_pool // blk_d
+    if n_out % blk_d:
+        raise ValueError(f"blk_d={blk_d} must divide n_out={n_out}")
+    n_blocks = n_out // blk_d
     gdt = jnp.bfloat16 if size_l <= 256 and w <= 256 else jnp.float32
     vdt = pool_vals_dtype(cfg)
 
     def kernel(round_ref, *refs):
+        def scalar_read(ref):
+            if interpret:
+                return ref[:].reshape(())
+            return ref[0]
+
+        if local:
+            off_ref, *refs = refs
+            r_off = scalar_read(off_ref)  # block's first GLOBAL receiver
+        else:
+            r_off = 0
         (
             vals_ref, lens_ref, count_ref, p_ref, v_ref, cell_ref,
             li_ref, acc_ref, accT_ref, att_ref, rv_ref, hon_ref,
@@ -517,11 +632,6 @@ def build_rebuild_kernel(
             osent_ref, ocell_ref, ovf_ref,
             wT_scr, sT_scr, lane_scr,
         ) = refs
-
-        def scalar_read(ref):
-            if interpret:
-                return ref[:].reshape(())
-            return ref[0]
 
         r_idx = scalar_read(round_ref)
         bd = pl.program_id(0) * blk_d
@@ -700,7 +810,10 @@ def build_rebuild_kernel(
             op_ref[:] = jnp.where(has & p2, 1.0, 0.0).astype(vdt)
             ov_ref[:] = jnp.where(has, v2_c, 0)
             osent_ref[:] = jnp.where(has, 1, 0)
-            ocell_ref[:] = jnp.where(has, r_j * slots + slot_lane, 0)
+            # Global cell id: the accepting receiver's GLOBAL index.
+            ocell_ref[:] = jnp.where(
+                has, (r_off + r_j) * slots + slot_lane, 0
+            )
 
     full = lambda i: (0, 0)  # noqa: E731 — constant index map (resident)
     full3 = lambda i: (0, 0, 0)  # noqa: E731
@@ -710,6 +823,9 @@ def build_rebuild_kernel(
 
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # round_idx
+    ] + (
+        [pl.BlockSpec(memory_space=pltpu.SMEM)] if local else []  # recv_off
+    ) + [
         pl.BlockSpec((max_l, n_pool, size_l), full3),  # vals
         pl.BlockSpec((n_pool, max_l), full),  # lens
         pl.BlockSpec((n_pool, 1), full),  # count
@@ -733,18 +849,23 @@ def build_rebuild_kernel(
         pl.BlockSpec((blk_d, 1), dmap),  # cell
         pl.BlockSpec((1, 1), lambda i: (0, 0)),  # overflow
     )
+    from qba_tpu.ops.round_kernel import promote_vma, vma_struct
+
+    def oshp(*dims, dt=jnp.int32):
+        return vma_struct(out_vma, dims, dt)
+
     call = pl.pallas_call(
         kernel,
         grid=(n_blocks,),
         out_shape=(
-            jax.ShapeDtypeStruct((max_l, n_pool, size_l), vdt),
-            jax.ShapeDtypeStruct((n_pool, max_l), jnp.int32),
-            jax.ShapeDtypeStruct((n_pool, 1), jnp.int32),
-            jax.ShapeDtypeStruct((n_pool, size_l), vdt),
-            jax.ShapeDtypeStruct((n_pool, 1), jnp.int32),
-            jax.ShapeDtypeStruct((n_pool, 1), jnp.int32),
-            jax.ShapeDtypeStruct((n_pool, 1), jnp.int32),
-            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            oshp(max_l, n_out, size_l, dt=vdt),
+            oshp(n_out, max_l),
+            oshp(n_out, 1),
+            oshp(n_out, size_l, dt=vdt),
+            oshp(n_out, 1),
+            oshp(n_out, 1),
+            oshp(n_out, 1),
+            oshp(1, 1),
         ),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -762,15 +883,33 @@ def build_rebuild_kernel(
         interpret=interpret,
     )
 
-    def rebuild(round_idx, vals, lens, count, p, v, cell, li, acc,
-                attack, rand_v, honest_cells):
-        out = call(
-            jnp.asarray([round_idx], jnp.int32),
-            vals, lens, count, p, v, cell, li, acc,
-            acc.T, attack, rand_v, honest_cells,
-        )
-        pool_new = out[:7]
-        return pool_new, out[7][0, 0] > 0
+    def _pv(x):
+        return promote_vma(out_vma, x)
+
+    if local:
+
+        def rebuild(round_idx, recv_off, vals, lens, count, p, v, cell,
+                    li, acc, attack, rand_v, honest_cells):
+            args = (
+                jnp.asarray([round_idx], jnp.int32),
+                jnp.asarray(recv_off, jnp.int32).reshape(1),
+                vals, lens, count, p, v, cell, li, acc,
+                acc.T, attack, rand_v, honest_cells,
+            )
+            out = call(*map(_pv, args))
+            return out[:7], out[7][0, 0] > 0
+
+    else:
+
+        def rebuild(round_idx, vals, lens, count, p, v, cell, li, acc,
+                    attack, rand_v, honest_cells):
+            out = call(
+                jnp.asarray([round_idx], jnp.int32),
+                vals, lens, count, p, v, cell, li, acc,
+                acc.T, attack, rand_v, honest_cells,
+            )
+            pool_new = out[:7]
+            return pool_new, out[7][0, 0] > 0
 
     return rebuild
 
@@ -794,16 +933,19 @@ _TILED_PREFILTER_BYTES = 48 * 2**20
 _MAX_PROBE_CANDIDATES = 4
 
 
-def _block_estimate(cfg: QBAConfig, blk: int) -> int:
+def _block_estimate(cfg: QBAConfig, blk: int,
+                    n_recv: int | None = None) -> int:
     """Loose VMEM estimate for one verdict block (same spirit as
     round_kernel.fits_kernel — a screen before the authoritative compile
-    probe, not a guarantee)."""
+    probe, not a guarantee).  ``n_recv`` estimates the party-sharded
+    local-receiver variant (smaller flag tiles and lane groups)."""
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
     tile = 4 * blk * cfg.size_l
     est = tile * (2 * cfg.max_l + 10)
-    grp = _lane_group(cfg.size_l, cfg.n_lieutenants)
+    grp = _lane_group(cfg.size_l, n_rv)
     if grp > 1:
         est += tile * grp * (cfg.max_l + 6)
-    est += 4 * blk * cfg.n_lieutenants * 6  # flag algebra tiles
+    est += 4 * blk * n_rv * 6  # flag algebra tiles
     est = int(est * (1.0 + cfg.max_l / 4.0))
     return est
 
@@ -827,38 +969,41 @@ def _order_candidates(cands: list[int], preferred: int) -> list[int]:
     )
 
 
-def block_candidates(cfg: QBAConfig) -> list[int]:
+def block_candidates(cfg: QBAConfig, n_recv: int | None = None) -> list[int]:
     """Candidate block sizes: divisors of the pool capacity, multiples
     of 8 where possible, within the VMEM pre-filter, ordered by
     closeness to the measured sweet spot (:func:`_preferred_block`) and
     capped at ``_MAX_PROBE_CANDIDATES`` (each failed remote compile
     probe costs minutes; the disk cache makes even that a one-time
-    cost)."""
+    cost).  Blocks always tile the GLOBAL pool — ``n_recv`` only
+    affects the VMEM estimate of the local-receiver variant."""
     n_pool = cfg.n_lieutenants * cfg.slots
     divs = [d for d in range(n_pool, 0, -1) if n_pool % d == 0]
     cands = [d for d in divs if d % 8 == 0] or divs
-    ok = [b for b in cands if _block_estimate(cfg, b)
+    ok = [b for b in cands if _block_estimate(cfg, b, n_recv)
           <= _TILED_PREFILTER_BYTES]
     return _order_candidates(ok, _preferred_block(cfg))[
         :_MAX_PROBE_CANDIDATES
     ]
 
 
-def _rebuild_estimate(cfg: QBAConfig, blk_d: int) -> int:
+def _rebuild_estimate(cfg: QBAConfig, blk_d: int,
+                      n_recv: int | None = None) -> int:
     """Loose per-step VMEM estimate for the rebuild kernel: resident
     pool operands (double-buffered under vmap) + the f32
-    ``[blk_d, n_pool]`` gather intermediates + gathered rows/outputs."""
-    n_rv, slots, max_l, s = (
-        cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l,
-    )
-    n_pool = n_rv * slots
+    ``[blk_d, n_pool]`` gather intermediates + gathered rows/outputs.
+    ``n_recv`` estimates the party-sharded variant, whose
+    receiver-indexed operands and scratch shrink with the block."""
+    slots, max_l, s = cfg.slots, cfg.max_l, cfg.size_l
+    n_pool = cfg.n_lieutenants * slots  # source pool stays global
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
     vb = 2 if cfg.w <= 256 else 4
     resident = (
         vb * max_l * n_pool * s  # vals
         + vb * n_pool * s  # p
         + 4 * n_pool * max_l  # lens
         + 6 * 4 * n_pool  # count/v/cell/honest cols
-        + 4 * 4 * n_pool * n_rv  # acc/accT/attack/rand_v
+        + 4 * 4 * n_pool * n_rv  # acc/accT/attack/rand_v + wT/sT scratch
     )
     step = (
         3 * 4 * blk_d * n_pool  # G^T, w_sel, s_sel (f32)
@@ -872,14 +1017,18 @@ def _rebuild_estimate(cfg: QBAConfig, blk_d: int) -> int:
 _REBUILD_BUDGET = 24 * 2**20
 
 
-def rebuild_candidates(cfg: QBAConfig) -> list[int]:
+def rebuild_candidates(cfg: QBAConfig, n_recv: int | None = None) -> list[int]:
     """Candidate destination block sizes for the rebuild kernel — same
     sweet-spot ordering as :func:`block_candidates` (dead destination
-    blocks skip like dead packet blocks)."""
-    n_pool = cfg.n_lieutenants * cfg.slots
-    divs = [d for d in range(n_pool, 0, -1) if n_pool % d == 0]
+    blocks skip like dead packet blocks).  The destination pool is
+    LOCAL in the party-sharded variant: blocks divide
+    ``n_recv * slots``."""
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
+    n_out = n_rv * cfg.slots
+    divs = [d for d in range(n_out, 0, -1) if n_out % d == 0]
     cands = [d for d in divs if d % 8 == 0] or divs
-    ok = [b for b in cands if _rebuild_estimate(cfg, b) <= _REBUILD_BUDGET]
+    ok = [b for b in cands
+          if _rebuild_estimate(cfg, b, n_recv) <= _REBUILD_BUDGET]
     return _order_candidates(ok, _preferred_block(cfg))[
         :_MAX_PROBE_CANDIDATES
     ]
@@ -894,15 +1043,17 @@ def _shape_key(cfg: QBAConfig) -> tuple:
 
 
 def _probe_plan(kernel_name, cfg, candidates, compile_one, cache,
-                fallback_desc):
+                fallback_desc, extra: str = ""):
     """Shared cached compile-probe search: first candidate block size
     that compiles wins.  Memory cache per process, disk cache per
     machine (see the module note above); ``compile_one(blk)`` must
-    raise on compile failure and never execute anything."""
-    key = _shape_key(cfg)
+    raise on compile failure and never execute anything.  ``extra``
+    distinguishes kernel variants of the same config shape (the
+    party-sharded ``n_recv`` builds)."""
+    key = _shape_key(cfg) + (extra,)
     if key in cache:
         return cache[key]
-    dkey = _probe_disk_key(kernel_name, cfg)
+    dkey = _probe_disk_key(kernel_name, cfg, extra=extra)
     hit = _probe_disk_get(dkey)
     if hit is not None:
         blk = None if hit < 0 else hit
@@ -948,20 +1099,26 @@ def _probe_shapes(cfg: QBAConfig):
     return shp, i32, vdt
 
 
-def tiled_kernel_plan(cfg: QBAConfig) -> int | None:
+def tiled_kernel_plan(cfg: QBAConfig, n_recv: int | None = None) -> int | None:
     """The verdict-kernel block size the tiled engine will use for this
     config, or None if no candidate compiles.  Like
     round_kernel.kernel_compiles, the authoritative gate is a cached
     data-free compile probe per shape — Mosaic's scoped-vmem use cannot
-    be modeled reliably from outside."""
+    be modeled reliably from outside.  ``n_recv`` probes the
+    party-sharded local-receiver variant."""
     shp, i32, vdt = _probe_shapes(cfg)
-    n_rv, slots = cfg.n_lieutenants, cfg.slots
-    n_pool = n_rv * slots
+    slots = cfg.slots
+    n_pool = cfg.n_lieutenants * slots
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
+    local = n_recv is not None
 
     def compile_one(blk):
-        verdict = build_verdict_kernel(cfg, blk)
-        jax.jit(jax.vmap(verdict, in_axes=(None,) + (0,) * 13)).lower(
+        verdict = build_verdict_kernel(cfg, blk, n_recv=n_recv)
+        off = (jax.ShapeDtypeStruct((), i32),) if local else ()
+        in_axes = (None,) * (1 + len(off)) + (0,) * 13
+        jax.jit(jax.vmap(verdict, in_axes=in_axes)).lower(
             jax.ShapeDtypeStruct((), i32),
+            *off,
             shp(cfg.max_l, n_pool, cfg.size_l, dt=vdt),
             shp(n_pool, cfg.max_l),
             shp(n_pool, 1), shp(n_pool, cfg.size_l, dt=vdt),
@@ -971,23 +1128,29 @@ def tiled_kernel_plan(cfg: QBAConfig) -> int | None:
         ).compile()
 
     return _probe_plan(
-        "tiled-verdict", cfg, block_candidates(cfg), compile_one,
+        "tiled-verdict", cfg, block_candidates(cfg, n_recv), compile_one,
         _TILED_PROBE_CACHE, "falling back to the XLA round engine",
+        extra=f"recv{n_recv}" if local else "",
     )
 
 
-def rebuild_kernel_plan(cfg: QBAConfig) -> int | None:
+def rebuild_kernel_plan(cfg: QBAConfig, n_recv: int | None = None) -> int | None:
     """Destination block size for the Pallas rebuild kernel, or None if
     no candidate compiles (the XLA :func:`rebuild_pool` then takes
-    over)."""
+    over).  ``n_recv`` probes the party-sharded variant."""
     shp, i32, vdt = _probe_shapes(cfg)
-    n_rv, slots = cfg.n_lieutenants, cfg.slots
-    n_pool = n_rv * slots
+    slots = cfg.slots
+    n_pool = cfg.n_lieutenants * slots
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
+    local = n_recv is not None
 
     def compile_one(blk_d):
-        rebuild = build_rebuild_kernel(cfg, blk_d)
-        jax.jit(jax.vmap(rebuild, in_axes=(None,) + (0,) * 11)).lower(
+        rebuild = build_rebuild_kernel(cfg, blk_d, n_recv=n_recv)
+        off = (jax.ShapeDtypeStruct((), i32),) if local else ()
+        in_axes = (None,) * (1 + len(off)) + (0,) * 11
+        jax.jit(jax.vmap(rebuild, in_axes=in_axes)).lower(
             jax.ShapeDtypeStruct((), i32),
+            *off,
             shp(cfg.max_l, n_pool, cfg.size_l, dt=vdt),
             shp(n_pool, cfg.max_l),
             shp(n_pool, 1), shp(n_pool, cfg.size_l, dt=vdt),
@@ -997,34 +1160,40 @@ def rebuild_kernel_plan(cfg: QBAConfig) -> int | None:
         ).compile()
 
     return _probe_plan(
-        "tiled-rebuild", cfg, rebuild_candidates(cfg), compile_one,
+        "tiled-rebuild", cfg, rebuild_candidates(cfg, n_recv), compile_one,
         _REBUILD_PROBE_CACHE, "using the XLA rebuild fallback",
+        extra=f"recv{n_recv}" if local else "",
     )
 
 
-def resolve_rebuild_block(cfg: QBAConfig) -> int | None:
+def resolve_rebuild_block(cfg: QBAConfig,
+                          n_recv: int | None = None) -> int | None:
     """Block size the tiled engine's rebuild kernel runs with, or None
     to use the XLA rebuild fallback.
 
     An explicit ``tiled_block`` is sized for the *verdict* kernel (whose
     per-block footprint shrinks with the block); the rebuild kernel's
     G^T/one-hot intermediates grow as ``blk_d * n_pool``, so the
-    explicit value is honored only where its estimate fits — otherwise
+    explicit value is honored only where its estimate fits (and, in the
+    party-sharded case, divides the LOCAL destination pool) — otherwise
     the probe picks, keeping the XLA fallback reachable instead of
     failing at trial-compile time."""
-    if cfg.tiled_block is not None:
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
+    n_out = n_rv * cfg.slots
+    if cfg.tiled_block is not None and n_out % cfg.tiled_block == 0:
         if (
             jax.default_backend() != "tpu"
-            or _rebuild_estimate(cfg, cfg.tiled_block) <= _REBUILD_BUDGET
+            or _rebuild_estimate(cfg, cfg.tiled_block, n_recv)
+            <= _REBUILD_BUDGET
         ):
             return cfg.tiled_block
     if jax.default_backend() == "tpu":
-        return rebuild_kernel_plan(cfg)
-    cands = rebuild_candidates(cfg)
-    return cands[0] if cands else cfg.n_lieutenants * cfg.slots
+        return rebuild_kernel_plan(cfg, n_recv)
+    cands = rebuild_candidates(cfg, n_recv)
+    return cands[0] if cands else n_out
 
 
-def resolve_tiled_block(cfg: QBAConfig) -> int:
+def resolve_tiled_block(cfg: QBAConfig, n_recv: int | None = None) -> int:
     """The block size the tiled engine runs with: the config's explicit
     ``tiled_block`` when set (tests force small blocks to exercise the
     multi-block path off-TPU), else the probe's pick on TPU, else the
@@ -1033,8 +1202,8 @@ def resolve_tiled_block(cfg: QBAConfig) -> int:
     if cfg.tiled_block is not None:
         return cfg.tiled_block
     if jax.default_backend() == "tpu":
-        blk = tiled_kernel_plan(cfg)
+        blk = tiled_kernel_plan(cfg, n_recv)
         if blk is not None:
             return blk
-    cands = block_candidates(cfg)
+    cands = block_candidates(cfg, n_recv)
     return cands[0] if cands else cfg.n_lieutenants * cfg.slots

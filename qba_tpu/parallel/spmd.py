@@ -21,6 +21,7 @@ change the randomness.
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -78,10 +79,11 @@ def _trial_party_sharded(
 
     # Step 3b (tfg.py:337-348): each round's traffic = one all_gather of
     # the local mailbox rows over tp (replaces the reference's Isend
-    # storm + Iprobe drain + Barrier).  Two bit-identical engines, like
-    # the single-device path: vectorized XLA, or the fused Pallas round
-    # kernel in its party-sharded variant (each device's kernel drains
-    # only its receiver block against the gathered global mailbox).
+    # storm + Iprobe drain + Barrier).  Three bit-identical engines,
+    # like the single-device path: vectorized XLA, the fused monolithic
+    # Pallas round kernel, or the packet-tiled kernel pair — each in a
+    # party-sharded variant where the device's kernels drain only its
+    # receiver block against the gathered global mailbox/pool.
     if engine == "pallas":
         from qba_tpu.ops.round_kernel import (
             build_round_step,
@@ -124,6 +126,91 @@ def _trial_party_sharded(
             return (out[6], tuple(out[:6])), out[7][0, 0] > 0
 
         init = (vi_l.astype(jnp.int32), pack_local(mb_local))
+        (vi_i32, _), overflows = jax.lax.scan(
+            round_body, init, jnp.arange(1, cfg.n_rounds + 1)
+        )
+        vi_l = vi_i32 != 0
+    elif engine == "pallas_tiled":
+        # The packet-tiled engine's party-sharded variant: each device
+        # keeps a LOCAL compacted pool (its own receivers' outgoing
+        # packets, global cell ids); one all_gather over tp per round
+        # concatenates the segments into the full pool in global
+        # (sender, slot) order — per-segment live prefixes with dead
+        # capacity between them, which the verdict kernel's block-skip
+        # test already handles (it reads the block's sent flags, not a
+        # global count).  The verdict kernel drains only the local
+        # receiver block; the rebuild compacts the accepted packets
+        # back into the local pool.  Mirrors tfg.py:337-348 semantics
+        # at the reference's multi-process shape (README.md:3-4).
+        from qba_tpu.ops.round_kernel_tiled import (
+            build_rebuild_kernel,
+            build_verdict_kernel,
+            honest_cells as honest_cells_fn,
+            pool_from_step3a,
+            rebuild_pool,
+            resolve_rebuild_block,
+            resolve_tiled_block,
+        )
+
+        interpret = jax.default_backend() != "tpu"
+        # out_vma stays None: this engine always runs check_vma=False
+        # (a grid'd kernel under vma tracking traces pvary ops Mosaic
+        # cannot lower — see _spmd_batch), so vma declarations would be
+        # dead machinery.  Re-enable when JAX lowers pvary in Mosaic.
+        blk = resolve_tiled_block(cfg, n_recv=n_local)
+        verdict = build_verdict_kernel(
+            cfg, blk, interpret=interpret, n_recv=n_local,
+        )
+        blk_d = resolve_rebuild_block(cfg, n_recv=n_local)
+        rebuild_k = (
+            build_rebuild_kernel(
+                cfg, blk_d, interpret=interpret, n_recv=n_local,
+            )
+            if blk_d is not None
+            else None
+        )
+        pool_l = pool_from_step3a(
+            cfg, out_cells, start=start, n_recv=n_local
+        )
+        honest_cells = honest_cells_fn(honest, cfg)
+
+        def round_body(carry, round_idx):
+            vi_i32, pool_l = carry
+            pool_g = tuple(
+                gather_tp(x, axis=1 if i == 0 else 0)
+                for i, x in enumerate(pool_l)
+            )
+            k_round = jax.random.fold_in(k_rounds, round_idx)
+            draws = sample_attacks_round(cfg, k_round)
+            att_c, rv_c, late_c = (
+                jax.lax.dynamic_slice_in_dim(d, start, n_local, 1)
+                .astype(jnp.int32)
+                for d in draws
+            )
+            acc, vi_i32 = verdict(
+                round_idx, start, *pool_g[:6], pool_g[6], my_li,
+                vi_i32, honest_cells, att_c, rv_c, late_c,
+            )
+            if rebuild_k is not None:
+                pool_new, ovf = rebuild_k(
+                    round_idx, start, pool_g[0], pool_g[1], pool_g[2],
+                    pool_g[3], pool_g[4], pool_g[6], my_li, acc,
+                    att_c, rv_c, honest_cells,
+                )
+            else:
+                # The XLA rebuild consumes pool-ordered draws.
+                cell = pool_g[6][:, 0]
+                pool_new, ovf = rebuild_pool(
+                    cfg, round_idx, pool_g, my_li, acc,
+                    jnp.take(att_c, cell, axis=0),
+                    jnp.take(rv_c, cell, axis=0),
+                    jnp.take(honest_cells, cell, axis=0),
+                    start=start, n_recv=n_local,
+                )
+            return (vi_i32, pool_new), ovf
+
+        # Step 3a's local rows feed the local pool; vi carries int32.
+        init = (vi_l.astype(jnp.int32), pool_l)
         (vi_i32, _), overflows = jax.lax.scan(
             round_body, init, jnp.arange(1, cfg.n_rounds + 1)
         )
@@ -186,13 +273,17 @@ def _spmd_batch(
 
     # check_vma stays ON for the production paths: the trial body ends in
     # psums over tp, which the replication checker can statically verify
-    # (see _trial_party_sharded), and on real TPU the pallas round step is
-    # an opaque call with declared output vma.  The one exception is the
-    # kernel's interpret mode (CPU tests): pallas-interpret stages ref
-    # reads as dynamic_slices whose literal indices lack the operand's
-    # vma, which the checker rejects — a JAX limitation its own error
-    # message works around with check_vma=False.
-    use_check_vma = not (
+    # (see _trial_party_sharded), and on real TPU the monolithic pallas
+    # round step is an opaque call with declared output vma.  Two JAX
+    # limitations force it OFF elsewhere: (a) the kernels' interpret
+    # mode (CPU tests) stages ref reads as dynamic_slices whose literal
+    # indices lack the operand's vma, which the checker rejects; (b) a
+    # GRID'd pallas kernel (the tiled engine) traced under vma tracking
+    # gets `pvary` promotions inside its kernel jaxpr wherever a
+    # ref-read value meets a literal, and Mosaic has no pvary lowering
+    # (the grid-less monolithic kernel is unaffected — its kernel trace
+    # strips operand vma).
+    use_check_vma = engine != "pallas_tiled" and not (
         engine == "pallas" and jax.default_backend() != "tpu"
     )
     shard = jax.shard_map(
@@ -224,27 +315,47 @@ def run_trials_spmd(
     require_divisible(keys.shape[0], dp, "trials", "dp")
     require_divisible(cfg.n_lieutenants, tp, "n_lieutenants", "tp")
     engine = _resolve_spmd_engine(cfg, cfg.n_lieutenants // tp)
-    return aggregate(_spmd_batch(cfg, mesh, keys, engine))
+    try:
+        return aggregate(_spmd_batch(cfg, mesh, keys, engine))
+    except Exception as e:
+        # The residual probe-context gap (ADVICE r2 item 1): the kernel
+        # probes compile standalone, not under the vma-annotated
+        # shard_map context the real call uses, so a probe-pass /
+        # shard_map-fail config can still surface here.  When the
+        # engine was AUTO-selected, degrade loudly to the XLA branch;
+        # an explicitly forced engine re-raises (an explicit knob never
+        # silently means something weaker, docs/DIVERGENCES.md D1).
+        if engine == "xla" or cfg.round_engine != "auto":
+            raise
+        warnings.warn(
+            f"party-sharded '{engine}' round engine failed under "
+            f"shard_map despite a passing compile probe; falling back "
+            f"to the XLA spmd engine: {e!r:.500}",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return aggregate(_spmd_batch(cfg, mesh, keys, "xla"))
 
 
 def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:
-    """Engine for the party-sharded round loop: the Pallas kernel's
-    party-sharded variant when forced or when ``auto`` on TPU and the
-    local-block kernel compiles; vectorized XLA otherwise.
-
-    ``pallas_tiled`` has no party-sharded variant — an explicit request
-    is refused rather than silently downgraded (an explicit knob must
-    never mean something weaker; cf. racy_mode, docs/DIVERGENCES.md D1).
+    """Engine for the party-sharded round loop: forced engines pass
+    through (both Pallas kernel families have party-sharded variants);
+    ``auto`` on TPU follows the same size_l-dependent preference order
+    as the single-device :func:`~qba_tpu.rounds.engine.resolve_round_engine`,
+    probing the LOCAL-receiver kernel variants; vectorized XLA last.
     """
-    if cfg.round_engine == "pallas_tiled":
-        raise ValueError(
-            "round_engine='pallas_tiled' has no party-sharded (spmd) "
-            "variant; use 'auto', 'xla', or 'pallas' with run_trials_spmd"
-        )
-    if cfg.round_engine == "pallas":
-        return "pallas"
+    if cfg.round_engine in ("pallas", "pallas_tiled"):
+        return cfg.round_engine
     if cfg.round_engine != "auto" or jax.default_backend() != "tpu":
         return "xla"
     from qba_tpu.ops.round_kernel import kernel_compiles
+    from qba_tpu.ops.round_kernel_tiled import tiled_kernel_plan
 
-    return "pallas" if kernel_compiles(cfg, n_recv=n_local) else "xla"
+    wide = cfg.size_l >= 256
+    if wide and tiled_kernel_plan(cfg, n_recv=n_local) is not None:
+        return "pallas_tiled"
+    if kernel_compiles(cfg, n_recv=n_local):
+        return "pallas"
+    if not wide and tiled_kernel_plan(cfg, n_recv=n_local) is not None:
+        return "pallas_tiled"
+    return "xla"

@@ -434,6 +434,7 @@ def run_rounds_tiled(
     from qba_tpu.ops.round_kernel_tiled import (
         build_rebuild_kernel,
         build_verdict_kernel,
+        honest_cells as honest_cells_fn,
         pool_from_step3a,
         rebuild_pool,
         resolve_rebuild_block,
@@ -449,10 +450,7 @@ def run_rounds_tiled(
         else None
     )
     pool = pool_from_step3a(cfg, out_cells)
-    # Per-cell sender honesty (cells are static per trial).
-    honest_cells = honest[
-        jnp.arange(cfg.n_lieutenants * cfg.slots) // cfg.slots + 2
-    ].astype(jnp.int32)[:, None]
+    honest_cells = honest_cells_fn(honest, cfg)
 
     def round_body(carry, round_idx):
         vi_i32, pool = carry

@@ -131,6 +131,49 @@ class TestPartySharded:
         spmd = run_trials_spmd(cfg, mesh)
         assert_trials_equal(spmd, ref)
 
+    def test_spmd_auto_engine_failure_degrades_to_xla(self, n_devices, monkeypatch):
+        # The probe-context gap (ADVICE r2 item 1 residual): a kernel
+        # engine that passed its standalone compile probe can still fail
+        # under the real shard_map context.  Auto-selected engines must
+        # degrade loudly to the XLA branch; forced engines must raise.
+        import warnings as _warnings
+
+        import qba_tpu.parallel.spmd as spmd_mod
+
+        cfg = QBAConfig(n_parties=5, size_l=8, n_dishonest=2, trials=4, seed=11)
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        ref = run_trials(cfg)
+
+        real_batch = spmd_mod._spmd_batch
+        engines_tried = []
+
+        def failing_batch(cfg_, mesh_, keys_, engine="xla"):
+            engines_tried.append(engine)
+            if engine != "xla":
+                raise RuntimeError("forced shard_map compile failure")
+            return real_batch(cfg_, mesh_, keys_, engine)
+
+        monkeypatch.setattr(spmd_mod, "_spmd_batch", failing_batch)
+        # Auto path: force the resolver to pick a kernel engine.
+        monkeypatch.setattr(
+            spmd_mod, "_resolve_spmd_engine", lambda c, n: "pallas_tiled"
+        )
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            out = spmd_mod.run_trials_spmd(cfg, mesh)
+        assert engines_tried == ["pallas_tiled", "xla"]
+        assert any("falling back" in str(w.message) for w in caught)
+        assert_trials_equal(out, ref)
+
+        # Forced path: the explicit knob must raise, never downgrade.
+        import dataclasses
+
+        cfg_forced = dataclasses.replace(cfg, round_engine="pallas_tiled")
+        engines_tried.clear()
+        with pytest.raises(RuntimeError, match="forced shard_map"):
+            spmd_mod.run_trials_spmd(cfg_forced, mesh)
+        assert engines_tried == ["pallas_tiled"]
+
     def test_indivisible_lieutenants_rejected(self, n_devices):
         cfg = QBAConfig(n_parties=4, size_l=4, trials=n_devices)  # 3 lieutenants
         mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
@@ -142,6 +185,101 @@ class TestPartySharded:
         mesh = make_mesh({"dp": n_devices})
         with pytest.raises(ValueError, match="'tp' mesh axis"):
             run_trials_spmd(cfg, mesh)
+
+
+class TestPartyShardedTiled:
+    """The packet-tiled engine's party-sharded variant (round 4,
+    VERDICT r3 item 1): per-device local pools with global cell ids,
+    one tp all_gather per round, local-receiver verdict + rebuild
+    kernels.  Must be bit-identical to the single-device XLA engine —
+    placement is never semantics."""
+
+    def _cfg(self, **kw):
+        base = dict(
+            n_parties=5, size_l=8, n_dishonest=2, trials=4, seed=11,
+            round_engine="pallas_tiled", tiled_block=8,
+        )
+        base.update(kw)
+        return QBAConfig(**base)
+
+    def _ref(self, cfg):
+        import dataclasses
+
+        return run_trials(
+            dataclasses.replace(cfg, round_engine="xla", tiled_block=None)
+        )
+
+    def test_tp_tiled_matches_xla(self, n_devices):
+        cfg = self._cfg()
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        assert_trials_equal(run_trials_spmd(cfg, mesh), self._ref(cfg))
+
+    def test_tp_tiled_matches_single_device_tiled(self, n_devices):
+        # Transitivity check straight against the single-device TILED
+        # engine (not just XLA): same pool algebra, different sharding.
+        cfg = self._cfg(seed=3, n_dishonest=3)
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        assert_trials_equal(run_trials_spmd(cfg, mesh), run_trials(cfg))
+
+    def test_tp_tiled_broadcast_scope_and_racy(self, n_devices):
+        cfg = self._cfg(
+            attack_scope="broadcast", delivery="racy", p_late=0.4,
+            seed=12,
+        )
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        assert_trials_equal(run_trials_spmd(cfg, mesh), self._ref(cfg))
+
+    def test_tp4_single_receiver_blocks(self, n_devices):
+        # n_local = 1: one receiver per device — the lane-group and
+        # prefix-sum edge cases of the local kernel variants.
+        if n_devices < 4:
+            pytest.skip("needs >= 4 devices")
+        cfg = self._cfg(n_dishonest=3, trials=2, seed=5)
+        mesh = make_mesh({"dp": n_devices // 4, "tp": 4})
+        assert_trials_equal(run_trials_spmd(cfg, mesh), self._ref(cfg))
+
+    def test_northstar_scale_tp4_matches_single_device(self, n_devices):
+        # THE round-4 acceptance criterion (VERDICT r3 item 1): the
+        # flagship 33p/64/10 lossless config, lieutenants sharded 4-way,
+        # bit-identical to the single-device tiled engine.  2 trials
+        # keep the interpret-mode kernels tractable on CPU.
+        if n_devices < 4:
+            pytest.skip("needs >= 4 devices")
+        cfg = QBAConfig(
+            n_parties=33, size_l=64, n_dishonest=10, trials=2, seed=3,
+            round_engine="pallas_tiled", tiled_block=256,
+        )
+        mesh = make_mesh({"dp": n_devices // 4, "tp": 4})
+        spmd = run_trials_spmd(cfg, mesh)
+        ref = run_trials(cfg)
+        assert_trials_equal(spmd, ref)
+        assert not bool(np.asarray(ref.trials.overflow).any())  # lossless
+
+    def test_tp_tiled_xla_rebuild_fallback(self, n_devices, monkeypatch):
+        # Forcing the Pallas rebuild plan away exercises the local
+        # XLA rebuild_pool variant under shard_map.
+        import qba_tpu.parallel.spmd as spmd_mod
+
+        monkeypatch.setattr(
+            spmd_mod, "_resolve_spmd_engine", lambda c, n: "pallas_tiled"
+        )
+        import qba_tpu.ops.round_kernel_tiled as rkt
+
+        monkeypatch.setattr(
+            rkt, "resolve_rebuild_block", lambda c, n_recv=None: None
+        )
+        cfg = self._cfg(round_engine="auto")
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        import warnings as _warnings
+
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            out = run_trials_spmd(cfg, mesh)
+        # The XLA-rebuild path itself must succeed — a silent engine
+        # downgrade through the exception fallback would make this
+        # equivalence vacuous.
+        assert not any("falling back" in str(w.message) for w in caught)
+        assert_trials_equal(out, self._ref(cfg))
 
 
 class TestMeshHelpers:
