@@ -20,11 +20,12 @@ from qba_tpu.config import QBAConfig
 # BASELINE.md config 5 as written (the "north star": nParties=33,
 # sizeL=64, nDishonest=10, lossless), 1000 trials — THE shared literal
 # for both gate surfaces (cli `--preset northstar` and bench.py's
-# embedded gate metric).  250-trial chunks: the 33-party lossless pool
-# exceeds HBM in one batch (docs/PERF.md), and measured throughput is
-# flat across 125/250/500 chunks (~6.2k rounds/s, honest fence).
+# embedded gate metric).  Single batch: the round-4 pool donation +
+# meta packing fit the whole 1000-trial batch in HBM (ceiling now
+# >= 1024, docs/PERF.md round 4), and one batch measures ~33% faster
+# than the round-3 250-trial chunking (9.9k vs 7.4k rounds/s honest).
 NORTHSTAR = dict(n_parties=33, size_l=64, n_dishonest=10, trials=1000)
-NORTHSTAR_CHUNK = 250
+NORTHSTAR_CHUNK = 1000
 
 
 def measure_batch(

@@ -51,10 +51,9 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
         "--round-engine",
         choices=("auto", "xla", "pallas", "pallas_tiled"), default="auto",
         help="voting-round engine: auto = the fastest engine that "
-        "compiles for this config (packet-tiled kernel first at "
-        "size_l >= 256, fused monolithic kernel first below that, "
-        "pure XLA as the final fallback); all engines are "
-        "bit-identical",
+        "compiles for this config (packet-tiled kernel first, fused "
+        "monolithic kernel second, pure XLA as the final fallback); "
+        "all engines are bit-identical",
     )
     p.add_argument(
         "--delivery", choices=("sync", "racy"), default="sync",

@@ -41,12 +41,10 @@ class QBAConfig:
         (``v not in Vi``, ``tfg.py:294``), so ``w`` is a universal bound;
         smaller values trade memory for a recorded overflow flag.
       round_engine: "auto" (default — the fastest engine that compiles
-        for this config; the preference order depends on the position
-        axis: at ``size_l >= 256`` the packet-tiled kernel goes first
-        (its skip-empty-blocks structure wins on wide lists, ~11% at
-        the reference's sizeL=1000), below that the fused monolithic
-        Pallas round kernel goes first (~5-10% faster at the headline
-        config); pure XLA is always the final fallback — see
+        for this config: the packet-tiled kernel first (after the
+        round-4 pool work it wins at every measured scale, 12-110% —
+        docs/PERF.md), the fused monolithic Pallas round kernel
+        second, pure XLA as the final fallback — see
         :func:`qba_tpu.rounds.engine.resolve_round_engine`), "xla",
         "pallas" (forces the monolithic kernel; interpreter mode
         off-TPU), or "pallas_tiled" (forces the tiled engine —

@@ -525,7 +525,10 @@ def _probe_cache_path() -> str:
     )
 
 
-_PROBE_VERSION = 5  # bump when kernel structure/compiler params change
+_PROBE_VERSION = 6  # bump when kernel structure/compiler params change
+# v6: tiled kernels take the meta-packed pool (count/v/sent/cell in one
+# [cap, 4] tensor) + donation; block ordering recalibrated on honest
+# timings (docs/PERF.md round 4 erratum).
 
 
 def _probe_disk_key(kernel: str, cfg: QBAConfig, extra: str = "") -> str:
@@ -583,6 +586,28 @@ def _probe_disk_put(key: str, value) -> None:
             _os.replace(tmp, path)
     except Exception:
         pass  # cache is best-effort
+
+
+# Transient-error classification for compile probes: a remote-tunnel
+# helper crash (HTTP 500 / dead subprocess / deadline) is NOT a verdict
+# about the kernel shape — caching it as "does not compile" silently
+# pins a config to a slower engine forever (observed: a flaky helper
+# crash cached tiled-verdict=-1 for the north-star shape, dropping auto
+# to the XLA engine which then OOM'd at the new single-batch size).
+# Transient failures retry once and are never persisted to disk.
+_TRANSIENT_ERR_MARKERS = (
+    "remote_compile",
+    "HTTP 5",
+    "subprocess exit",
+    "DEADLINE",
+    "UNAVAILABLE",
+    "Connection",
+)
+
+
+def probe_error_transient(e: Exception) -> bool:
+    s = repr(e)
+    return any(m in s for m in _TRANSIENT_ERR_MARKERS)
 
 
 # Pre-filter bound for the compile probe.  The real gate is a one-time
@@ -681,7 +706,7 @@ def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
     def shp(*dims):
         return jax.ShapeDtypeStruct(dims, i32)
 
-    try:
+    def compile_probe():
         step = build_round_step(cfg, n_recv=n_recv)
         n_in = 12  # operands after the round-idx scalar
         off = ()
@@ -703,20 +728,37 @@ def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
             bshp(n_rv, s), bshp(n_rv, w), bshp(n_pk, 1),  # li, vi, honest
             bshp(n_pk, n_rv), bshp(n_pk, n_rv), bshp(n_pk, n_rv),  # draws
         ).compile()
+
+    ok, transient = False, False
+    try:
+        compile_probe()
         ok = True
     except Exception as e:  # compile failures only reach here (no execution)
-        # Loud on purpose: a genuine VMEM overflow and a transient
-        # tunnel/infrastructure error both land here, and the fallback
-        # costs up to ~26x (docs/PERF.md) — the operator should see why.
-        warnings.warn(
-            "round kernel compile probe failed for "
-            f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
-            f"slots={cfg.slots}); falling back to the XLA round engine "
-            f"for this config: {e!r:.500}",
-            RuntimeWarning,
-            stacklevel=2,
-        )
-        ok = False
-    _PROBE_CACHE[key] = ok
-    _probe_disk_put(dkey, int(ok))
+        if probe_error_transient(e):
+            transient = True
+            try:  # one retry: helper crashes are not shape verdicts
+                compile_probe()
+                ok, transient = True, False
+            except Exception as e2:
+                e = e2
+        if not ok:
+            # Loud on purpose: a genuine VMEM overflow and a transient
+            # tunnel/infrastructure error both land here, and the
+            # fallback costs up to ~26x (docs/PERF.md) — the operator
+            # should see why.
+            warnings.warn(
+                "round kernel compile probe failed for "
+                f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
+                f"slots={cfg.slots}); falling back to the XLA round "
+                f"engine for this config: {e!r:.500}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+    if ok or not transient:
+        # Never cache transient failures — not even in-process: a flaky
+        # tunnel minute must not pin this config to the slow engine for
+        # the process lifetime.  The cost is a re-probe on the next
+        # call, which is exactly the desired retry.
+        _PROBE_CACHE[key] = ok
+        _probe_disk_put(dkey, int(ok))
     return ok

@@ -152,9 +152,9 @@ def build_verdict_kernel(
         else:
             r_off = 0
         (
-            vals_ref, lens_ref, count_ref, p_ref, v_ref, sent_ref,
-            cell_ref, vi_ref, honest_ref, act_ref, rv_ref,
-            late_ref, e_ref, lip_ref, lioob_ref, acc_ref, ovi_ref,
+            vals_ref, lens_ref, p_ref, meta_ref, vi_ref, honest_ref,
+            act_ref, rv_ref, late_ref, e_ref, lip_ref, lioob_ref,
+            acc_ref, ovi_ref,
         ) = refs
 
         r_idx = scalar_read(round_ref)
@@ -164,9 +164,12 @@ def build_verdict_kernel(
         def _init_vi():
             ovi_ref[:] = vi_ref[:]
 
-        # Compacted pool: the block is all-empty iff its first sent flag
-        # is zero (occupied entries are contiguous from position 0).
-        block_live = jnp.sum(sent_ref[:]) > 0
+        # Block-skip: all-empty blocks (zero sent flags — the pool is
+        # compacted, per device segment in the sharded case) skip all
+        # verdict compute.
+        block_live = (
+            jnp.sum(meta_ref[:, META_SENT : META_SENT + 1]) > 0
+        )
 
         @pl.when(jnp.logical_not(block_live))
         def _skip():
@@ -175,29 +178,44 @@ def build_verdict_kernel(
         @pl.when(block_live)
         def _verdict():
             idx_col = jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
-            cell_col = cell_ref[:]  # [blk, 1]
+            meta = meta_ref[:]  # [blk, 4] packed per-packet columns
+            cnt_col = meta[:, META_COUNT : META_COUNT + 1]
+            v_col = meta[:, META_V : META_V + 1]
+            cell_col = meta[:, META_CELL : META_CELL + 1]
             sender_col = cell_col // slots  # [blk, 1]
             vals = [
                 vals_ref[r].astype(jnp.int32) for r in range(max_l)
             ]  # each [blk, size_l]
-            sent = sent_ref[:] != 0  # [blk, 1]
+            sent = meta[:, META_SENT : META_SENT + 1] != 0  # [blk, 1]
 
             # ---- Draw selection: cell-ordered -> this block's rows -------
             # One-hot over mailbox cell ids (exact: ids < n_pool; values
             # <= 15 / < w / 0-1 are gdt-exact), like the rebuild kernel.
+            # The draw tables arrive receiver-major [n_rv, n_cells] — a
+            # [n_cells, n_rv] layout pads its n_rv minor dim to 128
+            # lanes (4x the HBM/DMA at n_rv=32); the transposed layout
+            # is pad-free and the MXU contracts the rhs's dim 1
+            # directly (an NT matmul — no in-kernel transpose).
             iota_cells = jax.lax.broadcasted_iota(
                 jnp.int32, (blk, n_pool), 1
             )
             oh_cell = jnp.where(iota_cells == cell_col, 1.0, 0.0).astype(gdt)
 
-            def cell_mm(tbl):
+            def cell_mm(tbl_t):  # [n_rv, n_cells] -> [blk, n_rv]
+                return jax.lax.dot_general(
+                    oh_cell, tbl_t.astype(gdt),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            def cell_col_mm(tbl):  # [n_cells, 1] column -> [blk, 1]
                 return jax.lax.dot_general(
                     oh_cell, tbl.astype(gdt),
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
 
-            biz = cell_mm(honest_ref[:]).astype(jnp.int32) == 0  # [blk, 1]
+            biz = cell_col_mm(honest_ref[:]).astype(jnp.int32) == 0
 
             # ---- All-receiver flag algebra -------------------------------
             act_all = cell_mm(act_ref[:]).astype(jnp.int32)  # [blk, n_rv]
@@ -211,14 +229,14 @@ def build_verdict_kernel(
             )
             dropped_all = biz & ((act_all & DROP_BIT) != 0)
             v2_all = jnp.where(biz & ((act_all & FORGE_BIT) != 0),
-                               rv_all, v_ref[:])
+                               rv_all, v_col)
             clearp_all = biz & ((act_all & CLEAR_P_BIT) != 0)
             clearl_all = biz & ((act_all & CLEAR_L_BIT) != 0)
             delivered_all = (
                 ~dropped_all & (late_all == 0) & sent
                 & (sender_col != lane_recv)
             )
-            count_eff_all = jnp.where(clearl_all, 0, count_ref[:])
+            count_eff_all = jnp.where(clearl_all, 0, cnt_col)
 
             # The shared per-group acceptance flag algebra
             # (ops/verdict_algebra.py — one implementation for both
@@ -226,7 +244,7 @@ def build_verdict_kernel(
             va = VerdictAlgebra(
                 n_p=blk, grp=grp, seg_l=seg_l, max_l=max_l,
                 size_l=size_l, w=w, gdt=gdt,
-                vals=vals, lens=lens_ref[:], count=count_ref[:],
+                vals=vals, lens=lens_ref[:], count=cnt_col,
                 p_i32=p_ref[:].astype(jnp.int32),
                 e_vals=e_ref[:], lip_vals=lip_ref[:],
                 lioob_vals=lioob_ref[:], r_idx=r_idx,
@@ -264,16 +282,13 @@ def build_verdict_kernel(
     ) + [
         pl.BlockSpec((max_l, blk, size_l), lambda i: (0, i, 0)),  # vals
         pl.BlockSpec((blk, max_l), blkmap),  # lens
-        pl.BlockSpec((blk, 1), blkmap),  # count
         pl.BlockSpec((blk, size_l), blkmap),  # p
-        pl.BlockSpec((blk, 1), blkmap),  # v
-        pl.BlockSpec((blk, 1), blkmap),  # sent
-        pl.BlockSpec((blk, 1), blkmap),  # cell
+        pl.BlockSpec((blk, 4), blkmap),  # meta (count, v, sent, cell)
         pl.BlockSpec((n_rv, w), lambda i: (0, 0)),  # vi
         pl.BlockSpec((n_pool, 1), lambda i: (0, 0)),  # honest_cells
-        pl.BlockSpec((n_pool, n_rv), lambda i: (0, 0)),  # attack (cells)
-        pl.BlockSpec((n_pool, n_rv), lambda i: (0, 0)),  # rand_v (cells)
-        pl.BlockSpec((n_pool, n_rv), lambda i: (0, 0)),  # late (cells)
+        pl.BlockSpec((n_rv, n_pool), lambda i: (0, 0)),  # attack^T
+        pl.BlockSpec((n_rv, n_pool), lambda i: (0, 0)),  # rand_v^T
+        pl.BlockSpec((n_rv, n_pool), lambda i: (0, 0)),  # late^T
         pl.BlockSpec((grp, seg_l), lambda i: (0, 0)),  # e_mat
         pl.BlockSpec((len(r0_list), seg_l), lambda i: (0, 0)),  # lip
         pl.BlockSpec((len(r0_list), seg_l), lambda i: (0, 0)),  # lioob
@@ -294,6 +309,11 @@ def build_verdict_kernel(
         ),
         in_specs=in_specs,
         out_specs=out_specs,
+        # vi donates into ovi: the round step is a lax.scan body, and an
+        # un-aliased carry costs a copy per round (see the monolithic
+        # kernel's aliasing note).  Safe: vi_ref is copied into the
+        # revisited ovi block at grid step 0 and only ovi is read after.
+        input_output_aliases={(2 if local else 1) + 4: 1},
         compiler_params=pltpu.CompilerParams(
             # See build_rebuild_kernel: large vmap batches multi-buffer
             # operands past the compiler's ~16 MB default scoped cap.
@@ -314,29 +334,32 @@ def build_verdict_kernel(
 
     if local:
 
-        def verdict(round_idx, recv_off, vals, lens, count, p, v, sent,
-                    cell, li, vi, honest_pk, attack, rand_v, late):
+        def verdict(round_idx, recv_off, vals, lens, p, meta, li, vi,
+                    honest_pk, attack, rand_v, late):
             # Pool operands are GLOBAL; li/vi/draw columns are the local
-            # receiver block's; recv_off is its first receiver.
+            # receiver block's; recv_off is its first receiver.  The
+            # cell-major draws transpose to the kernel's pad-free
+            # receiver-major layout here (XLA fuses the transpose into
+            # the sampling producer).
             args = (
                 jnp.asarray([round_idx], jnp.int32),
                 jnp.asarray(recv_off, jnp.int32).reshape(1),
-                vals, lens, count, p, v, sent, cell, vi, honest_pk,
-                attack, rand_v, late, *_tail(li),
+                vals, lens, p, meta, vi, honest_pk,
+                attack.T, rand_v.T, late.T, *_tail(li),
             )
             return call(*map(_pv, args))
 
     else:
 
-        def verdict(round_idx, vals, lens, count, p, v, sent, cell,
-                    li, vi, honest_pk, attack, rand_v, late):
+        def verdict(round_idx, vals, lens, p, meta, li, vi,
+                    honest_pk, attack, rand_v, late):
             # li itself is consumed host-side (the lane-packed lip/lioob
             # tables carry its data); the kernel takes only the tables.
             e_mat, lip, lioob = _tail(li)
             return call(
                 jnp.asarray([round_idx], jnp.int32),
-                vals, lens, count, p, v, sent, cell, vi, honest_pk,
-                attack, rand_v, late, e_mat, lip, lioob,
+                vals, lens, p, meta, vi, honest_pk,
+                attack.T, rand_v.T, late.T, e_mat, lip, lioob,
             )
 
     return verdict
@@ -365,12 +388,21 @@ def honest_cells(honest, cfg: QBAConfig):
     ].astype(jnp.int32)[:, None]
 
 
+# Lanes of the pool's packed per-packet meta column (ONE [cap, 4] int32
+# tensor instead of four [cap, 1] columns: a narrow minor dim pads to a
+# full 128-lane tile either way, so four separate columns cost 4x the
+# HBM/DMA of one packed tensor — ~4 MB/trial/round at the 33-party
+# scale, in BOTH kernels' operands and the rebuild's outputs).
+META_COUNT, META_V, META_SENT, META_CELL = 0, 1, 2, 3
+
+
 def empty_pool(cfg: QBAConfig, n_recv: int | None = None):
-    """The compacted packet pool: ``(vals, lens, count, p, v, sent,
-    cell)``, capacity ``n_lieutenants * slots`` (the lossless bound —
-    each receiver accepts at most ``slots <= w`` packets per round).
-    ``n_recv`` sizes a party-sharded LOCAL pool (capacity
-    ``n_recv * slots`` — one device's senders)."""
+    """The compacted packet pool: ``(vals, lens, p, meta)`` with
+    ``meta[:, META_*] = (count, v, sent, cell)``, capacity
+    ``n_lieutenants * slots`` (the lossless bound — each receiver
+    accepts at most ``slots <= w`` packets per round).  ``n_recv``
+    sizes a party-sharded LOCAL pool (capacity ``n_recv * slots`` —
+    one device's senders)."""
     n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
     slots, max_l, s = cfg.slots, cfg.max_l, cfg.size_l
     cap = n_rv * slots
@@ -378,11 +410,8 @@ def empty_pool(cfg: QBAConfig, n_recv: int | None = None):
     return (
         jnp.full((max_l, cap, s), SENTINEL, vdt),
         jnp.zeros((cap, max_l), jnp.int32),
-        jnp.zeros((cap, 1), jnp.int32),
         jnp.zeros((cap, s), vdt),
-        jnp.zeros((cap, 1), jnp.int32),
-        jnp.zeros((cap, 1), jnp.int32),
-        jnp.zeros((cap, 1), jnp.int32),
+        jnp.zeros((cap, 4), jnp.int32),
     )
 
 
@@ -415,14 +444,20 @@ def pool_from_step3a(cfg: QBAConfig, out_cells, *, start=None,
         o_vals[:, 0].astype(vdt), mode="drop"
     ).transpose(1, 0, 2)
     cell_ids = (base + jnp.arange(n_rv, dtype=jnp.int32)) * slots
+    meta_rows = jnp.stack(
+        [
+            o_count[:, 0],
+            o_v[:, 0],
+            jnp.ones((n_rv,), jnp.int32),
+            cell_ids,
+        ],
+        axis=1,
+    )
     return (
         vals_p,
         scat(pool[1], o_lens[:, 0]),
-        scat(pool[2], o_count[:, 0][:, None]),
-        scat(pool[3], o_p[:, 0].astype(vdt)),
-        scat(pool[4], o_v[:, 0][:, None]),
-        scat(pool[5], jnp.ones((n_rv, 1), jnp.int32)),
-        scat(pool[6], cell_ids[:, None]),
+        scat(pool[2], o_p[:, 0].astype(vdt)),
+        scat(pool[3], meta_rows),
     )
 
 
@@ -447,7 +482,9 @@ def rebuild_pool(cfg: QBAConfig, round_idx, pool, li, acc,
     n_rv = n_recv if n_recv is not None else n_rv_glob
     n_out = n_rv * slots  # this block's output pool capacity
     base = 0 if start is None else start
-    vals, lens, count, p, v, sent, _cell = pool
+    vals, lens, p, meta = pool
+    count = meta[:, META_COUNT : META_COUNT + 1]
+    v = meta[:, META_V : META_V + 1]
     biz = honest_pool == 0  # [n_pool, 1]
     clear_p = biz & ((attack_pool & CLEAR_P_BIT) != 0)  # [n_pool, n_rv]
     clear_l = biz & ((attack_pool & CLEAR_L_BIT) != 0)
@@ -544,10 +581,8 @@ def rebuild_pool(cfg: QBAConfig, round_idx, pool, li, acc,
     o_count = jnp.where(has, new_cnt, 0)
     o_p = jnp.where(has, p2, False).astype(vdt)
     o_v = jnp.where(has, v2_c, 0)
-    return (
-        (o_vals.astype(vdt), o_lens, o_count, o_p, o_v, new_sent, new_cell),
-        overflow,
-    )
+    o_meta = jnp.concatenate([o_count, o_v, new_sent, new_cell], axis=1)
+    return (o_vals.astype(vdt), o_lens, o_p, o_meta), overflow
 
 
 def build_rebuild_kernel(
@@ -626,10 +661,9 @@ def build_rebuild_kernel(
         else:
             r_off = 0
         (
-            vals_ref, lens_ref, count_ref, p_ref, v_ref, cell_ref,
+            vals_ref, lens_ref, p_ref, meta_ref,
             li_ref, acc_ref, accT_ref, att_ref, rv_ref, hon_ref,
-            ovals_ref, olens_ref, ocount_ref, op_ref, ov_ref,
-            osent_ref, ocell_ref, ovf_ref,
+            ovals_ref, olens_ref, op_ref, ometa_ref, ovf_ref,
             wT_scr, sT_scr, lane_scr,
         ) = refs
 
@@ -680,11 +714,8 @@ def build_rebuild_kernel(
                 (max_l, blk_d, size_l), SENTINEL, vdt
             )
             olens_ref[:] = jnp.zeros((blk_d, max_l), jnp.int32)
-            ocount_ref[:] = jnp.zeros((blk_d, 1), jnp.int32)
             op_ref[:] = jnp.zeros((blk_d, size_l), vdt)
-            ov_ref[:] = jnp.zeros((blk_d, 1), jnp.int32)
-            osent_ref[:] = jnp.zeros((blk_d, 1), jnp.int32)
-            ocell_ref[:] = jnp.zeros((blk_d, 1), jnp.int32)
+            ometa_ref[:] = jnp.zeros((blk_d, 4), jnp.int32)
 
         @pl.when(bd >= total)
         def _skip():
@@ -734,14 +765,19 @@ def build_rebuild_kernel(
                 gmm(vals_ref[r]).astype(jnp.int32) for r in range(max_l)
             ]
             lens_g = gmm(lens_ref[:]).astype(jnp.int32)  # [blk_d, max_l]
-            cnt_g = gmm(count_ref[:]).astype(jnp.int32)  # [blk_d, 1]
-            v_g = gmm(v_ref[:]).astype(jnp.int32)
             p_g = gmm(p_ref[:]).astype(jnp.int32)  # [blk_d, size_l]
-            # cell ids reach n_pool-1 > 256: f32 operands keep them exact.
-            cell_g = gmm(cell_ref[:], jnp.float32).astype(jnp.int32)
+            # One gather for all packed per-packet columns; f32 operands
+            # because cell ids reach n_pool-1 > 256 (bf16-inexact).
+            meta_g = gmm(meta_ref[:], jnp.float32).astype(jnp.int32)
+            cnt_g = meta_g[:, META_COUNT : META_COUNT + 1]
+            v_g = meta_g[:, META_V : META_V + 1]
+            cell_g = meta_g[:, META_CELL : META_CELL + 1]
 
             # (cell, receiver) corruption draws: one-hot over cell ids
             # (values < n_pool, f32-exact), then lane-select receiver.
+            # Draw tables are receiver-major [n_rv, n_cells] (pad-free;
+            # the MXU contracts the rhs's dim 1 — see the verdict
+            # kernel's layout note); the honesty column stays cell-major.
             iota_cells = jax.lax.broadcasted_iota(
                 jnp.int32, (blk_d, n_pool), 1
             )
@@ -749,7 +785,14 @@ def build_rebuild_kernel(
                 iota_cells == cell_g, 1.0, 0.0
             ).astype(gdt)
 
-            def cell_mm(tbl, dt=gdt):
+            def cell_mm(tbl_t, dt=gdt):  # [n_rv, n_cells] -> [blk_d, n_rv]
+                return jax.lax.dot_general(
+                    oh_cell.astype(dt), tbl_t.astype(dt),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+            def cell_col_mm(tbl, dt=gdt):  # [n_cells, 1] -> [blk_d, 1]
                 return jax.lax.dot_general(
                     oh_cell.astype(dt), tbl.astype(dt),
                     (((1,), (0,)), ((), ())),
@@ -764,7 +807,7 @@ def build_rebuild_kernel(
             rv_c = jnp.sum(
                 rv_rows * oh_f.astype(jnp.float32), axis=1, keepdims=True
             ).astype(jnp.int32)
-            hon_c = cell_mm(hon_ref[:]).astype(jnp.int32)  # [blk_d, 1]
+            hon_c = cell_col_mm(hon_ref[:]).astype(jnp.int32)  # [blk_d, 1]
 
             biz = hon_c == 0
             clearp_c = biz & ((att_c & CLEAR_P_BIT) != 0)
@@ -806,13 +849,21 @@ def build_rebuild_kernel(
                     is_new, own, jnp.where(keep, rows_g[r], SENTINEL)
                 )
                 ovals_ref[r] = jnp.where(has, row, SENTINEL).astype(vdt)
-            ocount_ref[:] = jnp.where(has, new_cnt, 0)
             op_ref[:] = jnp.where(has & p2, 1.0, 0.0).astype(vdt)
-            ov_ref[:] = jnp.where(has, v2_c, 0)
-            osent_ref[:] = jnp.where(has, 1, 0)
-            # Global cell id: the accepting receiver's GLOBAL index.
-            ocell_ref[:] = jnp.where(
-                has, (r_off + r_j) * slots + slot_lane, 0
+            # Packed next-round meta: count, v, sent, and the GLOBAL
+            # cell id (the accepting receiver's global index).
+            ometa_ref[:] = jnp.where(
+                has,
+                jnp.concatenate(
+                    [
+                        new_cnt,
+                        v2_c,
+                        jnp.ones((blk_d, 1), jnp.int32),
+                        (r_off + r_j) * slots + slot_lane,
+                    ],
+                    axis=1,
+                ),
+                0,
             )
 
     full = lambda i: (0, 0)  # noqa: E731 — constant index map (resident)
@@ -828,25 +879,20 @@ def build_rebuild_kernel(
     ) + [
         pl.BlockSpec((max_l, n_pool, size_l), full3),  # vals
         pl.BlockSpec((n_pool, max_l), full),  # lens
-        pl.BlockSpec((n_pool, 1), full),  # count
         pl.BlockSpec((n_pool, size_l), full),  # p
-        pl.BlockSpec((n_pool, 1), full),  # v
-        pl.BlockSpec((n_pool, 1), full),  # cell
+        pl.BlockSpec((n_pool, 4), full),  # meta (count, v, sent, cell)
         pl.BlockSpec((n_rv, size_l), full),  # li
         pl.BlockSpec((n_pool, n_rv), full),  # acc
         pl.BlockSpec((n_rv, n_pool), full),  # accT
-        pl.BlockSpec((n_pool, n_rv), full),  # attack (cell-ordered)
-        pl.BlockSpec((n_pool, n_rv), full),  # rand_v (cell-ordered)
+        pl.BlockSpec((n_rv, n_pool), full),  # attack^T (receiver-major)
+        pl.BlockSpec((n_rv, n_pool), full),  # rand_v^T (receiver-major)
         pl.BlockSpec((n_pool, 1), full),  # honest_cells
     ]
     out_specs = (
         pl.BlockSpec((max_l, blk_d, size_l), lambda i: (0, i, 0)),  # vals
         pl.BlockSpec((blk_d, max_l), dmap),  # lens
-        pl.BlockSpec((blk_d, 1), dmap),  # count
         pl.BlockSpec((blk_d, size_l), dmap),  # p
-        pl.BlockSpec((blk_d, 1), dmap),  # v
-        pl.BlockSpec((blk_d, 1), dmap),  # sent
-        pl.BlockSpec((blk_d, 1), dmap),  # cell
+        pl.BlockSpec((blk_d, 4), dmap),  # meta
         pl.BlockSpec((1, 1), lambda i: (0, 0)),  # overflow
     )
     from qba_tpu.ops.round_kernel import promote_vma, vma_struct
@@ -860,15 +906,25 @@ def build_rebuild_kernel(
         out_shape=(
             oshp(max_l, n_out, size_l, dt=vdt),
             oshp(n_out, max_l),
-            oshp(n_out, 1),
             oshp(n_out, size_l, dt=vdt),
-            oshp(n_out, 1),
-            oshp(n_out, 1),
-            oshp(n_out, 1),
+            oshp(n_out, 4),
             oshp(1, 1),
         ),
         in_specs=in_specs,
         out_specs=out_specs,
+        # The pool donates into the next-round pool (scan carry):
+        # vals/lens/p/meta -> ovals/olens/op/ometa.  Without the aliases
+        # XLA rebuilds the carry with a full pool copy per round
+        # (measured ~83 ms of a 480 ms 250-trial north-star batch) and
+        # keeps two resident pool generations in HBM.  Safe: the source
+        # operands have constant index maps — fetched to VMEM before the
+        # first destination block writes back — and the caller never
+        # reuses the donated arrays after this call.  The party-sharded
+        # variant cannot alias (gathered global pool in, local pool
+        # out — different shapes).
+        input_output_aliases=(
+            {} if local else {1: 0, 2: 1, 3: 2, 4: 3}
+        ),
         scratch_shapes=[
             pltpu.VMEM((n_rv, n_pool), jnp.int32),  # wT
             pltpu.VMEM((n_rv, n_pool), jnp.int32),  # sT (clamped slots)
@@ -888,28 +944,27 @@ def build_rebuild_kernel(
 
     if local:
 
-        def rebuild(round_idx, recv_off, vals, lens, count, p, v, cell,
+        def rebuild(round_idx, recv_off, vals, lens, p, meta,
                     li, acc, attack, rand_v, honest_cells):
             args = (
                 jnp.asarray([round_idx], jnp.int32),
                 jnp.asarray(recv_off, jnp.int32).reshape(1),
-                vals, lens, count, p, v, cell, li, acc,
-                acc.T, attack, rand_v, honest_cells,
+                vals, lens, p, meta, li, acc,
+                acc.T, attack.T, rand_v.T, honest_cells,
             )
             out = call(*map(_pv, args))
-            return out[:7], out[7][0, 0] > 0
+            return out[:4], out[4][0, 0] > 0
 
     else:
 
-        def rebuild(round_idx, vals, lens, count, p, v, cell, li, acc,
+        def rebuild(round_idx, vals, lens, p, meta, li, acc,
                     attack, rand_v, honest_cells):
             out = call(
                 jnp.asarray([round_idx], jnp.int32),
-                vals, lens, count, p, v, cell, li, acc,
-                acc.T, attack, rand_v, honest_cells,
+                vals, lens, p, meta, li, acc,
+                acc.T, attack.T, rand_v.T, honest_cells,
             )
-            pool_new = out[:7]
-            return pool_new, out[7][0, 0] > 0
+            return out[:4], out[4][0, 0] > 0
 
     return rebuild
 
@@ -951,14 +1006,20 @@ def _block_estimate(cfg: QBAConfig, blk: int,
 
 
 def _preferred_block(cfg: QBAConfig) -> int:
-    """Measured sweet spot for the packet-block size: roughly the
-    typical number of LIVE pool entries per round (~2 accepts per
-    receiver), floored at a tile-friendly 32.  Block-size sweeps at the
-    33-party north star and the reference's sizeL=1000 config both
-    peaked near this value and lost 10-16% at the largest compiling
-    candidate (docs/PERF.md round 3): the per-step fixed cost is small,
-    so finer blocks skip dead pool capacity more precisely."""
-    return max(2 * cfg.n_lieutenants, 32)
+    """Measured sweet spot for the packet-block size.
+
+    Round-4 HONEST sweeps (after the chunked-timing erratum,
+    docs/PERF.md) at 1000/256-trial single batches: the 33-party north
+    star peaks at blk=128 (8 932 rounds/s vs 8 579 at 64 and 7 143 at
+    512) and the reference-scale 11p/sizeL=1000 at blk=80 (11 190 vs
+    9 712 at 8).  A flat preferred value of 96 makes the log2-distance
+    ordering pick the measured winner in both sweeps — finer blocks
+    skip dead pool capacity, coarser blocks amortize the per-grid-step
+    fixed cost; ~100 packets balances the two at both scales.
+    Two-point calibrated (same caveat as the auto engine flip point):
+    configs far from these two scales get the nearest candidate, with
+    measured stakes of ~5-20% across the swept range."""
+    return 96
 
 
 def _order_candidates(cands: list[int], preferred: int) -> list[int]:
@@ -1002,8 +1063,9 @@ def _rebuild_estimate(cfg: QBAConfig, blk_d: int,
         vb * max_l * n_pool * s  # vals
         + vb * n_pool * s  # p
         + 4 * n_pool * max_l  # lens
-        + 6 * 4 * n_pool  # count/v/cell/honest cols
-        + 4 * 4 * n_pool * n_rv  # acc/accT/attack/rand_v + wT/sT scratch
+        + 6 * 4 * n_pool  # meta/honest cols (128-lane tile floor)
+        + 4 * 4 * n_pool * n_rv  # acc/accT/attack/rand_v operands
+        + 2 * 4 * n_pool * n_rv  # wT/sT scratch
     )
     step = (
         3 * 4 * blk_d * n_pool  # G^T, w_sel, s_sel (f32)
@@ -1059,16 +1121,25 @@ def _probe_plan(kernel_name, cfg, candidates, compile_one, cache,
         blk = None if hit < 0 else hit
         cache[key] = blk
         return blk
+    from qba_tpu.ops.round_kernel import probe_error_transient
+
     chosen: int | None = None
     last_err: Exception | None = None
+    transient_seen = False
     for blk in candidates:
-        try:
-            compile_one(blk)
-            chosen = blk
+        for _attempt in range(2):  # retry once on transient tunnel errors
+            try:
+                compile_one(blk)
+                chosen = blk
+                break
+            except Exception as e:  # compile failures only (no execution)
+                last_err = e
+                if probe_error_transient(e):
+                    transient_seen = True
+                    continue  # a helper crash is not a shape verdict
+                break  # deterministic (VMEM/lowering) -> next candidate
+        if chosen is not None:
             break
-        except Exception as e:  # compile failures only (no execution)
-            last_err = e
-            continue
     if chosen is None and last_err is not None:
         warnings.warn(
             f"{kernel_name} kernel compile probe failed for every block "
@@ -1078,8 +1149,15 @@ def _probe_plan(kernel_name, cfg, candidates, compile_one, cache,
             RuntimeWarning,
             stacklevel=3,
         )
-    cache[key] = chosen
-    _probe_disk_put(dkey, -1 if chosen is None else chosen)
+    if chosen is not None or not transient_seen:
+        # Cache only real verdicts (in-process AND on disk): a failure
+        # born from a transient tunnel error would pin this shape to a
+        # slower engine — for the process lifetime via the memory
+        # cache, for every later process via the disk cache (observed —
+        # see round_kernel.probe_error_transient).  The cost of not
+        # caching is a re-probe on the next call: the desired retry.
+        cache[key] = chosen
+        _probe_disk_put(dkey, -1 if chosen is None else chosen)
     return chosen
 
 
@@ -1099,6 +1177,46 @@ def _probe_shapes(cfg: QBAConfig):
     return shp, i32, vdt
 
 
+def pool_bytes(cfg: QBAConfig, trials: int = 1) -> dict:
+    """Logical vs TPU-padded resident bytes of the carried pool — the
+    planning view of the HBM ceiling (VERDICT r3 item 2).
+
+    Padding model (observed on v5e): the minor dim tiles to 128 lanes
+    (so ``size_l=64`` doubles ``vals``/``p`` and any narrow column pays
+    the full 128-lane tile), the second-minor to 8 sublanes (16 for
+    bf16's packed tiling).  The round-4 meta packing collapsed four
+    [cap, 1] columns into one [cap, 4] tensor — identical logical
+    bytes, 4x less padded — and kernel donation removed the second
+    resident pool generation the scan carry used to keep."""
+    n_rv, slots, max_l, s = (
+        cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l,
+    )
+    cap = n_rv * slots
+    vb = 2 if pool_vals_dtype(cfg) == jnp.bfloat16 else 4
+
+    def pad(x, m):
+        return -(-x // m) * m
+
+    lane = 128
+    logical = (
+        vb * max_l * cap * s  # vals
+        + 4 * cap * max_l  # lens
+        + vb * cap * s  # p
+        + 4 * cap * 4  # meta
+    )
+    padded = (
+        vb * max_l * pad(cap, 16 if vb == 2 else 8) * pad(s, lane)
+        + 4 * pad(cap, 8) * pad(max_l, lane)
+        + vb * pad(cap, 16 if vb == 2 else 8) * pad(s, lane)
+        + 4 * pad(cap, 8) * pad(4, lane)
+    )
+    return {
+        "logical_bytes": logical * trials,
+        "padded_bytes": padded * trials,
+        "pad_ratio": round(padded / logical, 2),
+    }
+
+
 def tiled_kernel_plan(cfg: QBAConfig, n_recv: int | None = None) -> int | None:
     """The verdict-kernel block size the tiled engine will use for this
     config, or None if no candidate compiles.  Like
@@ -1115,14 +1233,13 @@ def tiled_kernel_plan(cfg: QBAConfig, n_recv: int | None = None) -> int | None:
     def compile_one(blk):
         verdict = build_verdict_kernel(cfg, blk, n_recv=n_recv)
         off = (jax.ShapeDtypeStruct((), i32),) if local else ()
-        in_axes = (None,) * (1 + len(off)) + (0,) * 13
+        in_axes = (None,) * (1 + len(off)) + (0,) * 10
         jax.jit(jax.vmap(verdict, in_axes=in_axes)).lower(
             jax.ShapeDtypeStruct((), i32),
             *off,
             shp(cfg.max_l, n_pool, cfg.size_l, dt=vdt),
             shp(n_pool, cfg.max_l),
-            shp(n_pool, 1), shp(n_pool, cfg.size_l, dt=vdt),
-            shp(n_pool, 1), shp(n_pool, 1), shp(n_pool, 1),
+            shp(n_pool, cfg.size_l, dt=vdt), shp(n_pool, 4),
             shp(n_rv, cfg.size_l), shp(n_rv, cfg.w), shp(n_pool, 1),
             shp(n_pool, n_rv), shp(n_pool, n_rv), shp(n_pool, n_rv),
         ).compile()
@@ -1147,14 +1264,13 @@ def rebuild_kernel_plan(cfg: QBAConfig, n_recv: int | None = None) -> int | None
     def compile_one(blk_d):
         rebuild = build_rebuild_kernel(cfg, blk_d, n_recv=n_recv)
         off = (jax.ShapeDtypeStruct((), i32),) if local else ()
-        in_axes = (None,) * (1 + len(off)) + (0,) * 11
+        in_axes = (None,) * (1 + len(off)) + (0,) * 9
         jax.jit(jax.vmap(rebuild, in_axes=in_axes)).lower(
             jax.ShapeDtypeStruct((), i32),
             *off,
             shp(cfg.max_l, n_pool, cfg.size_l, dt=vdt),
             shp(n_pool, cfg.max_l),
-            shp(n_pool, 1), shp(n_pool, cfg.size_l, dt=vdt),
-            shp(n_pool, 1), shp(n_pool, 1),
+            shp(n_pool, cfg.size_l, dt=vdt), shp(n_pool, 4),
             shp(n_rv, cfg.size_l), shp(n_pool, n_rv),
             shp(n_pool, n_rv), shp(n_pool, n_rv), shp(n_pool, 1),
         ).compile()

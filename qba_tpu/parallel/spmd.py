@@ -143,6 +143,7 @@ def _trial_party_sharded(
         # back into the local pool.  Mirrors tfg.py:337-348 semantics
         # at the reference's multi-process shape (README.md:3-4).
         from qba_tpu.ops.round_kernel_tiled import (
+            META_CELL,
             build_rebuild_kernel,
             build_verdict_kernel,
             honest_cells as honest_cells_fn,
@@ -188,18 +189,17 @@ def _trial_party_sharded(
                 for d in draws
             )
             acc, vi_i32 = verdict(
-                round_idx, start, *pool_g[:6], pool_g[6], my_li,
+                round_idx, start, *pool_g, my_li,
                 vi_i32, honest_cells, att_c, rv_c, late_c,
             )
             if rebuild_k is not None:
                 pool_new, ovf = rebuild_k(
-                    round_idx, start, pool_g[0], pool_g[1], pool_g[2],
-                    pool_g[3], pool_g[4], pool_g[6], my_li, acc,
+                    round_idx, start, *pool_g, my_li, acc,
                     att_c, rv_c, honest_cells,
                 )
             else:
                 # The XLA rebuild consumes pool-ordered draws.
-                cell = pool_g[6][:, 0]
+                cell = pool_g[3][:, META_CELL]
                 pool_new, ovf = rebuild_pool(
                     cfg, round_idx, pool_g, my_li, acc,
                     jnp.take(att_c, cell, axis=0),
@@ -340,9 +340,10 @@ def run_trials_spmd(
 def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:
     """Engine for the party-sharded round loop: forced engines pass
     through (both Pallas kernel families have party-sharded variants);
-    ``auto`` on TPU follows the same size_l-dependent preference order
-    as the single-device :func:`~qba_tpu.rounds.engine.resolve_round_engine`,
-    probing the LOCAL-receiver kernel variants; vectorized XLA last.
+    ``auto`` on TPU follows the same flat preference order as the
+    single-device :func:`~qba_tpu.rounds.engine.resolve_round_engine`
+    (packet-tiled first everywhere since round 4, monolithic second,
+    XLA last), probing the LOCAL-receiver kernel variants.
     """
     if cfg.round_engine in ("pallas", "pallas_tiled"):
         return cfg.round_engine
@@ -351,11 +352,8 @@ def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:
     from qba_tpu.ops.round_kernel import kernel_compiles
     from qba_tpu.ops.round_kernel_tiled import tiled_kernel_plan
 
-    wide = cfg.size_l >= 256
-    if wide and tiled_kernel_plan(cfg, n_recv=n_local) is not None:
+    if tiled_kernel_plan(cfg, n_recv=n_local) is not None:
         return "pallas_tiled"
     if kernel_compiles(cfg, n_recv=n_local):
         return "pallas"
-    if not wide and tiled_kernel_plan(cfg, n_recv=n_local) is not None:
-        return "pallas_tiled"
     return "xla"

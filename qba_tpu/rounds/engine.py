@@ -432,6 +432,7 @@ def run_rounds_tiled(
     the reference's sizeL=1000); bit-identical verdicts to
     :func:`run_rounds_xla` (tests/test_round_kernel_tiled.py)."""
     from qba_tpu.ops.round_kernel_tiled import (
+        META_CELL,
         build_rebuild_kernel,
         build_verdict_kernel,
         honest_cells as honest_cells_fn,
@@ -462,17 +463,17 @@ def run_rounds_tiled(
         att_c = attack.astype(jnp.int32)
         rv_c = rand_v.astype(jnp.int32)
         acc, vi_i32 = verdict(
-            round_idx, *pool[:6], pool[6], lieu_lists, vi_i32,
+            round_idx, *pool, lieu_lists, vi_i32,
             honest_cells, att_c, rv_c, late.astype(jnp.int32),
         )
         if rebuild_k is not None:
             pool_new, ovf = rebuild_k(
-                round_idx, pool[0], pool[1], pool[2], pool[3], pool[4],
-                pool[6], lieu_lists, acc, att_c, rv_c, honest_cells,
+                round_idx, *pool, lieu_lists, acc, att_c, rv_c,
+                honest_cells,
             )
         else:
             # The XLA fallback consumes pool-ordered draws.
-            cell = pool[6][:, 0]
+            cell = pool[3][:, META_CELL]
             pool_new, ovf = rebuild_pool(
                 cfg, round_idx, pool, lieu_lists, acc,
                 jnp.take(att_c, cell, axis=0),
@@ -492,15 +493,20 @@ def resolve_round_engine(cfg: QBAConfig) -> str:
     """``auto`` -> the fastest engine that compiles for this config.
 
     Preference order (all gates are cached one-time compile probes
-    behind loose VMEM pre-filters): at ``size_l < 256`` the fused
-    monolithic kernel (:func:`qba_tpu.ops.round_kernel.kernel_compiles`)
-    beats the tiled engine by ~5-10% (measured at the headline config,
-    docs/PERF.md), so it goes first; at wide position axes the order
-    flips — per-packet tiles are large, so the tiled engine's
-    skip-empty-blocks structure wins (~11% at the reference's
-    sizeL=1000) and is preferred when it compiles
-    (:func:`qba_tpu.ops.round_kernel_tiled.tiled_kernel_plan`).  Pure
-    XLA is the final fallback."""
+    behind loose VMEM pre-filters): the packet-tiled engine first
+    (:func:`qba_tpu.ops.round_kernel_tiled.tiled_kernel_plan`), the
+    fused monolithic kernel second
+    (:func:`qba_tpu.ops.round_kernel.kernel_compiles`), pure XLA last.
+
+    Round 3 preferred the monolithic kernel below ``size_l < 256``; the
+    round-4 tiled-engine work (pool donation, meta packing,
+    receiver-major draw tables — docs/PERF.md) flipped every measured
+    config to the tiled engine: honest single-batch sweeps show it
+    ahead at the headline 11p/64 (28.5k vs 19.3k rounds/s), 21p/64
+    (8.6k vs 4.1k), and sizeL 128/256 at both party counts (12-84%).
+    The monolithic kernel stays as the second choice (it compiles at
+    small scales and keeps shard_map's replication checker usable — see
+    parallel/spmd.py)."""
     if cfg.round_engine != "auto":
         return cfg.round_engine
     if jax.default_backend() != "tpu":
@@ -508,13 +514,10 @@ def resolve_round_engine(cfg: QBAConfig) -> str:
     from qba_tpu.ops.round_kernel import kernel_compiles
     from qba_tpu.ops.round_kernel_tiled import tiled_kernel_plan
 
-    wide = cfg.size_l >= 256
-    if wide and tiled_kernel_plan(cfg) is not None:
+    if tiled_kernel_plan(cfg) is not None:
         return "pallas_tiled"
     if kernel_compiles(cfg):
         return "pallas"
-    if not wide and tiled_kernel_plan(cfg) is not None:
-        return "pallas_tiled"
     return "xla"
 
 

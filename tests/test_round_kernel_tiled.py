@@ -148,13 +148,15 @@ class TestPoolMechanics:
         _, out_cells = jax.vmap(
             lambda p, v, li: step3a_one(cfg, p, v, li)
         )(p_rows, v_sent, lieu_lists)
+        from qba_tpu.ops.round_kernel_tiled import META_CELL, META_SENT
+
         pool = pool_from_step3a(cfg, out_cells)
-        sent = pool[5][:, 0]
+        sent = pool[3][:, META_SENT]
         n_sent = int(jnp.sum(sent))
         # compacted: all sent entries first
         assert sent.tolist() == [1] * n_sent + [0] * (len(sent) - n_sent)
         # cell ids strictly increasing over the sent prefix (sender order)
-        cells = pool[6][:n_sent, 0].tolist()
+        cells = pool[3][:n_sent, META_CELL].tolist()
         assert cells == sorted(cells)
 
     def test_vals_dtype_bf16_exact_range(self):
