@@ -192,60 +192,93 @@ def _build_mesh(rank: int, peers: list[int], sock_dir: str,
 
 # ---------------------------------------------------------------------------
 # Party mains (Process targets — spawn-safe, jax-free).
+#
+# Batch mode (round 4, VERDICT r3 item 4): a party process builds its
+# socket mesh ONCE and then serves a stream of trials — the coordinator
+# sends ("trial", per-trial params) over the duplex work pipe, the party
+# runs the protocol over the persistent mesh and replies ("ok", result),
+# until ("stop",).  This amortizes the n_parties+1 process spawns
+# (~0.1-0.5 s each) across a whole Monte-Carlo batch, matching the
+# runtime shape of the reference's single mpiexec launch
+# (``tfg.py:310-314``) rather than one launch per trial.  Stream
+# alignment needs no per-trial framing: every trial is a complete BSP
+# exchange (each party reads exactly the messages the trial defines), so
+# consecutive trials cannot interleave on the sockets.
 
-def commander_main(rank, sock_dir, so_path, result_conn, params):
-    """Rank 1 (``tfg.py:166-184``): compute each lieutenant's packet
-    from the recovered Q-correlated set and send it over the wire; the
-    equivocation split is already folded into ``v_sent``."""
+def commander_main(rank, sock_dir, so_path, conn, params):
+    """Rank 1 (``tfg.py:166-184``): per trial, compute each
+    lieutenant's packet from the recovered Q-correlated set and send it
+    over the wire; the equivocation split is already folded into
+    ``v_sent``."""
     try:
         size_l = params["size_l"]
         codec = _Codec(so_path, size_l, params["max_l"])
         lieu_ranks = list(range(2, params["n_parties"] + 1))
         conns = _build_mesh(rank, lieu_ranks, sock_dir)
-        row0, row1 = params["list0"], params["list1"]
-        isq = {k for k in range(size_l) if row0[k] != row1[k]}
-        events = []
-        for i, r in enumerate(lieu_ranks):
-            v = params["v_sent"][i]
-            p = {k for k in isq if row1[k] == v}
-            events.append(
-                ((0, 0, i, 0), "step2", "send",
-                 dict(sender=1, dest=r, v=v, p_size=len(p), l_size=0))
-            )
-            _send_msg(conns[r], codec.encode(p, v, set()))
+        while True:
+            try:
+                msg = conn.recv()
+            except EOFError:  # coordinator closed the pipe = stop
+                break
+            if msg[0] != "trial":
+                break
+            work = msg[1]
+            row0, row1 = work["list0"], work["list1"]
+            isq = {k for k in range(size_l) if row0[k] != row1[k]}
+            events = []
+            for i, r in enumerate(lieu_ranks):
+                v = work["v_sent"][i]
+                p = {k for k in isq if row1[k] == v}
+                events.append(
+                    ((0, 0, i, 0), "step2", "send",
+                     dict(sender=1, dest=r, v=v, p_size=len(p), l_size=0))
+                )
+                _send_msg(conns[r], codec.encode(p, v, set()))
+            conn.send(("ok", {"events": events}))
         for s in conns.values():
             s.close()
-        result_conn.send(("ok", {"events": events}))
     except Exception as e:  # pragma: no cover - surfaced by the parent
-        result_conn.send(("error", f"{type(e).__name__}: {e}"))
+        conn.send(("error", f"{type(e).__name__}: {e}"))
     finally:
-        result_conn.close()
+        conn.close()
 
 
-def lieutenant_main(rank, sock_dir, so_path, result_conn, params):
-    """One lieutenant (rank 2..n_parties): step 3a on the commander's
-    wire packet, then the synchronous voting rounds against every peer
-    (``tfg.py:185-300,337-348``), decision at the end."""
+def lieutenant_main(rank, sock_dir, so_path, conn, params):
+    """One lieutenant (rank 2..n_parties): per trial, step 3a on the
+    commander's wire packet, then the synchronous voting rounds against
+    every peer (``tfg.py:185-300,337-348``), decision at the end."""
     try:
-        result_conn.send(_run_lieutenant(rank, sock_dir, so_path, params))
+        codec = _Codec(so_path, params["size_l"], params["max_l"])
+        peers = [
+            r for r in range(1, params["n_parties"] + 1) if r != rank
+        ]
+        conns = _build_mesh(rank, peers, sock_dir)
+        while True:
+            try:
+                msg = conn.recv()
+            except EOFError:  # coordinator closed the pipe = stop
+                break
+            if msg[0] != "trial":
+                break
+            conn.send(_run_lieutenant(rank, codec, conns, params, msg[1]))
+        for s in conns.values():
+            s.close()
     except Exception as e:  # pragma: no cover - surfaced by the parent
-        result_conn.send(("error", f"{type(e).__name__}: {e}"))
+        conn.send(("error", f"{type(e).__name__}: {e}"))
     finally:
-        result_conn.close()
+        conn.close()
 
 
-def _run_lieutenant(rank, sock_dir, so_path, params):
+def _run_lieutenant(rank, codec, conns, params, work):
     n_parties = params["n_parties"]
-    size_l, w, slots = params["size_l"], params["w"], params["slots"]
+    w, slots = params["w"], params["slots"]
     n_dis, n_rounds = params["n_dishonest"], params["n_rounds"]
     racy_defer = params["racy_defer"]
-    honest = params["honest"]  # rank-indexed tuple[bool]
-    li = params["list"]  # own particle list (ints)
-    attacks = np.asarray(params["attacks"])  # [n_rounds, n_cells, 3]
-    codec = _Codec(so_path, size_l, params["max_l"])
+    honest = work["honest"]  # rank-indexed tuple[bool]
+    li = work["list"]  # own particle list (ints)
+    attacks = np.asarray(work["attacks"])  # [n_rounds, n_cells, 3]
     me = rank - 2  # lieutenant index
     peers = [r for r in range(1, n_parties + 1) if r != rank]
-    conns = _build_mesh(rank, peers, sock_dir)
     lieu_peers = [r for r in peers if r >= 2]
 
     events: list = []
@@ -257,7 +290,6 @@ def _run_lieutenant(rank, sock_dir, so_path, params):
 
     # Step 3a (tfg.py:185-196): the commander's packet over the wire.
     p0, v0, L0 = codec.decode(_recv_msg(conns[1]))
-    conns[1].close()
     ell = set(L0)
     ell.add(tuple(li[j] for j in sorted(p0)))
     ok = _consistent(v0, ell, w)
@@ -378,9 +410,7 @@ def _run_lieutenant(rank, sock_dir, so_path, params):
         shipper.join()
         deferred = next_deferred
 
-    for s in conns.values():
-        if s.fileno() != -1:
-            s.close()
+    # Connections stay open — the mesh is persistent across the batch.
     # Decision (tfg.py:303-306; empty-Vi sentinel = w, DIVERGENCES D2).
     decision = min(vi) if vi else w
     return (
@@ -392,3 +422,4 @@ def _run_lieutenant(rank, sock_dir, so_path, params):
             "events": events,
         },
     )
+
