@@ -172,11 +172,35 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     delivered = ~dropped & ~late & sent_f & (senders != receiver_idx)
 
     # Receiver-independent raw-mailbox reductions (shared by all receivers).
+    # Row-sliced construction throughout (see the presence-plane note
+    # below): full [n_pk, max_l, size_l] intermediates cost ~1.6 GB
+    # materializations per round at the 33-party scale and tend to pick
+    # degenerate T(1,128) layouts; per-row [n_pk, size_l] slices fuse
+    # into full-width passes.
     valid_raw = jnp.arange(max_l)[None, :] < count_f[:, None]  # [n_pk, max_l]
     in_t_raw = vals_f != SENTINEL  # [n_pk, max_l, size_l]
+
+    def _tree(rows, op):
+        while len(rows) > 1:
+            folded = [op(a, b) for a, b in zip(rows[0::2], rows[1::2])]
+            if len(rows) % 2:
+                folded.append(rows[-1])
+            rows = folded
+        return rows[0]
+
+    def _in_valid_row(r):
+        return in_t_raw[:, r] & valid_raw[:, r : r + 1]
+
     oob_raw = jnp.any(
-        in_t_raw & ((vals_f > cfg.w) | (vals_f < 0)) & valid_raw[..., None],
-        axis=(1, 2),
+        _tree(
+            [
+                _in_valid_row(r)
+                & ((vals_f[:, r] > cfg.w) | (vals_f[:, r] < 0))
+                for r in range(max_l)
+            ],
+            jnp.logical_or,
+        ),
+        axis=-1,
     )  # [n_pk]
     # Value-presence bit planes: bit (x & 31) of plane x >> 5 at
     # [pk, pos] iff some valid row holds value x there.  Replaces the
@@ -188,16 +212,25 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     # distinct values map to distinct (plane, bit) pairs, so stored
     # garbage cannot alias a query.
     n_planes = (cfg.w + 31) // 32
-    in_valid = in_t_raw & valid_raw[..., None]
     pm_pos = []  # per plane: int32[n_pk, size_l]
     for p_i in range(n_planes):
         lo = 32 * p_i
-        in_pl = in_valid & (vals_f >= lo) & (vals_f < lo + 32)
-        bits = jnp.where(in_pl, jnp.left_shift(jnp.int32(1), vals_f & 31), 0)
-        acc = bits[:, 0]
-        for r in range(1, max_l):
-            acc = acc | bits[:, r]
-        pm_pos.append(acc)
+
+        def row_bits(r, lo=lo):
+            v_r = vals_f[:, r]
+            in_r = _in_valid_row(r) & (v_r >= lo) & (v_r < lo + 32)
+            return jnp.where(
+                in_r, jnp.left_shift(jnp.int32(1), v_r & 31), 0
+            )
+
+        # Per-row construction + tree-shaped OR: building a full
+        # [n_pk, max_l, size_l] bits tensor and reducing it cost two
+        # ~1.6 GB materializations plus max_l serial slice+or fusions
+        # per plane per round at the 33-party scale; row-sliced ops
+        # stay [n_pk, size_l]-shaped and fuse into full-width passes.
+        pm_pos.append(
+            _tree([row_bits(r) for r in range(max_l)], jnp.bitwise_or)
+        )
     def plane_bit_pos(q):  # int32[n_pk, size_l] query -> bool[n_pk, size_l]
         sel = pm_pos[0]
         for p_i in range(1, n_planes):
@@ -214,29 +247,83 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     cell_lens_ok_raw = jnp.all(
         jnp.where(valid_raw, lens_f == lens_f[:, :1], True), axis=1
     )  # [n_pk]
-    eq_raw = jnp.any(
-        (vals_f[:, :, None, :] == vals_f[:, None, :, :])
-        & in_t_raw[:, :, None, :]
-        & in_t_raw[:, None, :, :],
-        axis=-1,
-    )  # [n_pk, max_l, max_l]
-    pair_mask = (
-        jnp.arange(max_l)[:, None] < jnp.arange(max_l)[None, :]
-    )  # upper triangle
-    cells_ok_raw = ~jnp.any(
-        eq_raw & pair_mask[None] & valid_raw[:, :, None] & valid_raw[:, None, :],
-        axis=(1, 2),
-    )  # [n_pk]
+    # Pairwise row-collision (two valid rows sharing a value at the
+    # same position, tfg.py:96-98) via a popcount identity instead of
+    # the [n_pk, max_l, max_l, size_l] pairwise compare (a ~17 ms/round
+    # fusion at the 33-party scale): each plane-covered value
+    # contributes exactly one bit, duplicates collapse under OR, so a
+    # collision at a position is exactly
+    # popcount(planes) < (number of plane-covered entries).  Both sides
+    # count plane-covered values ONLY ([0, 32*n_planes) — a superset of
+    # [0, w)), keeping the identity exact for them.  The one value this
+    # test treats differently from the pairwise compare is the
+    # legal-but-boundary v == w (oob tolerates `<= w`, tfg.py:93, but
+    # no plane covers it when w is the usual power of two): a w-vs-w
+    # collision would go unflagged.  Unreachable: evidence rows only
+    # ever hold particle-list values, and the sampler draws those from
+    # [0, w).  All other uncovered values (> w, < 0) set oob_raw, which
+    # rejects the packet through cond2 whenever cond3 is consulted
+    # (~clear_l).
+    hi = 32 * n_planes
+
+    def _covered_row(r):
+        v_r = vals_f[:, r]
+        return (
+            _in_valid_row(r) & (v_r >= 0) & (v_r < hi)
+        ).astype(jnp.int32)
+
+    n_in_pos = _tree(
+        [_covered_row(r) for r in range(max_l)], jnp.add
+    )  # [n_pk, size_l]
+    pop_pos = sum(
+        jax.lax.population_count(pm).astype(jnp.int32) for pm in pm_pos
+    )
+    cells_ok_raw = ~jnp.any(pop_pos < n_in_pos, axis=-1)  # [n_pk]
 
     # Receiver-dependent part: the would-be own row (tfg.py:291).
     p2 = p_f & ~clear_p[:, None]  # [n_pk, size_l]
     own = jnp.where(p2, li[None, :], SENTINEL)  # [n_pk, size_l]
-    own_len = jnp.sum(p2.astype(jnp.int32), axis=-1)  # [n_pk]
+    s_p = jnp.sum(p_f.astype(jnp.int32), axis=-1)  # [n_pk] (hoisted)
+    own_len = jnp.where(clear_p, 0, s_p)  # |own row| = (1-cp) * |P|
 
     count_eff = jnp.where(clear_l, 0, count_f)
-    dup = ~clear_l & jnp.any(
-        valid_raw & jnp.all(vals_f == own[:, None, :], axis=-1), axis=-1
-    )
+    # Dup detection (row == own).  The direct form materializes a
+    # [receivers, n_pk, max_l, size_l] compare under the receiver vmap
+    # — the dominant fusion of this engine at the 33-party scale
+    # (~0.5 s of a 3.6 s 250-trial batch; docs/PERF.md round 4).  The
+    # MXU form is the exact integer identity
+    #   sum_pos (v - own)^2 == 0  <=>  row == own,
+    # with own = p2*(li+1) - 1 expanded so clear_p factors out of the
+    # position contraction:
+    #   cross = (1-cp) * [p*v](li+1) - sum v
+    #   sum own^2 = (1-cp) * [p](li^2-1) + size_l
+    # and the two bracketed contractions are matmuls against this
+    # receiver's li tables — under the receiver vmap XLA batches them
+    # into [n_pk*max_l, size_l] @ [size_l, receivers] MXU ops.  f32 is
+    # exact while size_l * w^2 < 2^24 (values live in [-1, w]); wider
+    # configs keep the elementwise form.
+    if cfg.size_l * cfg.w * cfg.w < 2**24:
+        li_f = li.astype(jnp.float32)
+        pv = jnp.where(p_f[:, None, :], vals_f, 0).astype(jnp.float32)
+        m1 = jax.lax.dot_general(
+            pv.reshape(n_pk * max_l, cfg.size_l),
+            (li_f + 1.0)[:, None],
+            (((1,), (0,)), ((), ())),
+        ).reshape(n_pk, max_l)
+        m2 = jax.lax.dot_general(
+            p_f.astype(jnp.float32), (li_f * li_f - 1.0)[:, None],
+            (((1,), (0,)), ((), ())),
+        )[:, 0]
+        s_v = jnp.sum(vals_f, axis=-1)  # int32, exact
+        ssq_v = jnp.sum(vals_f * vals_f, axis=-1)
+        cp_f = clear_p.astype(jnp.float32)[:, None]
+        cross = (1.0 - cp_f) * m1 - s_v.astype(jnp.float32)
+        ssq_o = (1.0 - cp_f) * m2[:, None] + float(cfg.size_l)
+        mism = ssq_v.astype(jnp.float32) - 2.0 * cross + ssq_o
+        dup_rows = mism == 0.0  # [n_pk, max_l]
+    else:  # pragma: no cover - w > 256-class configs
+        dup_rows = jnp.all(vals_f == own[:, None, :], axis=-1)
+    dup = ~clear_l & jnp.any(valid_raw & dup_rows, axis=-1)
     # append_own's fullness guard (consistent_after_append): the own-row
     # terms below apply only when the row actually enters L'.  With the
     # config invariant max_l >= n_rounds + 1 (enforced in QBAConfig),

@@ -1,0 +1,115 @@
+"""The reference's captured-run matrix at its ACTUAL scale.
+
+All five runs the reference ships as fixtures use sizeL=1000
+(`/root/reference/logs tests/log_3.txt` .. `log_d_11.txt` — list
+indices reach 999, e.g. `log_11.txt:25`): 3 parties with {nobody, one
+lieutenant, the commander} dishonest and 11 parties with {nobody, 5
+including the commander} dishonest.  Rounds 1-3 exercised these
+property classes only at reduced sizes (tests/test_e2e.py); this suite
+runs them at full scale on the auto engine (VERDICT r3 item 3) and
+asserts, per vmapped batch:
+
+* **zero overflow** — the auto engine must serve these configs
+  lossless, like the reference's unbounded Iprobe drain
+  (`tfg.py:337-348`);
+* **the oracle** — TrialResult.success re-derived independently from
+  decisions + honesty must match the engine's verdict
+  (`tfg.py:351-363`);
+* **validity** — in the all-honest classes every lieutenant decides
+  the commander's order.  With dishonest parties in play validity is
+  NOT a guarantee (observed counterexample at 11p/5 with an honest
+  commander), so the dishonest classes assert the oracle only — the
+  hardest captured class being the dishonest-commander 11-party run
+  (`log_d_11.txt:485-487`: Dishonests [7 5 1 11 2] include rank 1),
+  which the batches must cover.
+
+CPU note: these run the XLA engine (auto off-TPU); batch sizes are
+sized to keep the suite's added wall time modest while covering every
+class, including at least one dishonest-commander trial per dishonest
+config (seeds chosen so the random dishonesty assignment hits it).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from qba_tpu.backends.jax_backend import run_trials, trial_keys
+from qba_tpu.config import QBAConfig
+
+CASES = [
+    # (n_parties, n_dishonest, trials, seed) — the five captured
+    # configs' classes; the dishonest-commander classes emerge from
+    # the random assignment within the dishonest batches.
+    pytest.param(3, 0, 8, 0, id="3p_honest"),
+    pytest.param(3, 1, 16, 1, id="3p_one_dishonest"),
+    pytest.param(11, 0, 6, 0, id="11p_honest"),
+    pytest.param(11, 5, 12, 2, id="11p_five_dishonest"),
+]
+
+
+@pytest.mark.parametrize("n_parties,n_dishonest,trials,seed", CASES)
+def test_reference_scale_property_matrix(n_parties, n_dishonest, trials, seed):
+    cfg = QBAConfig(
+        n_parties=n_parties,
+        size_l=1000,  # the reference's actual sizeL
+        n_dishonest=n_dishonest,
+        trials=trials,
+        seed=seed,
+    )
+    res = run_trials(cfg, trial_keys(cfg))
+    decisions = np.asarray(res.trials.decisions)  # [trials, n_parties]
+    honest = np.asarray(res.trials.honest)  # [trials, n_parties]
+    success = np.asarray(res.trials.success)
+    overflow = np.asarray(res.trials.overflow)
+    v_comm = np.asarray(res.trials.v_comm)
+
+    # Lossless at reference scale on the auto engine.
+    assert not overflow.any(), "auto engine overflowed at sizeL=1000"
+
+    for t in range(trials):
+        hon = honest[t]
+        # The oracle, re-derived (tfg.py:351-363): success iff the
+        # honest parties' decisions form a singleton.
+        filtered = {int(d) for d, h in zip(decisions[t], hon) if h}
+        assert bool(success[t]) == (len(filtered) == 1), (t, filtered)
+        # Validity is a GUARANTEE only in the all-honest class (with
+        # dishonest lieutenants in play, an honest commander's order
+        # can still fail agreement — observed at 11p/5, and the
+        # reference's captured matrix makes no claim there either).
+        if n_dishonest == 0:
+            assert int(decisions[t][0]) == int(v_comm[t])
+            for i in range(1, n_parties):
+                assert int(decisions[t][i]) == int(v_comm[t]), (
+                    f"trial {t}: honest lieutenant {i} decided "
+                    f"{int(decisions[t][i])} != v_comm {int(v_comm[t])}"
+                )
+            assert bool(success[t])
+
+    if n_dishonest > 0:
+        # The captured matrix includes dishonest-commander runs
+        # (log_d_3.txt, log_d_11.txt): the batch must exercise that
+        # class — the hardest one, where only the oracle remains.
+        assert (~honest[:, 0]).any(), (
+            "no dishonest-commander trial in this batch; bump the seed"
+        )
+
+
+def test_reference_scale_both_commander_classes_covered():
+    """Aggregate coverage at the 11-party scale: a dishonest batch must
+    exercise both commander classes (the reference captures the
+    dishonest-commander one in log_d_11.txt — Dishonests [7 5 1 11 2]
+    includes rank 1 — and its honest-commander runs elsewhere), and
+    the engine's verdicts must satisfy the oracle in both."""
+    cfg = QBAConfig(n_parties=11, size_l=1000, n_dishonest=5, trials=12, seed=5)
+    res = run_trials(cfg, trial_keys(cfg))
+    honest = np.asarray(res.trials.honest)
+    success = np.asarray(res.trials.success)
+    decisions = np.asarray(res.trials.decisions)
+    hc = honest[:, 0]
+    assert hc.any() and (~hc).any(), "batch must cover both classes"
+    assert not np.asarray(res.trials.overflow).any()
+    for t in range(cfg.trials):
+        filtered = {
+            int(d) for d, h in zip(decisions[t], honest[t]) if h
+        }
+        assert bool(success[t]) == (len(filtered) == 1)
