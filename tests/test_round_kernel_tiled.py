@@ -119,6 +119,67 @@ class TestXlaRebuildFallback:
         assert out.trials.success.shape == (2,)
 
 
+class TestProbeTransientHandling:
+    """Probe failures born from transient tunnel/helper errors must
+    retry once and never be cached — a cached false 'does not compile'
+    verdict pins the config to a slower engine (observed on hardware:
+    it dropped the north star to the XLA engine, round 4)."""
+
+    def _plan(self, cfg, compile_one):
+        from qba_tpu.ops.round_kernel_tiled import _probe_plan
+
+        cache: dict = {}
+        return (
+            _probe_plan(
+                "test-kernel", cfg, [16, 8], compile_one, cache,
+                "falling back", extra="unit",
+            ),
+            cache,
+        )
+
+    def test_transient_failure_retries_and_is_not_cached(self):
+        cfg = QBAConfig(n_parties=5, size_l=8)
+        calls = []
+
+        def flaky(blk):
+            calls.append(blk)
+            if len(calls) == 1:
+                raise RuntimeError(
+                    "INTERNAL: remote_compile: HTTP 500: subprocess exit"
+                )
+
+        chosen, cache = self._plan(cfg, flaky)
+        # First candidate failed transiently once, retried, succeeded.
+        assert chosen == 16
+        assert calls == [16, 16]
+        assert cache  # successful verdicts DO cache
+
+    def test_persistent_transient_failure_not_cached(self):
+        cfg = QBAConfig(n_parties=5, size_l=8)
+
+        def always_transient(blk):
+            raise RuntimeError("remote_compile: HTTP 500")
+
+        with pytest.warns(RuntimeWarning, match="compile probe failed"):
+            chosen, cache = self._plan(cfg, always_transient)
+        assert chosen is None
+        assert not cache  # a flaky tunnel must not pin the verdict
+
+    def test_deterministic_failure_is_cached(self):
+        cfg = QBAConfig(n_parties=5, size_l=8)
+        calls = []
+
+        def vmem_oom(blk):
+            calls.append(blk)
+            raise RuntimeError("Mosaic: scoped vmem limit exceeded")
+
+        with pytest.warns(RuntimeWarning, match="compile probe failed"):
+            chosen, cache = self._plan(cfg, vmem_oom)
+        assert chosen is None
+        assert calls == [16, 8]  # no retry per candidate; all tried
+        assert cache  # real shape verdicts persist
+
+
 class TestPoolMechanics:
     def test_tiled_block_validation(self):
         with pytest.raises(ValueError, match="tiled_block"):
