@@ -71,6 +71,7 @@ from qba_tpu.ops.round_kernel import _lane_group
 from qba_tpu.ops.verdict_algebra import (
     VerdictAlgebra,
     accept_first_per_value,
+    accept_first_per_value_group,
 )
 
 
@@ -257,6 +258,24 @@ def build_verdict_kernel(
                     clearl_all[:, sl], count_eff_all[:, sl],
                     delivered_all[:, sl],
                 )
+                if grp > 1 and grp * w <= 512:
+                    # Group-batched dedup: one [blk, grp*w]-lane pass
+                    # for the whole lane group instead of a serial
+                    # per-receiver chain (receivers' vi rows are
+                    # disjoint).  Stores stay per receiver so the
+                    # tail-group overlap skips already-updated rows.
+                    acc_cols, new_rows = accept_first_per_value_group(
+                        r0, grp, ok_g, v2_all[:, sl], ovi_ref,
+                        idx_col, blk, w,
+                    )
+                    for j in range(grp):
+                        recv = r0 + j
+                        if recv in done:
+                            continue
+                        done.add(recv)
+                        ovi_ref[recv : recv + 1, :] = new_rows[j]
+                        acc_ref[:, recv : recv + 1] = acc_cols[j]
+                    continue
                 for j in range(grp):
                     recv = r0 + j
                     if recv in done:  # tail-group overlap: already done
@@ -1000,6 +1019,10 @@ def _block_estimate(cfg: QBAConfig, blk: int,
     grp = _lane_group(cfg.size_l, n_rv)
     if grp > 1:
         est += tile * grp * (cfg.max_l + 6)
+        if grp * cfg.w <= 512:
+            # Group-batched dedup intermediates (~7 [blk, grp*w] int32
+            # tiles — see accept_first_per_value_group).
+            est += 4 * blk * grp * cfg.w * 7
     est += 4 * blk * n_rv * 6  # flag algebra tiles
     est = int(est * (1.0 + cfg.max_l / 4.0))
     return est

@@ -178,3 +178,43 @@ def test_accept_first_per_value_semantics():
         np.testing.assert_array_equal(
             np.asarray(new_vi[0]) != 0, vi_seq
         )
+
+
+def test_accept_first_per_value_group_matches_serial():
+    # The group-batched variant must equal grp independent serial
+    # applications of accept_first_per_value — adversarial vi/ok
+    # patterns included (pre-set vi bits, all-ok, none-ok), beyond what
+    # the protocol-driven kernel suites reach.
+    from qba_tpu.ops.verdict_algebra import accept_first_per_value_group
+
+    rng = np.random.default_rng(11)
+    n_p, w, grp = 24, 8, 3
+    for case in range(20):
+        ok = rng.random((n_p, grp)) < (0.0, 0.5, 1.0)[case % 3]
+        v2 = rng.integers(0, w, size=(n_p, grp))
+        vi0 = (rng.random((grp, w)) < 0.3).astype(np.int32)
+
+        class _FakeRef:
+            """Row-sliceable stand-in for the kernel's ovi ref."""
+
+            def __getitem__(self, sl):
+                return jnp.asarray(vi0[sl])
+
+        acc_cols, new_rows = accept_first_per_value_group(
+            0, grp, jnp.asarray(ok), jnp.asarray(v2), _FakeRef(),
+            jnp.arange(n_p)[:, None], n_p, w,
+        )
+        for j in range(grp):
+            want_acc, want_vi = accept_first_per_value(
+                jnp.asarray(ok[:, j : j + 1]),
+                jnp.asarray(v2[:, j : j + 1]),
+                jnp.asarray(vi0[j : j + 1, :]),
+                jnp.arange(n_p)[:, None], n_p, w,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(acc_cols[j][:, 0]) != 0,
+                np.asarray(want_acc[:, 0]),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(new_rows[j]) != 0, np.asarray(want_vi) != 0
+            )
