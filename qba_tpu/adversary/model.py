@@ -117,6 +117,18 @@ def raw_attack_draws(cfg: QBAConfig, k_round: jax.Array):
     own ``np.random.randint`` carries the same class of modulo bias).
     """
     shape = (cfg.n_lieutenants * cfg.slots, cfg.n_lieutenants)
+    # Value-range invariant (ADVICE r4): forged orders must stay inside
+    # [0, w) — the engines' verdict identities (rounds/engine.py) and
+    # the kernels' flag algebra assume every value they see is in
+    # [0, w).  The reference's forge range [0, nParties+1) satisfies it
+    # only because w = 2**ceil(log2(nParties+1)) >= nParties+1 by
+    # construction; enforce that here so a future action with a wider
+    # range fails loudly instead of silently shifting verdicts.
+    if cfg.n_parties + 1 > cfg.w:  # survives -O, unlike assert
+        raise ValueError(
+            f"forge range [0, {cfg.n_parties + 1}) exceeds the value "
+            f"domain [0, {cfg.w}) the round engines are exact on"
+        )
     bits = jax.random.bits(
         jax.random.fold_in(k_round, _ATTACK_TAG), shape, jnp.uint32
     )

@@ -134,6 +134,19 @@ def _recv_deadline(conn, remaining: float):
     t.start()
     t.join(max(0.0, remaining))
     if t.is_alive():
+        # Grace join before declaring a wedge: the caller may reach
+        # here with remaining <= 0 for a pipe wait() just reported
+        # readable (budget consumed by a sibling recv in the same
+        # batch) — that recv completes in microseconds, and poisoning
+        # it would cost the healthy child its graceful stop.
+        t.join(0.1)
+    if t.is_alive():
+        # The abandoned thread is still blocked in conn.recv(); closing
+        # the fd from another thread while it reads can raise unraisable
+        # errors or, worse, hand a reused fd number to the blocked read.
+        # Poison the connection so cleanup leaks it instead of closing
+        # (the fd dies with the process; the daemon thread with it).
+        conn._qba_poisoned = True
         raise RuntimeError("party wedged mid-report (recv deadline)")
     if "error" in out:
         raise out["error"]
@@ -152,7 +165,11 @@ def _send_with_deadline(pipes, messages, timeout: float) -> None:
         rank = None
         try:
             for rank, msg in messages:
+                if box.get("cancel"):  # timeout fired: stop cleanly so
+                    return  # a later unblock can't race cleanup sends
+                box["inflight"] = rank
                 pipes[rank].send(msg)
+            box.pop("inflight", None)
         except BaseException as e:  # pragma: no cover - re-raised below
             box["error"], box["rank"] = e, rank
 
@@ -160,6 +177,18 @@ def _send_with_deadline(pipes, messages, timeout: float) -> None:
     t.start()
     t.join(max(0.0, timeout))
     if t.is_alive():
+        # Same hazard as _recv_deadline, send side: the abandoned
+        # thread is still blocked in conn.send() on the in-flight rank.
+        # Poison that connection so cleanup neither writes a second
+        # interleaved frame on it nor closes the fd under the blocked
+        # write (leak it; it dies with the process).  The cancel flag
+        # keeps the abandoned thread from ever touching the ranks it
+        # had not reached if the wedged send later unblocks — those
+        # connections stay clean for the graceful stop path.
+        box["cancel"] = True
+        inflight = box.get("inflight")
+        if inflight is not None:
+            pipes[inflight]._qba_poisoned = True
         raise RuntimeError(
             f"mp work dispatch timed out after {timeout:.0f}s "
             "(party wedged before draining its work pipe?)"
@@ -363,11 +392,21 @@ def run_trials_mp(
             # its stop (the party mains treat EOF as stop).
             try:
                 _send_with_deadline(
-                    pipes, [(r, ("stop",)) for r in pipes], 5.0
+                    pipes,
+                    [
+                        (r, ("stop",))
+                        for r in pipes
+                        if not getattr(pipes[r], "_qba_poisoned", False)
+                    ],
+                    5.0,
                 )
             except Exception:  # pragma: no cover - cleanup best-effort
                 pass
             for conn in pipes.values():
+                if getattr(conn, "_qba_poisoned", False):
+                    # A recv-deadline thread may still be blocked in
+                    # conn.recv(); leak the fd (see _recv_deadline).
+                    continue
                 try:
                     conn.close()
                 except OSError:  # pragma: no cover

@@ -1149,7 +1149,9 @@ def _probe_plan(kernel_name, cfg, candidates, compile_one, cache,
     chosen: int | None = None
     last_err: Exception | None = None
     transient_seen = False
+    transient_abandoned = False  # a candidate given up on a transient
     for blk in candidates:
+        this_transient = False
         for _attempt in range(2):  # retry once on transient tunnel errors
             try:
                 compile_one(blk)
@@ -1157,12 +1159,17 @@ def _probe_plan(kernel_name, cfg, candidates, compile_one, cache,
                 break
             except Exception as e:  # compile failures only (no execution)
                 last_err = e
-                if probe_error_transient(e):
+                this_transient = probe_error_transient(e)
+                if this_transient:
                     transient_seen = True
                     continue  # a helper crash is not a shape verdict
                 break  # deterministic (VMEM/lowering) -> next candidate
         if chosen is not None:
             break
+        if this_transient:
+            # This candidate's FINAL error was transient: its verdict is
+            # unknown, so any later candidate's win is provisional.
+            transient_abandoned = True
     if chosen is None and last_err is not None:
         warnings.warn(
             f"{kernel_name} kernel compile probe failed for every block "
@@ -1173,14 +1180,24 @@ def _probe_plan(kernel_name, cfg, candidates, compile_one, cache,
             stacklevel=3,
         )
     if chosen is not None or not transient_seen:
-        # Cache only real verdicts (in-process AND on disk): a failure
-        # born from a transient tunnel error would pin this shape to a
-        # slower engine — for the process lifetime via the memory
-        # cache, for every later process via the disk cache (observed —
-        # see round_kernel.probe_error_transient).  The cost of not
-        # caching is a re-probe on the next call: the desired retry.
+        # Cache only real verdicts in-process: a failure born from a
+        # transient tunnel error would pin this shape to a slower
+        # engine — for the process lifetime via the memory cache, for
+        # every later process via the disk cache (observed — see
+        # round_kernel.probe_error_transient).  The cost of not caching
+        # is a re-probe on the next call: the desired retry.
         cache[key] = chosen
-        _probe_disk_put(dkey, -1 if chosen is None else chosen)
+        if not transient_abandoned:
+            # Disk-persist only verdicts whose losing candidates all
+            # failed *deterministically*: a candidate abandoned on a
+            # transient tunnel error has an unknown verdict, and a
+            # later (slower) candidate's win must not pin this shape
+            # machine-wide — keep it in-process only so the next
+            # process re-probes the abandoned candidate (ADVICE r4).
+            # Deterministic earlier failures (VMEM OOM, lowering) are
+            # real shape verdicts and persist as before, even when a
+            # transient blip happened elsewhere in the search.
+            _probe_disk_put(dkey, -1 if chosen is None else chosen)
     return chosen
 
 

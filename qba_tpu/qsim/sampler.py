@@ -36,6 +36,19 @@ def generate_lists(cfg: QBAConfig, key: jax.Array):
     (``tfg.py:69``).
     """
     n, w, s = cfg.n_parties, cfg.w, cfg.size_l
+    # Value-range invariant (ADVICE r4): every list value this sampler
+    # emits must lie in [0, w).  The XLA engine's popcount-collision and
+    # MXU dup identities (rounds/engine.py) are exact ONLY on that
+    # range, and this sampler is where evidence values are born.  The
+    # XOR path below stays closed under [0, w) iff w is a power of two
+    # (it is, by construction: w = 2**n_qubits) AND every perm value
+    # fits in n_qubits bits (perms <= n_parties < 2**n_qubits = w).
+    if w & (w - 1) != 0 or n >= w:  # survives -O, unlike assert
+        raise ValueError(
+            f"sampler range invariant broken: w={w} must be a power of "
+            f"two > n_parties={n}; engine verdict identities assume "
+            "vals in [0, w)"
+        )
     k_qcorr, k_r, k_perm, k_u = jax.random.split(key, 4)
 
     qcorr = jax.random.bernoulli(k_qcorr, 0.5, (s,))

@@ -193,3 +193,70 @@ class TestWireBoundary:
         assert a["decisions"] == b["decisions"]
         assert a["vi"] == b["vi"]
         assert a["success"] == b["success"]
+
+
+class TestDeadlineHazards:
+    """The recv/send deadline helpers abandon daemon threads still
+    blocked on the Connection; cleanup must never close (or write) a
+    connection such a thread still owns (ADVICE r4 + review r5)."""
+
+    def test_recv_deadline_poisons_wedged_conn(self):
+        import multiprocessing as mp
+
+        from qba_tpu.backends.mp_backend import _recv_deadline
+
+        parent, child = mp.Pipe(duplex=True)
+        try:
+            with pytest.raises(RuntimeError, match="recv deadline"):
+                _recv_deadline(parent, 0.05)  # nothing ever written
+            assert getattr(parent, "_qba_poisoned", False)
+        finally:
+            child.close()  # EOFs the abandoned reader thread
+
+    def test_recv_deadline_grace_recovers_readable_pipe(self):
+        # remaining <= 0 with the report already sitting in the pipe
+        # (budget consumed by a sibling recv in the same wait batch):
+        # the grace join must deliver it instead of poisoning a healthy
+        # party out of its graceful stop.
+        import multiprocessing as mp
+
+        from qba_tpu.backends.mp_backend import _recv_deadline
+
+        parent, child = mp.Pipe(duplex=True)
+        try:
+            child.send(("ok", 42))
+            assert _recv_deadline(parent, 0.0) == ("ok", 42)
+            assert not getattr(parent, "_qba_poisoned", False)
+        finally:
+            parent.close()
+            child.close()
+
+    def test_send_deadline_poisons_inflight_conn(self):
+        import threading
+
+        from qba_tpu.backends.mp_backend import _send_with_deadline
+
+        ev = threading.Event()
+
+        class WedgedConn:
+            def send(self, msg):
+                ev.wait()  # blocked "in the OS write" forever
+
+        class FineConn:
+            def __init__(self):
+                self.sent = []
+
+            def send(self, msg):
+                self.sent.append(msg)
+
+        pipes = {1: FineConn(), 2: WedgedConn()}
+        try:
+            with pytest.raises(RuntimeError, match="dispatch timed out"):
+                _send_with_deadline(
+                    pipes, [(1, ("work",)), (2, ("work",))], 0.1
+                )
+            assert pipes[1].sent == [("work",)]
+            assert getattr(pipes[2], "_qba_poisoned", False)
+            assert not getattr(pipes[1], "_qba_poisoned", False)
+        finally:
+            ev.set()  # release the abandoned sender thread

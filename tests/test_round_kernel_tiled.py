@@ -125,6 +125,20 @@ class TestProbeTransientHandling:
     verdict pins the config to a slower engine (observed on hardware:
     it dropped the north star to the XLA engine, round 4)."""
 
+    @pytest.fixture(autouse=True)
+    def _isolate_probe_disk(self, monkeypatch):
+        # On a TPU backend the machine-wide disk cache is live: a stale
+        # 'test-kernel' entry would short-circuit _probe_plan before
+        # compile_one runs, and these probes must never pollute the
+        # real probe JSON.  Stub both ends; record puts for assertions.
+        import qba_tpu.ops.round_kernel_tiled as rkt
+
+        self.puts = []
+        monkeypatch.setattr(rkt, "_probe_disk_get", lambda k: None)
+        monkeypatch.setattr(
+            rkt, "_probe_disk_put", lambda k, v: self.puts.append((k, v))
+        )
+
     def _plan(self, cfg, compile_one):
         from qba_tpu.ops.round_kernel_tiled import _probe_plan
 
@@ -178,6 +192,62 @@ class TestProbeTransientHandling:
         assert chosen is None
         assert calls == [16, 8]  # no retry per candidate; all tried
         assert cache  # real shape verdicts persist
+
+    def test_transient_on_preferred_skips_disk_write(self):
+        # ADVICE r4: a transient tunnel error on the preferred candidate
+        # followed by a clean compile of a slower one must not pin the
+        # slower block machine-wide — the in-process cache may keep it
+        # (this process already paid the probes), but the disk cache
+        # must stay empty so the next process re-probes the preferred.
+        cfg = QBAConfig(n_parties=5, size_l=8)
+
+        def flaky_preferred(blk):
+            if blk == 16:
+                raise RuntimeError("remote_compile: HTTP 500")
+
+        chosen, cache = self._plan(cfg, flaky_preferred)
+        assert chosen == 8  # the slower candidate won this process
+        assert cache  # in-process verdict kept
+        assert self.puts == []  # but never persisted to disk
+
+    def test_deterministic_preferred_failure_still_persists(self):
+        # Control for the above: a *deterministic* preferred-candidate
+        # failure is a real shape verdict — the slower choice persists.
+        import qba_tpu.ops.round_kernel_tiled as rkt
+
+        cfg = QBAConfig(n_parties=5, size_l=8)
+
+        def oom_preferred(blk):
+            if blk == 16:
+                raise RuntimeError("Mosaic: scoped vmem limit exceeded")
+
+        chosen, cache = self._plan(cfg, oom_preferred)
+        assert chosen == 8
+        assert self.puts == [(rkt._probe_disk_key("test-kernel", cfg,
+                                                  extra="unit"), 8)]
+
+    def test_transient_blip_on_winner_still_persists(self):
+        # A deterministic preferred failure plus a transient blip that
+        # the WINNING candidate recovered from within its own retry is
+        # a fully real verdict — it must persist (the skip keys on
+        # abandoned-on-transient candidates, not on any transient seen).
+        import qba_tpu.ops.round_kernel_tiled as rkt
+
+        cfg = QBAConfig(n_parties=5, size_l=8)
+        calls = []
+
+        def mixed(blk):
+            calls.append(blk)
+            if blk == 16:
+                raise RuntimeError("Mosaic: scoped vmem limit exceeded")
+            if calls.count(8) == 1:
+                raise RuntimeError("remote_compile: HTTP 500")
+
+        chosen, cache = self._plan(cfg, mixed)
+        assert chosen == 8
+        assert calls == [16, 8, 8]
+        assert self.puts == [(rkt._probe_disk_key("test-kernel", cfg,
+                                                  extra="unit"), 8)]
 
 
 class TestPoolMechanics:
