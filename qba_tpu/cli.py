@@ -42,10 +42,14 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
     p.add_argument("--trials", type=int, default=trials_default)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
-        "--qsim-path", choices=("factorized", "dense", "dense_pallas"),
+        "--qsim-path",
+        choices=("factorized", "dense", "dense_pallas", "stabilizer"),
         default="factorized",
         help="quantum engine path (dense = joint statevector, validation "
-        "only; dense_pallas = same on the fused Pallas kernel)",
+        "only, <=20 qubits; dense_pallas = same on the fused Pallas "
+        "kernel; stabilizer = Clifford tableau — executes the actual "
+        "joint circuits at any party count, incl. the reference's "
+        "48-qubit 11-party scale)",
     )
     p.add_argument(
         "--round-engine",

@@ -34,8 +34,11 @@ class QBAConfig:
         explicit threefry key tree).
       qsim_path: "factorized" (closed-form sampler, any size — SURVEY §2.6),
         "dense" (full joint statevector, validation only, <= ~20 qubits),
-        or "dense_pallas" (dense path on the fused single-kernel Pallas
-        executor, :mod:`qba_tpu.ops.fused_circuit`).
+        "dense_pallas" (dense path on the fused single-kernel Pallas
+        executor, :mod:`qba_tpu.ops.fused_circuit`), or "stabilizer"
+        (vectorized Clifford tableau, :mod:`qba_tpu.qsim.stabilizer` —
+        executes the actual joint circuits at ANY party count, incl.
+        the reference's 48-qubit 11-party construction).
       max_accepts_per_round: static bound on mailbox slots per (sender,
         round). A lieutenant accepts each order value at most once
         (``v not in Vi``, ``tfg.py:294``), so ``w`` is a universal bound;
@@ -118,7 +121,9 @@ class QBAConfig:
             )
         if self.trials < 1:
             raise ValueError("trials must be >= 1")
-        if self.qsim_path not in ("factorized", "dense", "dense_pallas"):
+        if self.qsim_path not in (
+            "factorized", "dense", "dense_pallas", "stabilizer"
+        ):
             raise ValueError(f"unknown qsim_path {self.qsim_path!r}")
         if self.qsim_path.startswith("dense") and self.total_qubits > 20:
             raise ValueError(

@@ -16,6 +16,11 @@ The reference simulates every list position with a joint
 * :mod:`qba_tpu.qsim.sampler` — the factorized closed-form sampler
   (SURVEY §2.6): the exact output distribution of those Clifford circuits,
   sampled directly; scales to any ``nParties`` and is the production path.
+* :mod:`qba_tpu.qsim.stabilizer` — vectorized Clifford-tableau executor:
+  runs the *actual* joint circuits at the reference's real scale (48
+  qubits at 11 parties, ``tfg.py:76-80``; 204 at 33) where no
+  statevector can exist — the circuit-API path for ``qsim_path=
+  "stabilizer"`` and ``Drewom``'s beyond-20-qubit auto engine.
 """
 
 from qba_tpu.qsim.circuit import Circuit, Gate
@@ -33,7 +38,12 @@ def generate_lists_for(cfg, key):
     key tree stays identical across them."""
     if cfg.qsim_path == "factorized":
         return generate_lists(cfg, key)
-    impl = "auto" if cfg.qsim_path == "dense_pallas" else "xla"
+    if cfg.qsim_path == "stabilizer":
+        impl = "stabilizer"
+    elif cfg.qsim_path == "dense_pallas":
+        impl = "auto"
+    else:
+        impl = "xla"
     return generate_lists_dense(cfg, key, impl)
 
 

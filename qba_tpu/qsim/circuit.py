@@ -124,6 +124,12 @@ class Circuit:
         ops = tuple(self.ops)
         n = self.n_qubits
         n_params = self.n_params
+        if impl == "stabilizer":
+            raise ValueError(
+                "the stabilizer engine has no statevector (that is the "
+                "point: it runs circuits whose 2**n amplitudes cannot "
+                "exist); use compile()/compile_shots(impl='stabilizer')"
+            )
         if impl in ("pallas", "pallas_interpret"):
             from qba_tpu.ops import build_fused_circuit_run
 
@@ -156,8 +162,17 @@ class Circuit:
         The returned function is pure and jit/vmap-safe; measurement of
         every qubit (the reference's per-qubit MEASURE ops,
         ``tfg.py:49-51``) is one Born sample over the final state.
+
+        ``impl="stabilizer"`` routes Clifford circuits to the tableau
+        engine (:mod:`qba_tpu.qsim.stabilizer`) — identical contract,
+        no qubit-count cap (the reference's 48-qubit 11-party joint
+        circuit, ``tfg.py:76-80``, runs through here).
         """
         n = self.n_qubits
+        if impl == "stabilizer":
+            from qba_tpu.qsim.stabilizer import build_tableau_run
+
+            return build_tableau_run(n, tuple(self.ops), self.n_params)
         state_fn = self.compile_state(impl)
 
         def run(key: jax.Array, params: jnp.ndarray | None = None) -> jnp.ndarray:
@@ -171,9 +186,17 @@ class Circuit:
 
         Multi-shot batching: the statevector is prepared ONCE and only
         the Born sampling batches over shots (``shots`` must be static
-        under jit).
+        under jit).  On ``impl="stabilizer"`` each shot is an
+        independent vmapped tableau run (measurement collapses a
+        tableau; prep is O(n^2), the cheap part).
         """
         n = self.n_qubits
+        if impl == "stabilizer":
+            from qba_tpu.qsim.stabilizer import build_tableau_run_shots
+
+            return build_tableau_run_shots(
+                n, tuple(self.ops), self.n_params
+            )
         state_fn = self.compile_state(impl)
 
         def run(

@@ -125,22 +125,56 @@ class QCircuit:
 class Drewom:
     """qsimov-shaped executor: ``Drewom().execute(circuit)`` returns a
     list of shot results, each the measured bits in output-slot order —
-    ``execute(circ)[0]`` is the reference's usage (``tfg.py:76-80``)."""
+    ``execute(circ)[0]`` is the reference's usage (``tfg.py:76-80``).
 
-    def __init__(self, seed: int = 0):
+    ``engine`` selects the simulator: ``"auto"`` (default) runs the
+    dense statevector up to 20 qubits and switches to the stabilizer
+    tableau (:mod:`qba_tpu.qsim.stabilizer`) beyond — so the
+    reference's 48-qubit 11-party joint circuit executes through the
+    same three-line call it uses with qsimov.  ``"dense"`` /
+    ``"stabilizer"`` force one engine (the stabilizer engine rejects
+    non-Clifford gates with a ValueError).
+    """
+
+    _DENSE_QUBIT_CAP = 20
+
+    def __init__(self, seed: int = 0, engine: str = "auto"):
+        if engine not in ("auto", "dense", "stabilizer"):
+            raise ValueError(f"unknown Drewom engine {engine!r}")
         self._key = jax.random.key(seed)
+        self._engine = engine
         self._programs: dict = {}
+
+    def _impl_for(self, circuit: QCircuit) -> str:
+        if self._engine == "dense":
+            return "xla"
+        if self._engine == "stabilizer":
+            return "stabilizer"
+        if circuit.n_qubits <= self._DENSE_QUBIT_CAP:
+            return "xla"
+        from qba_tpu.qsim.stabilizer import is_clifford_ops
+
+        if is_clifford_ops(circuit._circ.ops):
+            return "stabilizer"
+        raise ValueError(
+            f"{circuit.n_qubits}-qubit circuit outside the stabilizer "
+            "engine's gate set (S/T/rotations/multi-control change the "
+            f"XZ normal form), and the dense engine caps at "
+            f"{self._DENSE_QUBIT_CAP} qubits"
+        )
 
     def execute(self, circuit: QCircuit, shots: int = 1) -> list[list[int]]:
         if not isinstance(circuit, QCircuit):
             raise TypeError("Drewom.execute expects a QCircuit")
-        struct = circuit._structure()
+        impl = self._impl_for(circuit)
+        struct = (impl,) + circuit._structure()
         run = self._programs.get(struct)
         if run is None:
-            # Multi-shot batching: the state is prepared once and only
-            # the Born sampling batches over shots (compile_shots).
+            # Multi-shot batching: dense prepares the state once and
+            # batches only the Born sampling; stabilizer vmaps whole
+            # tableau runs (compile_shots on either impl).
             run = jax.jit(
-                circuit._circ.compile_shots(), static_argnums=1
+                circuit._circ.compile_shots(impl), static_argnums=1
             )
             self._programs[struct] = run
         self._key, k = jax.random.split(self._key)

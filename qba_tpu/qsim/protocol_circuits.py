@@ -79,8 +79,11 @@ def generate_lists_dense(cfg: QBAConfig, key: jax.Array, impl: str = "xla"):
     per list position, all positions batched with ``vmap``.
 
     ``impl`` selects the circuit executor (:meth:`Circuit.compile`):
-    ``"xla"``, ``"pallas"``, ``"pallas_interpret"``, or ``"auto"`` (the
-    fused Pallas kernel on TPU, interpreter mode elsewhere).
+    ``"xla"``, ``"pallas"``, ``"pallas_interpret"``, ``"auto"`` (the
+    fused Pallas kernel on TPU, interpreter mode elsewhere), or
+    ``"stabilizer"`` (the Clifford tableau — the only executor that
+    runs the joint circuits at the reference's real party counts; the
+    dense impls cap at ~20 qubits).
 
     Returns ``(lists, qcorr)``: int32 ``[n_parties+1, size_l]`` decoded
     order values per party (row 0 = QSD extra copy, row 1 = commander),

@@ -306,3 +306,217 @@ class TestExtendedGates:
         shots = Drewom(seed=1).execute(circ, shots=2000)
         frac = sum(s[0] for s in shots) / 2000
         assert abs(frac - 0.75) < 0.04
+
+
+class TestStabilizer:
+    """The Clifford-tableau executor (VERDICT r4 item 1): runs the
+    reference's ACTUAL joint-circuit construction at its real scale —
+    48 qubits at 11 parties (proven feasible by ``log_11.txt``), 204 at
+    33 — through the same circuit API, closing SURVEY §2.16.
+
+    Validation strategy: (a) differential against the dense engine on
+    random small Clifford circuits — the sampled support must be the
+    dense support exactly (signs wrong => wrong support) and the
+    frequencies chi-square-consistent; (b) the protocol circuits'
+    per-shot structural laws, which are EXACT (group_i = r XOR
+    rands[i-1] at Q-corr, group0 == group1 at not-Q-corr); (c) the
+    full §2.6 closed-form invariants + value-law chi-squares at the
+    11-party scale the factorized sampler was previously validated at
+    only indirectly."""
+
+    def _random_clifford(self, n, depth, rng, with_xpow=False):
+        c = Circuit(n)
+        g = Gate(n)
+        p = 0
+        for _ in range(depth):
+            kind = rng.choice(
+                ["H", "X", "Y", "Z", "CNOT", "CZ"]
+                + (["XPOW"] if with_xpow else [])
+            )
+            if kind in ("CNOT", "CZ"):
+                a, b = rng.sample(range(n), 2)
+                g.add_operation(
+                    "X" if kind == "CNOT" else "Z", targets=a, controls=b
+                )
+            elif kind == "XPOW":
+                g.add_operation("XPOW", targets=rng.randrange(n), param=p)
+                p += 1
+            else:
+                g.add_operation(kind, targets=rng.randrange(n))
+        c.add_operation(g)
+        return c
+
+    def test_differential_vs_dense_random_clifford(self):
+        # Support must match exactly (a single sign error puts samples
+        # outside the dense support) and frequencies must be
+        # chi-square-consistent at significance 1e-4.
+        import random as pyrandom
+
+        from scipy import stats
+
+        rng = pyrandom.Random(0)
+        shots = 4000
+        for trial in range(6):
+            n = rng.choice([3, 4, 5])
+            c = self._random_clifford(n, 14, rng, with_xpow=trial >= 3)
+            n_par = max(c.n_params, 1)
+            params = jnp.asarray(
+                [rng.randrange(2) for _ in range(n_par)], dtype=jnp.int32
+            )
+            probs = np.abs(
+                np.asarray(c.compile_state("xla")(params))
+            ) ** 2
+            run = jax.jit(c.compile_shots("stabilizer"), static_argnums=1)
+            bits = np.asarray(run(jax.random.key(trial), shots, params))
+            idx = (bits * (2 ** np.arange(n - 1, -1, -1))).sum(-1)
+            emp = np.bincount(idx, minlength=2**n)
+            sup = probs > 1e-9
+            assert emp[~sup].sum() == 0, (
+                f"trial {trial}: sampled outside the dense support"
+            )
+            if sup.sum() > 1:  # dof 0 on deterministic circuits
+                pv = stats.chisquare(
+                    emp[sup], shots * probs[sup] / probs[sup].sum()
+                ).pvalue
+                assert pv > 1e-4, (trial, pv)
+
+    def test_rejects_non_clifford(self):
+        import pytest
+
+        c = Circuit(2)
+        c.add_operation(Gate(2).add_operation("T", targets=0))
+        with pytest.raises(ValueError, match="Clifford"):
+            c.compile("stabilizer")
+        c2 = Circuit(2)
+        c2.add_operation(Gate(2).add_operation("S", targets=0))
+        with pytest.raises(ValueError, match="Clifford"):
+            c2.compile("stabilizer")
+        c3 = Circuit(3)
+        c3.add_operation(
+            Gate(3).add_operation("X", targets=0, controls=(1, 2))
+        )
+        with pytest.raises(ValueError, match="Clifford"):
+            c3.compile("stabilizer")
+        c4 = Circuit(2)
+        c4.add_operation(Gate(2).add_operation("H", targets=0, controls=1))
+        with pytest.raises(ValueError, match="stabilizer engine"):
+            c4.compile("stabilizer")
+
+    def test_no_statevector(self):
+        import pytest
+
+        c = Circuit(2)
+        c.add_operation(Gate(2).add_operation("H", targets=0))
+        with pytest.raises(ValueError, match="no statevector"):
+            c.compile_state("stabilizer")
+
+    def test_reference_scale_48_qubits_exact_law(self):
+        # The reference's real 11-party construction (tfg.py:43-52,
+        # proven feasible by log_11.txt): one Born sample of the
+        # 48-qubit joint Q-correlated circuit must satisfy the EXACT
+        # per-shot law group_i = r XOR rands[i-1].
+        from qba_tpu.qsim.protocol_circuits import (
+            _perm_bits,
+            gen_q_corr_circuit,
+        )
+
+        n_p, nq = 11, 4
+        run = jax.jit(gen_q_corr_circuit(n_p, nq).compile("stabilizer"))
+        perm = jax.random.permutation(
+            jax.random.key(3), jnp.arange(1, n_p + 1, dtype=jnp.int32)
+        )
+        for seed in range(3):
+            bits = np.asarray(run(jax.random.key(seed), _perm_bits(perm, nq)))
+            vals = (
+                bits.reshape(n_p + 1, nq) * (2 ** np.arange(nq - 1, -1, -1))
+            ).sum(-1)
+            expect = np.concatenate([[vals[0]], vals[0] ^ np.asarray(perm)])
+            np.testing.assert_array_equal(vals, expect)
+
+    def test_reference_scale_204_qubits_smoke(self):
+        # 33 parties = 34 groups x 6 qubits = 204 qubits: far beyond
+        # any statevector, exact on the tableau.
+        from qba_tpu.qsim.protocol_circuits import (
+            _perm_bits,
+            gen_q_corr_circuit,
+        )
+
+        n_p, nq = 33, 6
+        run = jax.jit(gen_q_corr_circuit(n_p, nq).compile("stabilizer"))
+        perm = jax.random.permutation(
+            jax.random.key(4), jnp.arange(1, n_p + 1, dtype=jnp.int32)
+        )
+        bits = np.asarray(run(jax.random.key(0), _perm_bits(perm, nq)))
+        vals = (
+            bits.reshape(n_p + 1, nq) * (2 ** np.arange(nq - 1, -1, -1))
+        ).sum(-1)
+        expect = np.concatenate([[vals[0]], vals[0] ^ np.asarray(perm)])
+        np.testing.assert_array_equal(vals, expect)
+
+    def test_drewom_executes_11_party_joint_circuit(self):
+        # VERDICT r4 done-criterion: Drewom().execute() of the 11-party
+        # joint circuit runs — the reference's three-line usage
+        # (tfg.py:76-80) at its real scale, via the qsimov-shaped API.
+        from qba_tpu.qsim.compat import Drewom, QCircuit, QGate
+
+        n_p, nq = 11, 4
+        size = (n_p + 1) * nq
+        gate = QGate(size, 0, "not Q-Correlated")
+        for i in range(nq, size):
+            gate.add_operation("H", targets=i)
+        for i in range(nq):
+            gate.add_operation("X", targets=i, controls=i + nq)
+        circ = QCircuit(size, size, "nqc")
+        circ.add_operation(gate)
+        for i in range(size):
+            circ.add_operation("MEASURE", targets=i, outputs=i)
+        res = Drewom(seed=3).execute(circ, shots=8)
+        assert len(res) == 8 and len(res[0]) == size
+        for shot in res:
+            vals = (
+                np.array(shot).reshape(n_p + 1, nq)
+                * (2 ** np.arange(nq - 1, -1, -1))
+            ).sum(-1)
+            assert vals[0] == vals[1]  # CNOT copy law, exact per shot
+
+    def test_full_scale_lists_match_factorized_law(self):
+        # The §2.6 cross-validation AT THE REFERENCE'S SCALE (VERDICT
+        # r4 item 1 done-criterion): lists generated by executing the
+        # actual 48-qubit circuits satisfy every exact closed-form
+        # invariant, and the value marginals pass chi-square against
+        # the factorized sampler's law (uniform on [0, w) per row; r
+        # uniform; XOR offsets a uniform permutation coordinate).
+        from scipy import stats
+
+        cfg = QBAConfig(n_parties=11, size_l=256, qsim_path="stabilizer")
+        lists, qcorr = generate_lists_dense(
+            cfg, jax.random.key(6), impl="stabilizer"
+        )
+        assert lists.shape == (12, 256)
+        check_closed_form_properties(lists, qcorr, cfg.w)
+        lists, qcorr = np.asarray(lists), np.asarray(qcorr)
+        r = lists[0][qcorr]
+        assert stats.chisquare(np.bincount(r, minlength=cfg.w)).pvalue > 1e-4
+        for row in lists:
+            obs = np.bincount(row, minlength=cfg.w)
+            assert stats.chisquare(obs).pvalue > 1e-4
+        # Direct two-sample check against the factorized sampler on the
+        # commander row (same law <=> same protocol-visible inputs).
+        lf, _ = generate_lists(cfg, jax.random.key(7))
+        a = np.bincount(lists[1], minlength=cfg.w)
+        b = np.bincount(np.asarray(lf)[1], minlength=cfg.w)
+        table = np.stack([a, b])
+        assert stats.chi2_contingency(table).pvalue > 1e-4
+
+    def test_end_to_end_trial_through_stabilizer_lists(self):
+        # qsim_path="stabilizer" plugs into the full protocol: lists
+        # come from executing the real joint circuits, then the round
+        # engines run unchanged (honest config decides successfully).
+        from qba_tpu.backends import run_trials
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, trials=2, qsim_path="stabilizer",
+            seed=2,
+        )
+        out = run_trials(cfg)
+        assert np.asarray(out.trials.success).all()
