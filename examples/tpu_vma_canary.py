@@ -1,0 +1,103 @@
+"""Real-TPU canary for the party-sharded tiled engine's vma checking.
+
+History (docs/KNOWN_ISSUES.md KI-1): round 4 shipped the flagship
+multi-device path (packet-tiled kernels under ``shard_map``) with
+``check_vma=False`` after a Mosaic ``pvary`` lowering failure, leaving
+its semantics pinned only by CPU-mesh equivalence tests.  Round 5 found
+the failure gone once the kernels' ``out_vma`` is actually declared
+(round 4 hard-coded ``None``), and the checker is now ON by default on
+TPU.  This canary re-validates all three configurations on hardware so
+a toolchain regression is caught loudly, not silently:
+
+1. **Checker-ON control** — the grid-less monolithic Pallas engine with
+   ``check_vma=True`` (the configuration that always worked).
+2. **Tiled, checker force-OFF** (``QBA_TILED_CHECK_VMA=0``, the escape
+   hatch) — must stay bit-identical to the single-device tiled engine.
+3. **Tiled, default (checker ON on TPU)** — must compile, run, and stay
+   bit-identical.  If THIS step fails with a Mosaic lowering error, the
+   toolchain has regressed: re-open KI-1 and ship
+   ``QBA_TILED_CHECK_VMA=0`` as the default until fixed.
+
+Run:  python examples/tpu_vma_canary.py        (needs a real TPU)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _require_tpu():
+    if jax.default_backend() != "tpu":
+        print("SKIP: no TPU backend (this canary is hardware-only)")
+        sys.exit(0)
+
+
+def _cfg(engine):
+    from qba_tpu.config import QBAConfig
+
+    return QBAConfig(
+        n_parties=5, size_l=16, n_dishonest=2, trials=4,
+        round_engine=engine, seed=9,
+    )
+
+
+def _tiled_spmd_vs_single(label):
+    from qba_tpu.backends.jax_backend import run_trials
+    from qba_tpu.parallel.mesh import make_mesh
+    from qba_tpu.parallel.spmd import run_trials_spmd
+
+    cfg = _cfg("pallas_tiled")
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1])
+    spmd_out = run_trials_spmd(cfg, mesh)
+    single = run_trials(cfg)
+    a = np.asarray(spmd_out.trials.success)
+    b = np.asarray(single.trials.success)
+    assert (a == b).all(), (a, b)
+    av = np.asarray(spmd_out.trials.decisions)
+    bv = np.asarray(single.trials.decisions)
+    assert (av == bv).all(), "decision mismatch spmd vs single-device"
+    print(f"{label}: OK (bit-identical to single-device)")
+
+
+def step_control_monolithic():
+    from qba_tpu.parallel.mesh import make_mesh
+    from qba_tpu.parallel.spmd import run_trials_spmd
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1])
+    out = run_trials_spmd(_cfg("pallas"), mesh)
+    print("1. monolithic checker-ON tp=1: OK",
+          np.asarray(out.trials.success).tolist())
+
+
+def step_tiled_checker_off():
+    os.environ["QBA_TILED_CHECK_VMA"] = "0"
+    try:
+        _tiled_spmd_vs_single("2. tiled checker-OFF tp=1 (escape hatch)")
+    finally:
+        del os.environ["QBA_TILED_CHECK_VMA"]
+
+
+def step_tiled_default_checker_on():
+    try:
+        _tiled_spmd_vs_single("3. tiled DEFAULT (checker ON) tp=1")
+    except Exception as e:
+        print(
+            "3. tiled DEFAULT (checker ON) tp=1: FAILED — the toolchain "
+            "has regressed on vma-tracked grid'd kernels.  Re-open "
+            "docs/KNOWN_ISSUES.md KI-1 and default QBA_TILED_CHECK_VMA "
+            f"to 0 in qba_tpu/parallel/spmd.py.\n   {type(e).__name__}: "
+            f"{str(e)[:600]}"
+        )
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    _require_tpu()
+    step_control_monolithic()
+    step_tiled_checker_off()
+    step_tiled_default_checker_on()
+    print("canary: all configurations healthy")

@@ -16,6 +16,12 @@ from __future__ import annotations
 import dataclasses
 import math
 
+# Joint-statevector feasibility bound: 2**20 complex64 amplitudes ≈ 8 MB
+# per state, the practical dense-path ceiling under vmap over list
+# positions.  Single source of truth for the config validator and
+# Drewom's auto engine switch (qsim/compat.py).
+DENSE_QUBIT_CAP = 20
+
 
 @dataclasses.dataclass(frozen=True)
 class QBAConfig:
@@ -125,7 +131,9 @@ class QBAConfig:
             "factorized", "dense", "dense_pallas", "stabilizer"
         ):
             raise ValueError(f"unknown qsim_path {self.qsim_path!r}")
-        if self.qsim_path.startswith("dense") and self.total_qubits > 20:
+        if self.qsim_path.startswith("dense") and (
+            self.total_qubits > DENSE_QUBIT_CAP
+        ):
             raise ValueError(
                 f"dense qsim path infeasible at {self.total_qubits} qubits; "
                 "use qsim_path='factorized'"

@@ -21,6 +21,7 @@ change the randomness.
 from __future__ import annotations
 
 import functools
+import os
 import warnings
 
 import jax
@@ -40,12 +41,37 @@ from qba_tpu.rounds.engine import (
 )
 
 
+def _tiled_check_vma() -> bool:
+    """Whether the party-sharded tiled engine runs with shard_map's
+    replication checker ON (and the kernels' output vma declared).
+
+    Default: ON on real TPU, OFF in kernel interpret mode (interpret
+    stages ref reads as dynamic_slices whose literal indices lack the
+    operand's vma, which the checker rejects — CPU-mesh tests and the
+    multichip dryrun run interpret).  Round 4 shipped this path
+    checker-OFF after a Mosaic ``pvary`` lowering failure; round 5
+    found the failure gone once ``out_vma`` is actually threaded into
+    the tiled builders (the round-4 code hard-coded ``None``) — see
+    docs/KNOWN_ISSUES.md KI-1 and ``examples/tpu_vma_canary.py``, which
+    re-validates all three configurations on hardware.
+    ``QBA_TILED_CHECK_VMA=0`` force-disables (escape hatch if a future
+    toolchain regresses); ``=1`` force-enables (e.g. to probe interpret
+    mode after a JAX upgrade)."""
+    flag = os.environ.get("QBA_TILED_CHECK_VMA", "")
+    if flag == "1":
+        return True  # force, even in interpret mode (probe a JAX fix)
+    if flag == "0":
+        return False
+    return jax.default_backend() == "tpu"  # interpret mode: off
+
+
 def _trial_party_sharded(
     cfg: QBAConfig,
     n_tp: int,
     key: jax.Array,
     engine: str = "xla",
     vma_axes: frozenset | None = None,
+    tiled_out_vma: frozenset | None = None,
 ) -> TrialResult:
     """One trial with lieutenants sharded over the bound ``tp`` mesh axis.
 
@@ -154,18 +180,22 @@ def _trial_party_sharded(
         )
 
         interpret = jax.default_backend() != "tpu"
-        # out_vma stays None: this engine always runs check_vma=False
-        # (a grid'd kernel under vma tracking traces pvary ops Mosaic
-        # cannot lower — see _spmd_batch), so vma declarations would be
-        # dead machinery.  Re-enable when JAX lowers pvary in Mosaic.
+        # out_vma powers shard_map's replication checker (ON by default
+        # on TPU since round 5; resolved by the caller so the flag is
+        # part of the jit cache key — see _spmd_batch); None when the
+        # checker is off, where the declarations would be dead
+        # machinery.
+        out_vma = tiled_out_vma
         blk = resolve_tiled_block(cfg, n_recv=n_local)
         verdict = build_verdict_kernel(
             cfg, blk, interpret=interpret, n_recv=n_local,
+            out_vma=out_vma,
         )
         blk_d = resolve_rebuild_block(cfg, n_recv=n_local)
         rebuild_k = (
             build_rebuild_kernel(
                 cfg, blk_d, interpret=interpret, n_recv=n_local,
+                out_vma=out_vma,
             )
             if blk_d is not None
             else None
@@ -257,40 +287,55 @@ def _trial_party_sharded(
     return finish_trial(cfg, vi, v_comm, honest, overflow)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 3, 4))
 def _spmd_batch(
-    cfg: QBAConfig, mesh: Mesh, keys: jax.Array, engine: str = "xla"
+    cfg: QBAConfig,
+    mesh: Mesh,
+    keys: jax.Array,
+    engine: str = "xla",
+    check_vma: bool = True,
 ) -> TrialResult:
+    """``check_vma`` must be resolved by the CALLER (see
+    :func:`_resolve_check_vma`) so it participates in the jit cache
+    key: resolved inside the traced body, toggling the
+    ``QBA_TILED_CHECK_VMA`` escape hatch after a first compile would be
+    silently ignored by the cache — which would, among other things,
+    turn the hardware canary's decisive step into a false pass."""
     n_tp = axis_sizes(mesh)["tp"]
     key_spec = P("dp") if "dp" in mesh.axis_names else P()
 
     vma_axes = frozenset(mesh.axis_names)
+    tiled_out_vma = vma_axes if check_vma else None
 
     def body(local_keys):
         return jax.vmap(
-            lambda k: _trial_party_sharded(cfg, n_tp, k, engine, vma_axes)
+            lambda k: _trial_party_sharded(
+                cfg, n_tp, k, engine, vma_axes, tiled_out_vma
+            )
         )(local_keys)
 
-    # check_vma stays ON for the production paths: the trial body ends in
-    # psums over tp, which the replication checker can statically verify
-    # (see _trial_party_sharded), and on real TPU the monolithic pallas
-    # round step is an opaque call with declared output vma.  Two JAX
-    # limitations force it OFF elsewhere: (a) the kernels' interpret
-    # mode (CPU tests) stages ref reads as dynamic_slices whose literal
-    # indices lack the operand's vma, which the checker rejects; (b) a
-    # GRID'd pallas kernel (the tiled engine) traced under vma tracking
-    # gets `pvary` promotions inside its kernel jaxpr wherever a
-    # ref-read value meets a literal, and Mosaic has no pvary lowering
-    # (the grid-less monolithic kernel is unaffected — its kernel trace
-    # strips operand vma).
-    use_check_vma = engine != "pallas_tiled" and not (
-        engine == "pallas" and jax.default_backend() != "tpu"
-    )
     shard = jax.shard_map(
         body, mesh=mesh, in_specs=key_spec, out_specs=key_spec,
-        check_vma=use_check_vma,
+        check_vma=check_vma,
     )
     return shard(keys)
+
+
+def _resolve_check_vma(engine: str) -> bool:
+    """shard_map replication checking is ON for every engine on real
+    TPU (since round 5 — including the tiled engine, whose round-4
+    Mosaic ``pvary`` failure disappeared once out_vma was actually
+    threaded into its builders; docs/KNOWN_ISSUES.md KI-1): the trial
+    body ends in psums over tp, which the checker statically verifies,
+    and each Pallas kernel is an opaque call with declared output vma.
+    One JAX limitation forces it OFF in kernel interpret mode (CPU
+    tests/dryrun): interpret stages ref reads as dynamic_slices whose
+    literal indices lack the operand's vma, which the checker rejects.
+    The tiled engine additionally honors the ``QBA_TILED_CHECK_VMA``
+    escape hatch (:func:`_tiled_check_vma`)."""
+    if engine == "pallas_tiled":
+        return _tiled_check_vma()
+    return not (engine == "pallas" and jax.default_backend() != "tpu")
 
 
 def run_trials_spmd(
@@ -316,7 +361,9 @@ def run_trials_spmd(
     require_divisible(cfg.n_lieutenants, tp, "n_lieutenants", "tp")
     engine = _resolve_spmd_engine(cfg, cfg.n_lieutenants // tp)
     try:
-        return aggregate(_spmd_batch(cfg, mesh, keys, engine))
+        return aggregate(
+            _spmd_batch(cfg, mesh, keys, engine, _resolve_check_vma(engine))
+        )
     except Exception as e:
         # The residual probe-context gap (ADVICE r2 item 1): the kernel
         # probes compile standalone, not under the vma-annotated
@@ -334,7 +381,9 @@ def run_trials_spmd(
             RuntimeWarning,
             stacklevel=2,
         )
-        return aggregate(_spmd_batch(cfg, mesh, keys, "xla"))
+        return aggregate(
+            _spmd_batch(cfg, mesh, keys, "xla", _resolve_check_vma("xla"))
+        )
 
 
 def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:

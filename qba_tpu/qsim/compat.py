@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import jax
 
+from qba_tpu.config import DENSE_QUBIT_CAP
 from qba_tpu.qsim.circuit import Circuit, Gate
 
 
@@ -136,8 +137,6 @@ class Drewom:
     non-Clifford gates with a ValueError).
     """
 
-    _DENSE_QUBIT_CAP = 20
-
     def __init__(self, seed: int = 0, engine: str = "auto"):
         if engine not in ("auto", "dense", "stabilizer"):
             raise ValueError(f"unknown Drewom engine {engine!r}")
@@ -150,7 +149,7 @@ class Drewom:
             return "xla"
         if self._engine == "stabilizer":
             return "stabilizer"
-        if circuit.n_qubits <= self._DENSE_QUBIT_CAP:
+        if circuit.n_qubits <= DENSE_QUBIT_CAP:
             return "xla"
         from qba_tpu.qsim.stabilizer import is_clifford_ops
 
@@ -160,7 +159,7 @@ class Drewom:
             f"{circuit.n_qubits}-qubit circuit outside the stabilizer "
             "engine's gate set (S/T/rotations/multi-control change the "
             f"XZ normal form), and the dense engine caps at "
-            f"{self._DENSE_QUBIT_CAP} qubits"
+            f"{DENSE_QUBIT_CAP} qubits"
         )
 
     def execute(self, circuit: QCircuit, shots: int = 1) -> list[list[int]]:

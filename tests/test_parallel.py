@@ -147,11 +147,11 @@ class TestPartySharded:
         real_batch = spmd_mod._spmd_batch
         engines_tried = []
 
-        def failing_batch(cfg_, mesh_, keys_, engine="xla"):
+        def failing_batch(cfg_, mesh_, keys_, engine="xla", check_vma=True):
             engines_tried.append(engine)
             if engine != "xla":
                 raise RuntimeError("forced shard_map compile failure")
-            return real_batch(cfg_, mesh_, keys_, engine)
+            return real_batch(cfg_, mesh_, keys_, engine, check_vma)
 
         monkeypatch.setattr(spmd_mod, "_spmd_batch", failing_batch)
         # Auto path: force the resolver to pick a kernel engine.
