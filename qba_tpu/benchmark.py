@@ -58,8 +58,40 @@ def measure_batch(
     chunk = chunk_trials or cfg.trials
     n_chunks = -(-cfg.trials // chunk)
     cfg_chunk = dataclasses.replace(cfg, trials=chunk)
+
+    def run_chunk(keys_chunk):
+        try:
+            return run_trials(cfg_chunk, keys_chunk)
+        except Exception as e:  # name the batch-size HBM ceiling (KI-2)
+            msg = str(e)
+            if "Ran out of memory in memory space hbm" not in msg:
+                raise
+            # Only the compile-time verdict is the hard per-config
+            # ceiling; a runtime RESOURCE_EXHAUSTED with the same
+            # marker can be transient pressure (HBM held elsewhere).
+            compile_time = "compile permanent error" in msg
+            raise RuntimeError(
+                f"single-batch Monte-Carlo of {chunk} trials exceeds "
+                f"TPU HBM {'at compile time' if compile_time else 'at run time'} "
+                f"for this config (n_parties={cfg.n_parties}, "
+                f"size_l={cfg.size_l}, n_dishonest={cfg.n_dishonest}). "
+                + (
+                    "This is the real batch ceiling, not a compiler "
+                    "bug — on a remote-tunnel backend the OOM arrives "
+                    "disguised as a compile-helper exit-1 "
+                    "(docs/KNOWN_ISSUES.md KI-2; measured at the "
+                    "north-star scale: 1088 trials fit in 15.75 GB, "
+                    "1152 overflow by 1.8 GB).  "
+                    if compile_time
+                    else "If other processes hold HBM, freeing them may "
+                    "suffice (docs/KNOWN_ISSUES.md KI-2 documents the "
+                    "per-config compile-time ceiling).  "
+                )
+                + "Split the batch with chunk_trials / --chunk-trials."
+            ) from e
+
     if warmup:
-        fence(run_trials(cfg_chunk, trial_keys(cfg_chunk)))  # compile
+        fence(run_chunk(trial_keys(cfg_chunk)))  # compile
     times, results = [], None
     for rep in range(reps):
         keys = jax.random.split(
@@ -68,7 +100,7 @@ def measure_batch(
         fence(keys)  # key generation off the clock
         t0 = time.perf_counter()
         results = [
-            run_trials(cfg_chunk, keys[i * chunk : (i + 1) * chunk])
+            run_chunk(keys[i * chunk : (i + 1) * chunk])
             for i in range(n_chunks)
         ]
         fence(results)  # last leaf = last chunk -> all chunks done

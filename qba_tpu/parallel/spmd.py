@@ -62,6 +62,13 @@ def _tiled_check_vma() -> bool:
         return True  # force, even in interpret mode (probe a JAX fix)
     if flag == "0":
         return False
+    if flag:  # an escape hatch must fail loudly, not fall back silently
+        raise ValueError(
+            f"QBA_TILED_CHECK_VMA={flag!r}: expected '0' (force the "
+            "replication checker off) or '1' (force it on); unset it "
+            "for the default (on for TPU, off for kernel interpret "
+            "mode)"
+        )
     return jax.default_backend() == "tpu"  # interpret mode: off
 
 

@@ -221,3 +221,39 @@ class TestStudyCommand:
         with pytest.raises(SystemExit):
             main(["study", "--n-parties", "3", "--size-l", "4",
                   "--param", "w", "--values", "1,2"])
+
+
+class TestBatchCeilingDiagnostic:
+    def test_hbm_oom_is_named_not_raw(self, monkeypatch):
+        # KI-2: a compile-time HBM OOM (possibly wrapped in the remote
+        # helper's HTTP 500) must surface as a named ceiling with the
+        # chunking remedy, not a bare helper crash.
+        import qba_tpu.backends.jax_backend as jb
+        from qba_tpu.benchmark import measure_batch
+        from qba_tpu.config import QBAConfig
+
+        def oom(cfg, keys=None):
+            raise RuntimeError(
+                "INTERNAL: http://127.0.0.1:1/remote_compile: HTTP 500: "
+                "tpu_compile_helper subprocess exit code 1 ... XLA:TPU "
+                "compile permanent error. Ran out of memory in memory "
+                "space hbm. Used 21.02G of 15.75G hbm."
+            )
+
+        monkeypatch.setattr(jb, "run_trials", oom)
+        cfg = QBAConfig(n_parties=3, size_l=4, trials=8)
+        with pytest.raises(RuntimeError, match="KI-2"):
+            measure_batch(cfg, reps=1)
+
+    def test_non_oom_errors_pass_through(self, monkeypatch):
+        import qba_tpu.backends.jax_backend as jb
+        from qba_tpu.benchmark import measure_batch
+        from qba_tpu.config import QBAConfig
+
+        def other(cfg, keys=None):
+            raise RuntimeError("some unrelated lowering failure")
+
+        monkeypatch.setattr(jb, "run_trials", other)
+        cfg = QBAConfig(n_parties=3, size_l=4, trials=8)
+        with pytest.raises(RuntimeError, match="unrelated"):
+            measure_batch(cfg, reps=1)

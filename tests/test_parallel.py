@@ -460,3 +460,26 @@ def test_two_process_distributed_cpu_smoke(tmp_path):
                 pytest.skip(f"distributed service unavailable: {out[-200:]}")
             pytest.fail(f"distributed smoke rc={rc}:\n{out[-2000:]}")
         assert f"DIST_SMOKE_RESULT {outs.index((rc, out))} 6.0" in out, out
+
+
+class TestCheckVmaFlag:
+    def test_bad_flag_value_raises(self, monkeypatch):
+        # The escape hatch must fail loudly on unrecognized values, not
+        # silently fall back to the backend default (review r5).
+        import qba_tpu.parallel.spmd as spmd_mod
+
+        monkeypatch.setenv("QBA_TILED_CHECK_VMA", "true")
+        with pytest.raises(ValueError, match="QBA_TILED_CHECK_VMA"):
+            spmd_mod._tiled_check_vma()
+
+    def test_flag_values(self, monkeypatch):
+        import qba_tpu.parallel.spmd as spmd_mod
+
+        monkeypatch.setenv("QBA_TILED_CHECK_VMA", "1")
+        assert spmd_mod._tiled_check_vma() is True
+        monkeypatch.setenv("QBA_TILED_CHECK_VMA", "0")
+        assert spmd_mod._tiled_check_vma() is False
+        monkeypatch.delenv("QBA_TILED_CHECK_VMA")
+        assert spmd_mod._tiled_check_vma() is (
+            __import__("jax").default_backend() == "tpu"
+        )
