@@ -60,35 +60,7 @@ def measure_batch(
     cfg_chunk = dataclasses.replace(cfg, trials=chunk)
 
     def run_chunk(keys_chunk):
-        try:
-            return run_trials(cfg_chunk, keys_chunk)
-        except Exception as e:  # name the batch-size HBM ceiling (KI-2)
-            msg = str(e)
-            if "Ran out of memory in memory space hbm" not in msg:
-                raise
-            # Only the compile-time verdict is the hard per-config
-            # ceiling; a runtime RESOURCE_EXHAUSTED with the same
-            # marker can be transient pressure (HBM held elsewhere).
-            compile_time = "compile permanent error" in msg
-            raise RuntimeError(
-                f"single-batch Monte-Carlo of {chunk} trials exceeds "
-                f"TPU HBM {'at compile time' if compile_time else 'at run time'} "
-                f"for this config (n_parties={cfg.n_parties}, "
-                f"size_l={cfg.size_l}, n_dishonest={cfg.n_dishonest}). "
-                + (
-                    "This is the real batch ceiling, not a compiler "
-                    "bug — on a remote-tunnel backend the OOM arrives "
-                    "disguised as a compile-helper exit-1 "
-                    "(docs/KNOWN_ISSUES.md KI-2; measured at the "
-                    "north-star scale: 1088 trials fit in 15.75 GB, "
-                    "1152 overflow by 1.8 GB).  "
-                    if compile_time
-                    else "If other processes hold HBM, freeing them may "
-                    "suffice (docs/KNOWN_ISSUES.md KI-2 documents the "
-                    "per-config compile-time ceiling).  "
-                )
-                + "Split the batch with chunk_trials / --chunk-trials."
-            ) from e
+        return _run_trials_named(run_trials, cfg, cfg_chunk, keys_chunk)
 
     if warmup:
         fence(run_chunk(trial_keys(cfg_chunk)))  # compile
@@ -106,3 +78,115 @@ def measure_batch(
         fence(results)  # last leaf = last chunk -> all chunks done
         times.append(time.perf_counter() - t0)
     return times, n_chunks * chunk, results
+
+
+def _run_trials_named(run_trials, cfg, cfg_chunk, keys_chunk):
+    """``run_trials`` with the KI-2 HBM-ceiling diagnostic attached."""
+    chunk = cfg_chunk.trials
+    try:
+        return run_trials(cfg_chunk, keys_chunk)
+    except Exception as e:  # name the batch-size HBM ceiling (KI-2)
+        msg = str(e)
+        if "Ran out of memory in memory space hbm" not in msg:
+            raise
+        # Only the compile-time verdict is the hard per-config
+        # ceiling; a runtime RESOURCE_EXHAUSTED with the same
+        # marker can be transient pressure (HBM held elsewhere).
+        compile_time = "compile permanent error" in msg
+        raise RuntimeError(
+            f"single-batch Monte-Carlo of {chunk} trials exceeds "
+            f"TPU HBM {'at compile time' if compile_time else 'at run time'} "
+            f"for this config (n_parties={cfg.n_parties}, "
+            f"size_l={cfg.size_l}, n_dishonest={cfg.n_dishonest}). "
+            + (
+                "This is the real batch ceiling, not a compiler "
+                "bug — on a remote-tunnel backend the OOM arrives "
+                "disguised as a compile-helper exit-1 "
+                "(docs/KNOWN_ISSUES.md KI-2; measured at the "
+                "north-star scale: 1088 trials fit in 15.75 GB, "
+                "1152 overflow by 1.8 GB).  "
+                if compile_time
+                else "If other processes hold HBM, freeing them may "
+                "suffice (docs/KNOWN_ISSUES.md KI-2 documents the "
+                "per-config compile-time ceiling).  "
+            )
+            + "Split the batch with chunk_trials / --chunk-trials."
+        ) from e
+
+
+def measure_device_batch(
+    cfg: QBAConfig,
+    pairs: int = 3,
+    reps_lo: int = 1,
+    reps_hi: int = 5,
+    chunk_trials: int | None = None,
+    *,
+    warmup: bool = True,
+):
+    """Slope-based DEVICE-side batch seconds (VERDICT r4 item 4).
+
+    On a remote-tunnel backend every fenced wall time includes a
+    ~60-100 ms result fetch with tens of ms of jitter — ~40% spread at
+    the headline config, which :func:`measure_batch` honestly reports
+    but cannot decompose.  This measures the device-side sustained time
+    per batch by the slope trick: dispatch ``r`` same-shape batches
+    back-to-back with ONE final fence, for ``r = reps_lo`` and
+    ``r = reps_hi``; the difference quotient
+
+        (T(reps_hi) - T(reps_lo)) / (reps_hi - reps_lo)
+
+    cancels the constant dispatch + fetch overhead, leaving the
+    per-batch device execution time (host enqueue overlaps device
+    execution on the async stream, so sustained throughput is the
+    honest interpretation).  Each of ``pairs`` repetitions draws fresh
+    keys (a result-caching backend cannot fake the slope).
+
+    Returns ``(device_seconds_per_batch: list[float], n_run)`` — one
+    slope estimate per pair; callers take the median and quote the
+    spread.
+    """
+    import jax
+
+    from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
+
+    if pairs < 1:
+        raise ValueError("pairs must be >= 1")
+    if not 1 <= reps_lo < reps_hi:
+        raise ValueError("need 1 <= reps_lo < reps_hi")
+    chunk = chunk_trials or cfg.trials
+    n_chunks = -(-cfg.trials // chunk)
+    cfg_chunk = dataclasses.replace(cfg, trials=chunk)
+    if warmup:
+        fence(
+            _run_trials_named(
+                run_trials, cfg, cfg_chunk, trial_keys(cfg_chunk)
+            )
+        )
+
+    def timed_chain(r: int, tag: int) -> float:
+        keys = jax.random.split(
+            jax.random.key(cfg.seed + tag), r * n_chunks * chunk
+        )
+        fence(keys)  # key generation off the clock
+        t0 = time.perf_counter()
+        res = None
+        for i in range(r * n_chunks):
+            res = _run_trials_named(
+                run_trials, cfg, cfg_chunk,
+                keys[i * chunk : (i + 1) * chunk],
+            )
+        fence(res)  # single stream: last batch done => all done
+        return time.perf_counter() - t0
+
+    # Throwaway chain at FULL depth: the first dispatch burst after
+    # (re)warming pays one-off tunnel/queue setup proportional to the
+    # chain length — a short throwaway leaves the first long chain's
+    # T_hi inflated and corrupts the first slope (observed: 2-4x
+    # outliers on the first pair at the headline config).
+    timed_chain(reps_hi, 999)
+    slopes = []
+    for p in range(pairs):
+        t_lo = timed_chain(reps_lo, 1001 + 2 * p)
+        t_hi = timed_chain(reps_hi, 1002 + 2 * p)
+        slopes.append((t_hi - t_lo) / (reps_hi - reps_lo))
+    return slopes, n_chunks * chunk

@@ -257,3 +257,31 @@ class TestBatchCeilingDiagnostic:
         cfg = QBAConfig(n_parties=3, size_l=4, trials=8)
         with pytest.raises(RuntimeError, match="unrelated"):
             measure_batch(cfg, reps=1)
+
+
+class TestDeviceBatchMeasure:
+    def test_slope_measure_runs_and_shapes(self):
+        # The slope method itself (chain r batches, one fence, difference
+        # quotient) must run on any backend; on CPU the "device" time is
+        # just compute time, but shapes/validation are backend-neutral.
+        from qba_tpu.benchmark import measure_device_batch
+        from qba_tpu.config import QBAConfig
+
+        cfg = QBAConfig(n_parties=3, size_l=4, trials=8)
+        slopes, n_run = measure_device_batch(
+            cfg, pairs=2, reps_lo=1, reps_hi=2
+        )
+        assert len(slopes) == 2 and n_run == 8
+        assert all(isinstance(s, float) for s in slopes)
+
+    def test_slope_measure_validation(self):
+        import pytest as _pytest
+
+        from qba_tpu.benchmark import measure_device_batch
+        from qba_tpu.config import QBAConfig
+
+        cfg = QBAConfig(n_parties=3, size_l=4, trials=8)
+        with _pytest.raises(ValueError, match="pairs"):
+            measure_device_batch(cfg, pairs=0)
+        with _pytest.raises(ValueError, match="reps_lo"):
+            measure_device_batch(cfg, reps_lo=3, reps_hi=2)
