@@ -338,3 +338,22 @@ class TestMaxEvidenceRowsInvariant:
         b = jax.jit(jax.vmap(lambda k: run_trial(wide, k)))(keys)
         assert a.decisions.tolist() == b.decisions.tolist()
         assert a.success.tolist() == b.success.tolist()
+
+
+class TestRooflineModel:
+    def test_model_shape_and_scaling(self):
+        from qba_tpu.ops.round_kernel_tiled import pool_bytes, roofline_model
+
+        cfg = QBAConfig(n_parties=33, size_l=64, n_dishonest=10)
+        m1 = roofline_model(cfg, 1)
+        m1000 = roofline_model(cfg, 1000)
+        assert m1["per_round_per_trial_bytes"] > 0
+        assert 0 < m1["pool_share"] < 1
+        # Batch bound scales linearly in trials and covers the pool term.
+        assert m1000["batch_bytes_upper_bound"] == (
+            1000 * m1["batch_bytes_upper_bound"]
+        )
+        pool = pool_bytes(cfg, 1000)
+        assert m1000["batch_bytes_upper_bound"] > (
+            3 * pool["padded_bytes"] * cfg.n_rounds
+        )
