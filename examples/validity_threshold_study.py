@@ -1,0 +1,187 @@
+"""Security-parameter study: validity & agreement over (nDishonest, sizeL).
+
+The reference demonstrated its threshold behavior anecdotally (one
+``log_d_11.txt`` run); this maps it.  For each (nDishonest, sizeL) grid
+point at fixed ``n_parties``, runs >= ``--trials`` Monte-Carlo trials
+and records, with Wilson 95% intervals (``qba_tpu.obs.stats``):
+
+* overall success (the oracle: all honest parties agree),
+* VALIDITY — success conditional on an honest commander (honest
+  lieutenants decide the commander's order; the protocol's security
+  claim, and the property whose 11p/d=5 counterexample
+  ``tests/test_reference_scale.py`` recorded in round 4),
+* agreement conditional on a dishonest commander.
+
+Writes ``validity_study.json`` + a matplotlib figure to ``--out``.
+
+Usage:
+  python examples/validity_threshold_study.py               # full grid (TPU, ~20 min)
+  python examples/validity_threshold_study.py --quick       # CI-sized smoke
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def run_point(cfg, total_trials: int, chunk: int):
+    """Accumulate success/honesty/decisions across chunked batches."""
+    import jax
+
+    from qba_tpu.backends.jax_backend import fence, run_trials
+
+    succ, hon, dec, vc = [], [], [], []
+    n_chunks = -(-total_trials // chunk)
+    cfg_c = dataclasses.replace(cfg, trials=chunk)
+    for i in range(n_chunks):
+        keys = jax.random.split(
+            jax.random.key(cfg.seed * 1_000_003 + i), chunk
+        )
+        res = run_trials(cfg_c, keys)
+        fence(res)
+        succ.append(np.asarray(res.trials.success))
+        hon.append(np.asarray(res.trials.honest))
+        dec.append(np.asarray(res.trials.decisions))
+        vc.append(np.asarray(res.trials.v_comm))
+    return (
+        np.concatenate(succ),
+        np.concatenate(hon),
+        np.concatenate(dec),
+        np.concatenate(vc),
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-parties", type=int, default=11)
+    ap.add_argument("--dishonest", default="1,2,3,4,5")
+    ap.add_argument("--size-l", default="4,16,64,256,1000")
+    ap.add_argument("--trials", type=int, default=10_000)
+    ap.add_argument("--out", default="docs/assets")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny grid for CI/smoke (overrides the above)")
+    args = ap.parse_args()
+
+    from qba_tpu.compile_cache import enable_compile_cache
+    from qba_tpu.config import QBAConfig
+    from qba_tpu.obs.stats import decision_profile, study_breakdown
+
+    enable_compile_cache()
+
+    if args.quick:
+        n_p, ds, ls, trials = 5, [1, 2], [4, 16], 256
+    else:
+        n_p = args.n_parties
+        ds = [int(x) for x in args.dishonest.split(",")]
+        ls = [int(x) for x in args.size_l.split(",")]
+        trials = args.trials
+
+    points = []
+    for d in ds:
+        for L in ls:
+            cfg = QBAConfig(
+                n_parties=n_p, size_l=L, n_dishonest=d,
+                trials=trials, seed=17 * d + L,
+            )
+            # Chunk by pool footprint: sizeL=1000 at 10k trials would
+            # blow the single-batch HBM ceiling (KI-2).
+            chunk = min(trials, 2000 if L <= 256 else 500)
+            t0 = time.time()
+            succ, hon, dec, vc = run_point(cfg, trials, chunk)
+            b = study_breakdown(succ, hon[:, 0])
+            b["profile"] = decision_profile(dec, hon, vc, cfg.w)
+            b.update(n_parties=n_p, n_dishonest=d, size_l=L,
+                     trials=int(succ.size), seconds=round(time.time() - t0, 1))
+            points.append(b)
+            va, pr = b["validity"], b["profile"]
+
+            def r(x, nd=4):  # a zero-honest-commander point has rate None
+                return "  n/a " if x["rate"] is None else f"{x['rate']:.{nd}f}"
+
+            print(
+                f"d={d} L={L:4d}: overall {r(b['overall'])}  "
+                f"validity {r(va)} [{va['lo']:.4f},{va['hi']:.4f}]  "
+                f"abort {r(pr['abort_all'], 3)} "
+                f"mixed {r(pr['mixed_valid_abort'], 3)} "
+                f"corrupt {r(pr['corrupted'], 3)} "
+                f"({va['n']} hc-trials, {b['seconds']}s)",
+                flush=True,
+            )
+
+    os.makedirs(args.out, exist_ok=True)
+    json_path = os.path.join(args.out, "validity_study.json")
+    with open(json_path, "w") as f:
+        json.dump({"n_parties": n_p, "points": points}, f, indent=1)
+    print("wrote", json_path)
+
+    try:
+        _plot(points, ds, ls, n_p, os.path.join(args.out, "validity_study.png"))
+    except Exception as e:  # matplotlib optional
+        print(f"plot skipped: {e!r}")
+
+
+def _plot(points, ds, ls, n_p, path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    by = {(p["n_dishonest"], p["size_l"]): p for p in points}
+    fig, (ax1, ax2, ax3) = plt.subplots(1, 3, figsize=(15, 4), dpi=150)
+    cmap = plt.get_cmap("viridis")
+    for i, d in enumerate(ds):
+        color = cmap(i / max(len(ds) - 1, 1))
+        xs = [
+            L for L in ls
+            if (d, L) in by
+            and by[(d, L)]["validity"]["rate"] is not None
+        ]
+        va = [by[(d, L)]["validity"] for L in xs]
+        ax1.fill_between(xs, [v["lo"] for v in va], [v["hi"] for v in va],
+                         color=color, alpha=0.15, lw=0)
+        ax1.plot(xs, [v["rate"] for v in va], color=color, marker="o",
+                 ms=4, lw=1.8, label=f"d={d}")
+        pr = [by[(d, L)]["profile"] for L in xs]
+        corrupt = [p["corrupted"]["rate"] for p in pr]
+        detect = [
+            p["abort_all"]["rate"] + p["mixed_valid_abort"]["rate"]
+            for p in pr
+        ]
+        ax2.plot(xs, corrupt, color=color, marker="v", ms=4, lw=1.8,
+                 label=f"corrupted d={d}")
+        ax2.plot(xs, detect, color=color, marker="^", ms=4, lw=1.2,
+                 ls="--", label=f"detected d={d}")
+        ag = [by[(d, L)]["agreement_dishonest_c"] for L in xs]
+        ax3.plot(xs, [a["rate"] for a in ag], color=color, marker="s",
+                 ms=4, lw=1.8, label=f"d={d}")
+        ax3.fill_between(xs, [a["lo"] for a in ag], [a["hi"] for a in ag],
+                         color=color, alpha=0.15, lw=0)
+    for ax, title in (
+        (ax1, "validity: all honest lieutenants decide the order"
+              " | honest commander"),
+        (ax2, "failure split | honest commander:\n"
+              "corrupted (solid) vs detected/abort (dashed)"),
+        (ax3, "agreement | dishonest commander"),
+    ):
+        ax.set_xscale("log", base=2)
+        ax.set_xlabel("sizeL (security parameter)")
+        ax.set_ylim(-0.02, 1.02)
+        ax.grid(alpha=0.25)
+        ax.set_title(title, fontsize=9)
+        ax.legend(fontsize=7)
+    fig.suptitle(f"QBA threshold study, n_parties={n_p} "
+                 f"(Wilson 95% bands)", fontsize=11)
+    fig.tight_layout()
+    fig.savefig(path)
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -431,6 +431,10 @@ def _cmd_study(args: argparse.Namespace, out) -> int:
 
     from qba_tpu.backends.jax_backend import run_trials
 
+    import numpy as np
+
+    from qba_tpu.obs.stats import study_breakdown
+
     cfg = _config(args)
     is_float = args.param == "p_late"
     if is_float and cfg.delivery != "racy":
@@ -441,10 +445,34 @@ def _cmd_study(args: argparse.Namespace, out) -> int:
     rates = []
     for v in values:
         cfg_v = dataclasses.replace(cfg, **{args.param: v})
-        rate = float(run_trials(cfg_v).success_rate)
+        res = run_trials(cfg_v)
+        rate = float(res.success_rate)
         rates.append(rate)
         print(f"{args.param}={v}: success_rate={rate:.4f} "
               f"({cfg_v.trials} trials)", file=out)
+        # Success decomposed over commander honesty (Wilson 95% —
+        # validity is the protocol's actual security property, see
+        # docs/VALIDITY.md); printed only when the split is non-trivial.
+        if cfg_v.n_dishonest:
+            b = study_breakdown(
+                np.asarray(res.trials.success),
+                np.asarray(res.trials.honest)[:, 0],
+            )
+            va, ag = b["validity"], b["agreement_dishonest_c"]
+            if va["n"]:
+                print(
+                    f"  validity (honest commander):  "
+                    f"{va['rate']:.4f} [{va['lo']:.4f}, {va['hi']:.4f}] "
+                    f"({va['k']}/{va['n']})",
+                    file=out,
+                )
+            if ag["n"]:
+                print(
+                    f"  agreement (dishonest cmdr.):  "
+                    f"{ag['rate']:.4f} [{ag['lo']:.4f}, {ag['hi']:.4f}] "
+                    f"({ag['k']}/{ag['n']})",
+                    file=out,
+                )
     if args.plot:
         from qba_tpu.obs.plots import plot_param_study
 

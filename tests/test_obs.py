@@ -92,3 +92,66 @@ class TestReports:
         assert "success rate: 0.9750" in text
         # 400 trials * 4 rounds / 2 s = 800 rounds/s
         assert "800.0 protocol rounds/s" in text
+
+
+class TestStudyStats:
+    def test_wilson_interval_basics(self):
+        from qba_tpu.obs.stats import wilson_interval
+
+        lo, hi = wilson_interval(0, 0)
+        assert (lo, hi) == (0.0, 1.0)
+        lo, hi = wilson_interval(50, 100)
+        assert 0.40 < lo < 0.5 < hi < 0.60
+        lo, hi = wilson_interval(100, 100)
+        assert lo > 0.95 and hi > 0.9999
+        lo, hi = wilson_interval(0, 100)
+        assert lo == 0.0 and hi < 0.05
+
+    def test_study_breakdown_conditions_on_commander(self):
+        import numpy as np
+
+        from qba_tpu.obs.stats import study_breakdown
+
+        success = np.array([True, False, True, True])
+        ch = np.array([True, True, False, False])
+        b = study_breakdown(success, ch)
+        assert b["overall"]["k"] == 3 and b["overall"]["n"] == 4
+        assert b["validity"]["k"] == 1 and b["validity"]["n"] == 2
+        assert b["agreement_dishonest_c"]["k"] == 2
+
+    def test_decision_profile_classes(self):
+        import numpy as np
+
+        from qba_tpu.obs.stats import decision_profile
+
+        w = 8
+        # 5 trials, 4 parties (commander + 3 lieutenants), all honest
+        # except trial 4's commander (excluded from conditioning).
+        v_comm = np.array([3, 3, 3, 3, 3])
+        honest = np.ones((5, 4), dtype=bool)
+        honest[4, 0] = False
+        decisions = np.array([
+            [3, 3, 3, 3],   # valid
+            [3, w, w, w],   # abort_all
+            [3, 3, w, 3],   # mixed valid/abort
+            [3, 3, 1, 3],   # corrupted (forged 1 < 3 won a min(Vi))
+            [3, 3, 3, 3],   # dishonest commander: not conditioned on
+        ])
+        p = decision_profile(decisions, honest, v_comm, w)
+        assert p["n_honest_commander"] == 4
+        assert p["valid"]["k"] == 1
+        assert p["abort_all"]["k"] == 1
+        assert p["mixed_valid_abort"]["k"] == 1
+        assert p["corrupted"]["k"] == 1
+
+    def test_decision_profile_ignores_dishonest_lieutenants(self):
+        import numpy as np
+
+        from qba_tpu.obs.stats import decision_profile
+
+        w = 8
+        v_comm = np.array([2])
+        honest = np.array([[True, True, False, True]])
+        decisions = np.array([[2, 2, 0, 2]])  # dishonest lieu's 0 ignored
+        p = decision_profile(decisions, honest, v_comm, w)
+        assert p["valid"]["k"] == 1 and p["corrupted"]["k"] == 0
