@@ -18,7 +18,10 @@ asserts, per vmapped batch:
 * **validity** — in the all-honest classes every lieutenant decides
   the commander's order.  With dishonest parties in play validity is
   NOT a guarantee (observed counterexample at 11p/5 with an honest
-  commander), so the dishonest classes assert the oracle only — the
+  commander; the round-5 study quantifies it — docs/VALIDITY.md: at
+  sizeL=64 that configuration sits in the validity VALLEY, measured
+  0.221 [0.210, 0.232], while the reference's own sizeL=1000 measures
+  0.918), so the dishonest classes assert the oracle only — the
   hardest captured class being the dishonest-commander 11-party run
   (`log_d_11.txt:485-487`: Dishonests [7 5 1 11 2] include rank 1),
   which the batches must cover.
