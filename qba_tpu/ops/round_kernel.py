@@ -49,6 +49,7 @@ from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
 from qba_tpu.ops.verdict_algebra import (
     VerdictAlgebra,
+    _exact_prec,
     accept_first_per_value,
 )
 
@@ -353,6 +354,7 @@ def build_round_step(
                 x.astype(gdt),
                 (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
+                precision=_exact_prec(gdt),
             )
 
         def gsel(field_all):  # [n_pk(src), n_lieu] -> int32 [n_pk(c), 1]
@@ -380,6 +382,7 @@ def build_round_step(
             li_all.astype(gdt),
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_exact_prec(gdt),
         ).astype(jnp.int32)
         p2_g = (pin_g != 0) & (clrp_g == 0)
         own_g = jnp.where(p2_g, li_exp, SENTINEL)
@@ -525,10 +528,13 @@ def _probe_cache_path() -> str:
     )
 
 
-_PROBE_VERSION = 6  # bump when kernel structure/compiler params change
+_PROBE_VERSION = 7  # bump when kernel structure/compiler params change
 # v6: tiled kernels take the meta-packed pool (count/v/sent/cell in one
 # [cap, 4] tensor) + donation; block ordering recalibrated on honest
 # timings (docs/PERF.md round 4 erratum).
+# v7: Precision.HIGHEST on exactness-critical dots (KI-3 — changes the
+# kernels' scoped-vmem footprint, so v6 block plans are stale) + the
+# all-receiver verdict variant.
 
 
 def _probe_disk_key(kernel: str, cfg: QBAConfig, extra: str = "") -> str:
@@ -607,6 +613,12 @@ _TRANSIENT_ERR_MARKERS = (
 
 def probe_error_transient(e: Exception) -> bool:
     s = repr(e)
+    # A remote-tunnel wrapper (HTTP 500 / helper exit 1) around a REAL
+    # compiler verdict is deterministic: the Mosaic error text rides
+    # inside the message (round 5 — previously such failures re-probed
+    # every process).
+    if "Mosaic failed to compile" in s:
+        return False
     return any(m in s for m in _TRANSIENT_ERR_MARKERS)
 
 

@@ -69,10 +69,39 @@ from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
 from qba_tpu.ops.round_kernel import _lane_group
 from qba_tpu.ops.verdict_algebra import (
+    AllReceiverVerdict,
     VerdictAlgebra,
     accept_first_per_value,
+    accept_first_per_value_all,
     accept_first_per_value_group,
+    all_receiver_supported,
+    make_receiver_tables,
 )
+
+
+def _gdt(cfg: QBAConfig):
+    """The kernels' exact-integer matmul dtype for this config."""
+    return (
+        jnp.bfloat16 if cfg.size_l <= 256 and cfg.w <= 256
+        else jnp.float32
+    )
+
+
+def _prec(dt):
+    """Matmul precision making an integer-valued dot EXACT for values
+    beyond bf16's 256-integer range.
+
+    An f32 *dtype* does NOT buy f32 *precision*: with JAX's default
+    matmul precision XLA may lower an f32 dot through single-pass bf16
+    (observed on BOTH the TPU and CPU backends, and lowering-dependent —
+    the same program batched differently flipped between exact and
+    lossy), silently rounding integer operands > 256 to even.  Round-5
+    root cause of the rebuild kernel's wrong-draw bug: the meta gather's
+    cell ids (< n_pool, odd values > 256) came back decremented.  Every
+    dot whose operands can exceed 256 must therefore pass
+    ``Precision.HIGHEST``; bf16-operand dots with proven <= 256 values
+    are exact by construction and keep the fast path."""
+    return jax.lax.Precision.HIGHEST if dt == jnp.float32 else None
 
 
 def build_verdict_kernel(
@@ -82,6 +111,7 @@ def build_verdict_kernel(
     interpret: bool = False,
     n_recv: int | None = None,
     out_vma: frozenset | None = None,
+    variant: str = "group",
 ):
     """Compile phase 1: the blocked acceptance-verdict kernel.
 
@@ -123,7 +153,13 @@ def build_verdict_kernel(
     if n_pool % blk:
         raise ValueError(f"blk={blk} must divide n_pool={n_pool}")
     n_blocks = n_pool // blk
-    gdt = jnp.bfloat16 if size_l <= 256 and w <= 256 else jnp.float32
+    gdt = _gdt(cfg)
+    if variant not in ("group", "allrecv"):
+        raise ValueError(f"unknown verdict variant {variant!r}")
+    if variant == "allrecv" and not all_receiver_supported(size_l, w):
+        raise ValueError(
+            f"allrecv variant unsupported at size_l={size_l}, w={w}"
+        )
 
     # Receiver lane-packing plan (see round_kernel.py's kernel v4): grp
     # receivers side by side fill the VPU's 128 lanes when size_l is
@@ -152,11 +188,19 @@ def build_verdict_kernel(
             r_off = scalar_read(off_ref)  # block's first receiver
         else:
             r_off = 0
-        (
-            vals_ref, lens_ref, p_ref, meta_ref, vi_ref, honest_ref,
-            act_ref, rv_ref, late_ref, e_ref, lip_ref, lioob_ref,
-            acc_ref, ovi_ref,
-        ) = refs
+        if variant == "allrecv":
+            (
+                vals_ref, lens_ref, p_ref, meta_ref, vi_ref, honest_ref,
+                act_ref, rv_ref, late_ref, t1_ref, t2_ref, tob_ref,
+                tlh_ref, tlh2_ref,
+                acc_ref, ovi_ref,
+            ) = refs
+        else:
+            (
+                vals_ref, lens_ref, p_ref, meta_ref, vi_ref, honest_ref,
+                act_ref, rv_ref, late_ref, e_ref, lip_ref, lioob_ref,
+                acc_ref, ovi_ref,
+            ) = refs
 
         r_idx = scalar_read(round_ref)
         blk_id = pl.program_id(0)
@@ -207,6 +251,7 @@ def build_verdict_kernel(
                     oh_cell, tbl_t.astype(gdt),
                     (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
+                    precision=_prec(gdt),
                 )
 
             def cell_col_mm(tbl):  # [n_cells, 1] column -> [blk, 1]
@@ -214,6 +259,7 @@ def build_verdict_kernel(
                     oh_cell, tbl.astype(gdt),
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
+                    precision=_prec(gdt),
                 )
 
             biz = cell_col_mm(honest_ref[:]).astype(jnp.int32) == 0
@@ -238,6 +284,31 @@ def build_verdict_kernel(
                 & (sender_col != lane_recv)
             )
             count_eff_all = jnp.where(clearl_all, 0, cnt_col)
+
+            if variant == "allrecv":
+                # All receivers in one batched pass (docs/PERF.md round
+                # 5: the group loop's serial accept chains were the
+                # measured compute floor at the north-star scale).
+                ar = AllReceiverVerdict(
+                    n_p=blk, n_rv=n_rv, max_l=max_l, size_l=size_l,
+                    w=w, gdt=gdt, vals=vals, lens=lens_ref[:],
+                    count=cnt_col, p_i32=p_ref[:].astype(jnp.int32),
+                    tables=(
+                        t1_ref[:], t2_ref[:], tob_ref[:],
+                        tlh_ref[:], tlh2_ref[:],
+                    ),
+                    r_idx=r_idx,
+                )
+                ok_all = ar.flags(
+                    v2_all, clearp_all, clearl_all, count_eff_all,
+                    delivered_all,
+                )
+                acc, new_vi = accept_first_per_value_all(
+                    ok_all, v2_all, ovi_ref[:], idx_col, blk, n_rv, w
+                )
+                ovi_ref[:] = new_vi
+                acc_ref[:] = acc
+                return
 
             # The shared per-group acceptance flag algebra
             # (ops/verdict_algebra.py — one implementation for both
@@ -308,10 +379,21 @@ def build_verdict_kernel(
         pl.BlockSpec((n_rv, n_pool), lambda i: (0, 0)),  # attack^T
         pl.BlockSpec((n_rv, n_pool), lambda i: (0, 0)),  # rand_v^T
         pl.BlockSpec((n_rv, n_pool), lambda i: (0, 0)),  # late^T
-        pl.BlockSpec((grp, seg_l), lambda i: (0, 0)),  # e_mat
-        pl.BlockSpec((len(r0_list), seg_l), lambda i: (0, 0)),  # lip
-        pl.BlockSpec((len(r0_list), seg_l), lambda i: (0, 0)),  # lioob
-    ]
+    ] + (
+        [
+            pl.BlockSpec((size_l, n_rv), lambda i: (0, 0)),  # t_li1
+            pl.BlockSpec((size_l, n_rv), lambda i: (0, 0)),  # t_li2
+            pl.BlockSpec((size_l, n_rv), lambda i: (0, 0)),  # t_oob
+            pl.BlockSpec((size_l, w * n_rv), lambda i: (0, 0)),  # t_lh
+            pl.BlockSpec((w * size_l, n_rv), lambda i: (0, 0)),  # t_lh2
+        ]
+        if variant == "allrecv"
+        else [
+            pl.BlockSpec((grp, seg_l), lambda i: (0, 0)),  # e_mat
+            pl.BlockSpec((len(r0_list), seg_l), lambda i: (0, 0)),  # lip
+            pl.BlockSpec((len(r0_list), seg_l), lambda i: (0, 0)),  # lioob
+        ]
+    )
     out_specs = (
         pl.BlockSpec((blk, n_rv), blkmap),  # acc
         pl.BlockSpec((n_rv, w), lambda i: (0, 0)),  # ovi (revisited)
@@ -345,6 +427,11 @@ def build_verdict_kernel(
         return promote_vma(out_vma, x)
 
     def _tail(li):
+        if variant == "allrecv":
+            # ``li`` is the prebuilt table tuple from
+            # :func:`make_verdict_tables` (round-invariant — built once
+            # outside the scan).
+            return tuple(li)
         li_pack = jnp.stack(
             [li[r0 : r0 + grp].reshape(-1) for r0 in r0_list]
         )
@@ -373,12 +460,12 @@ def build_verdict_kernel(
         def verdict(round_idx, vals, lens, p, meta, li, vi,
                     honest_pk, attack, rand_v, late):
             # li itself is consumed host-side (the lane-packed lip/lioob
-            # tables carry its data); the kernel takes only the tables.
-            e_mat, lip, lioob = _tail(li)
+            # or all-receiver tables carry its data); the kernel takes
+            # only the tables.
             return call(
                 jnp.asarray([round_idx], jnp.int32),
                 vals, lens, p, meta, vi, honest_pk,
-                attack.T, rand_v.T, late.T, e_mat, lip, lioob,
+                attack.T, rand_v.T, late.T, *_tail(li),
             )
 
     return verdict
@@ -393,6 +480,14 @@ def pool_vals_dtype(cfg: QBAConfig):
     would halve it again, but this TPU target rejects i8 vector
     compares.)"""
     return jnp.bfloat16 if cfg.w <= 256 else jnp.int32
+
+
+def make_verdict_tables(cfg: QBAConfig, li):
+    """Receiver tables for the all-receiver verdict variant
+    (:func:`qba_tpu.ops.verdict_algebra.make_receiver_tables`) — built
+    ONCE per trial, outside the round scan (li is round-invariant), and
+    passed to the kernel in place of ``li``."""
+    return make_receiver_tables(li, cfg.size_l, cfg.w, _gdt(cfg))
 
 
 def honest_cells(honest, cfg: QBAConfig):
@@ -766,6 +861,7 @@ def build_rebuild_kernel(
                     oh_f.astype(dt), tbl.astype(dt),
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
+                    precision=_prec(dt),
                 )
 
             w_sel = oh_mm(wT_scr[:]) > 0.5  # [blk_d, n_pool]
@@ -778,6 +874,7 @@ def build_rebuild_kernel(
                     g_f.astype(dt), field.astype(dt),
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
+                    precision=_prec(dt),
                 )
 
             rows_g = [
@@ -786,7 +883,10 @@ def build_rebuild_kernel(
             lens_g = gmm(lens_ref[:]).astype(jnp.int32)  # [blk_d, max_l]
             p_g = gmm(p_ref[:]).astype(jnp.int32)  # [blk_d, size_l]
             # One gather for all packed per-packet columns; f32 operands
-            # because cell ids reach n_pool-1 > 256 (bf16-inexact).
+            # AND Precision.HIGHEST (via _prec) because cell ids reach
+            # n_pool-1 > 256 — an f32 dot at default precision may
+            # lower through bf16 and round odd cell ids to even (the
+            # round-5 wrong-draw bug; see _prec).
             meta_g = gmm(meta_ref[:], jnp.float32).astype(jnp.int32)
             cnt_g = meta_g[:, META_COUNT : META_COUNT + 1]
             v_g = meta_g[:, META_V : META_V + 1]
@@ -809,6 +909,7 @@ def build_rebuild_kernel(
                     oh_cell.astype(dt), tbl_t.astype(dt),
                     (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
+                    precision=_prec(dt),
                 )
 
             def cell_col_mm(tbl, dt=gdt):  # [n_cells, 1] -> [blk_d, 1]
@@ -816,6 +917,7 @@ def build_rebuild_kernel(
                     oh_cell.astype(dt), tbl.astype(dt),
                     (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
+                    precision=_prec(dt),
                 )
 
             att_rows = cell_mm(att_ref[:])  # [blk_d, n_rv] f32
@@ -1008,11 +1110,14 @@ _MAX_PROBE_CANDIDATES = 4
 
 
 def _block_estimate(cfg: QBAConfig, blk: int,
-                    n_recv: int | None = None) -> int:
+                    n_recv: int | None = None,
+                    variant: str | None = None) -> int:
     """Loose VMEM estimate for one verdict block (same spirit as
     round_kernel.fits_kernel — a screen before the authoritative compile
     probe, not a guarantee).  ``n_recv`` estimates the party-sharded
-    local-receiver variant (smaller flag tiles and lane groups)."""
+    local-receiver variant (smaller flag tiles and lane groups);
+    ``variant`` None is the conservative max over both verdict
+    variants."""
     n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
     tile = 4 * blk * cfg.size_l
     est = tile * (2 * cfg.max_l + 10)
@@ -1025,6 +1130,23 @@ def _block_estimate(cfg: QBAConfig, blk: int,
             est += 4 * blk * grp * cfg.w * 7
     est += 4 * blk * n_rv * 6  # flag algebra tiles
     est = int(est * (1.0 + cfg.max_l / 4.0))
+    if (
+        variant != "group"
+        and n_recv is None
+        and all_receiver_supported(cfg.size_l, cfg.w)
+    ):
+        # The all-receiver variant's distinct big intermediates: the
+        # [blk, w*n_rv] count/pack tensors, the [blk, n_rv, w] accept
+        # pass, and the [blk, 32*n_planes*size_l] PB planes.  With
+        # variant unknown (None) this is a conservative max; a resolved
+        # "group" variant prunes with the group estimate only.
+        w = cfg.w
+        est_ar = (
+            4 * blk * cfg.size_l * (2 * cfg.max_l + 8)
+            + 4 * blk * w * n_rv * 7
+            + 2 * blk * 32 * ((w + 31) // 32) * cfg.size_l * 3
+        )
+        est = max(est, int(est_ar * (1.0 + cfg.max_l / 4.0)))
     return est
 
 
@@ -1053,7 +1175,8 @@ def _order_candidates(cands: list[int], preferred: int) -> list[int]:
     )
 
 
-def block_candidates(cfg: QBAConfig, n_recv: int | None = None) -> list[int]:
+def block_candidates(cfg: QBAConfig, n_recv: int | None = None,
+                     variant: str | None = None) -> list[int]:
     """Candidate block sizes: divisors of the pool capacity, multiples
     of 8 where possible, within the VMEM pre-filter, ordered by
     closeness to the measured sweet spot (:func:`_preferred_block`) and
@@ -1064,7 +1187,7 @@ def block_candidates(cfg: QBAConfig, n_recv: int | None = None) -> list[int]:
     n_pool = cfg.n_lieutenants * cfg.slots
     divs = [d for d in range(n_pool, 0, -1) if n_pool % d == 0]
     cands = [d for d in divs if d % 8 == 0] or divs
-    ok = [b for b in cands if _block_estimate(cfg, b, n_recv)
+    ok = [b for b in cands if _block_estimate(cfg, b, n_recv, variant)
           <= _TILED_PREFILTER_BYTES]
     return _order_candidates(ok, _preferred_block(cfg))[
         :_MAX_PROBE_CANDIDATES
@@ -1289,21 +1412,117 @@ def roofline_model(cfg: QBAConfig, trials: int = 1) -> dict:
     }
 
 
-def tiled_kernel_plan(cfg: QBAConfig, n_recv: int | None = None) -> int | None:
+_VARIANT_CACHE: dict[tuple, str] = {}
+
+
+def resolve_verdict_variant(cfg: QBAConfig,
+                            n_recv: int | None = None) -> str:
+    """Which verdict-kernel variant this config runs: ``"allrecv"``
+    (all receivers batched per block — docs/PERF.md round 5) where the
+    exactness gate holds and the kernel compiles, else ``"group"`` (the
+    lane-group loop).  On TPU the verdict is a cached compile probe
+    (same machinery as the block-size plans); off-TPU (interpret mode)
+    the static gate alone decides, so the CPU equivalence suites
+    exercise the same math the TPU runs.  The party-sharded engine
+    (``n_recv``) keeps the group variant."""
+    if n_recv is not None or not all_receiver_supported(cfg.size_l, cfg.w):
+        return "group"
+    if jax.default_backend() != "tpu":
+        return "allrecv"
+    # Probe at the block size the engine will actually run with — an
+    # explicit tiled_block bypasses the block-plan probe entirely, so a
+    # variant verdict from a different block would not transfer.
+    n_pool = cfg.n_lieutenants * cfg.slots
+    if cfg.tiled_block is not None and n_pool % cfg.tiled_block == 0:
+        blk_probe = cfg.tiled_block
+    else:
+        cands = block_candidates(cfg, variant="allrecv")
+        if not cands:
+            return "group"
+        blk_probe = cands[0]
+    key = _shape_key(cfg) + (blk_probe,)
+    if key in _VARIANT_CACHE:
+        return _VARIANT_CACHE[key]
+    dkey = _probe_disk_key(
+        "tiled-verdict-variant", cfg, extra=f"blk{blk_probe}"
+    )
+    hit = _probe_disk_get(dkey)
+    if hit is not None:
+        var = "allrecv" if hit > 0 else "group"
+        _VARIANT_CACHE[key] = var
+        return var
+    from qba_tpu.ops.round_kernel import probe_error_transient
+
+    shp, i32, vdt = _probe_shapes(cfg)
+    n_rv = cfg.n_lieutenants
+    s, w, gdt = cfg.size_l, cfg.w, _gdt(cfg)
+    try:
+        verdict = build_verdict_kernel(cfg, blk_probe, variant="allrecv")
+        jax.jit(jax.vmap(verdict, in_axes=(None,) + (0,) * 10)).lower(
+            jax.ShapeDtypeStruct((), i32),
+            shp(cfg.max_l, n_pool, s, dt=vdt),
+            shp(n_pool, cfg.max_l),
+            shp(n_pool, s, dt=vdt), shp(n_pool, 4),
+            (
+                shp(s, n_rv, dt=jnp.float32), shp(s, n_rv, dt=jnp.float32),
+                shp(s, n_rv, dt=jnp.float32), shp(s, w * n_rv, dt=gdt),
+                shp(w * s, n_rv, dt=gdt),
+            ),
+            shp(n_rv, w), shp(n_pool, 1),
+            shp(n_pool, n_rv), shp(n_pool, n_rv), shp(n_pool, n_rv),
+        ).compile()
+        var = "allrecv"
+        # Seed the block plan with the just-compiled candidate so
+        # tiled_kernel_plan does not pay the same ~2-minute remote
+        # compile a second time (it probes the same first candidate).
+        if cfg.tiled_block is None:
+            plan_key = _shape_key(cfg) + ("+allrecv",)
+            _TILED_PROBE_CACHE.setdefault(plan_key, blk_probe)
+            _probe_disk_put(
+                _probe_disk_key("tiled-verdict", cfg, extra="+allrecv"),
+                blk_probe,
+            )
+    except Exception as e:
+        if probe_error_transient(e):
+            return "group"  # unknown verdict — do not cache
+        var = "group"
+    _VARIANT_CACHE[key] = var
+    _probe_disk_put(dkey, 1 if var == "allrecv" else 0)
+    return var
+
+
+def tiled_kernel_plan(cfg: QBAConfig, n_recv: int | None = None,
+                      variant: str | None = None) -> int | None:
     """The verdict-kernel block size the tiled engine will use for this
     config, or None if no candidate compiles.  Like
     round_kernel.kernel_compiles, the authoritative gate is a cached
     data-free compile probe per shape — Mosaic's scoped-vmem use cannot
     be modeled reliably from outside.  ``n_recv`` probes the
-    party-sharded local-receiver variant."""
+    party-sharded local-receiver variant; ``variant`` defaults to
+    :func:`resolve_verdict_variant`'s pick."""
     shp, i32, vdt = _probe_shapes(cfg)
     slots = cfg.slots
     n_pool = cfg.n_lieutenants * slots
     n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
     local = n_recv is not None
 
+    if variant is None:
+        variant = resolve_verdict_variant(cfg, n_recv)
+
+    def _li_shape():
+        if variant == "allrecv":
+            s, w, f32, gdt = cfg.size_l, cfg.w, jnp.float32, _gdt(cfg)
+            return (
+                shp(s, n_rv, dt=f32), shp(s, n_rv, dt=f32),
+                shp(s, n_rv, dt=f32), shp(s, w * n_rv, dt=gdt),
+                shp(w * s, n_rv, dt=gdt),
+            )
+        return shp(n_rv, cfg.size_l)
+
     def compile_one(blk):
-        verdict = build_verdict_kernel(cfg, blk, n_recv=n_recv)
+        verdict = build_verdict_kernel(
+            cfg, blk, n_recv=n_recv, variant=variant
+        )
         off = (jax.ShapeDtypeStruct((), i32),) if local else ()
         in_axes = (None,) * (1 + len(off)) + (0,) * 10
         jax.jit(jax.vmap(verdict, in_axes=in_axes)).lower(
@@ -1312,14 +1531,16 @@ def tiled_kernel_plan(cfg: QBAConfig, n_recv: int | None = None) -> int | None:
             shp(cfg.max_l, n_pool, cfg.size_l, dt=vdt),
             shp(n_pool, cfg.max_l),
             shp(n_pool, cfg.size_l, dt=vdt), shp(n_pool, 4),
-            shp(n_rv, cfg.size_l), shp(n_rv, cfg.w), shp(n_pool, 1),
+            _li_shape(), shp(n_rv, cfg.w), shp(n_pool, 1),
             shp(n_pool, n_rv), shp(n_pool, n_rv), shp(n_pool, n_rv),
         ).compile()
 
     return _probe_plan(
-        "tiled-verdict", cfg, block_candidates(cfg, n_recv), compile_one,
+        "tiled-verdict", cfg, block_candidates(cfg, n_recv, variant),
+        compile_one,
         _TILED_PROBE_CACHE, "falling back to the XLA round engine",
-        extra=f"recv{n_recv}" if local else "",
+        extra=(f"recv{n_recv}" if local else "")
+        + ("+allrecv" if variant == "allrecv" else ""),
     )
 
 

@@ -31,6 +31,16 @@ import jax.numpy as jnp
 from qba_tpu.core.types import SENTINEL
 
 
+def _exact_prec(dt):
+    """``Precision.HIGHEST`` for f32-dtype integer dots: with default
+    matmul precision XLA may lower an f32 dot through single-pass bf16
+    (backend- and lowering-dependent), rounding integer operands > 256.
+    bf16-operand dots whose values are proven <= 256 are exact by
+    construction and keep the fast path.  (Round-5 root cause of the
+    rebuild kernel's wrong-draw bug — see round_kernel_tiled._prec.)"""
+    return jax.lax.Precision.HIGHEST if dt == jnp.float32 else None
+
+
 class VerdictAlgebra:
     """Per-kernel-invocation instance: precomputes the receiver-
     independent raw-packet facts and lane tiles from loaded values, then
@@ -136,6 +146,7 @@ class VerdictAlgebra:
             self._as_gdt(cols), self._e_mat,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_exact_prec(self.gdt),
         )
 
     def seg_reduce(self, lanes):
@@ -149,6 +160,7 @@ class VerdictAlgebra:
             self._as_gdt(lanes), self._e_mat,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=_exact_prec(self.gdt),
         )
 
     def _plane_bit(self, q_lanes):
@@ -234,6 +246,315 @@ class VerdictAlgebra:
             & (new_count_g == self.r_idx + 1)
         )
         return ok_g, dup_g, own_len_g
+
+
+def _or_fold_lanes(x):
+    """Bitwise-OR reduction over the lane axis: ``[n_p, n] -> [n_p, 1]``
+    by halving folds (handles odd widths; Mosaic has no or-reduce)."""
+    n = x.shape[1]
+    while n > 1:
+        h = n // 2
+        lo = x[:, :h] | x[:, h : 2 * h]
+        x = jnp.concatenate([lo, x[:, 2 * h :]], axis=1) if n % 2 else lo
+        n = h + (n % 2)
+    return x
+
+
+class AllReceiverVerdict:
+    """The verdict flag algebra for ALL receivers of a block in one
+    batched pass — no per-receiver-group loop (docs/PERF.md round 5:
+    the group loop's serial chains were the tiled verdict kernel's
+    measured compute floor at the north-star scale).
+
+    Same inputs/invariants as :class:`VerdictAlgebra`, but every
+    receiver-dependent term is an ``[n_p, n_rv]`` 2-D op fed by MXU
+    contractions against per-receiver tables built once per trial
+    (:func:`make_receiver_tables`):
+
+    * duplicate-of-own-row via the exact integer identity
+      ``sum_pos (v - own)^2 == 0`` (the XLA engine's MXU form,
+      rounds/engine.py) — ``max_l`` matmuls against ``(li+1)`` /
+      ``(li^2-1)`` tables instead of ``n_groups * max_l`` segment
+      reductions;
+    * evidence-contains-v2 via position-folded presence bit planes
+      (one bit-select per receiver column, no lane expansion);
+    * own-row collision via a ``(value, position)`` one-hot
+      contraction: ``PB[n_p, w*size_l] @ Lh2[w*size_l, n_rv]`` where
+      ``PB`` masks the packet's presence planes by P;
+    * ``v2 == li`` on a P position via ``P @ Lh`` counts packed into
+      16-bit presence planes by a second (config-constant) matmul —
+      f32-exact (powers of two, sums < 2^16).
+
+    Exactness gate (:func:`all_receiver_supported`): ``w <= 64`` (bit
+    planes) and ``size_l * (w+1)^2 < 2^24`` (the dup identity in f32).
+    """
+
+    def __init__(self, *, n_p, n_rv, max_l, size_l, w, gdt,
+                 vals, lens, count, p_i32, tables, r_idx):
+        self.n_p, self.n_rv = n_p, n_rv
+        self.max_l, self.size_l, self.w, self.gdt = max_l, size_l, w, gdt
+        self.r_idx = r_idx
+        self.lens, self.count = lens, count
+        self.len0 = lens[:, 0:1]
+        self.vals = vals
+        (self.t_li1, self.t_li2, self.t_oob, self.t_lh,
+         self.t_lh2) = tables
+        in_t = [vals[r] != SENTINEL for r in range(max_l)]
+        self.valid = [count > r for r in range(max_l)]
+        self.p_i32 = p_i32  # 0/1
+        self.p_b = p_i32 != 0
+        self.p_f32 = p_i32.astype(jnp.float32)
+
+        # ---- Receiver-independent raw-packet facts (tfg.py:87-98) ----
+        false_col = jnp.zeros((n_p, 1), jnp.bool_)
+        oob = false_col
+        lens_bad = false_col
+        cells_coll = false_col
+        for r in range(max_l):
+            row_bad = jnp.any(
+                in_t[r] & ((vals[r] > w) | (vals[r] < 0)),
+                axis=1, keepdims=True,
+            )
+            oob |= self.valid[r] & row_bad
+            lens_bad |= self.valid[r] & (lens[:, r : r + 1] != self.len0)
+            for s in range(r + 1, max_l):
+                hit = jnp.any(
+                    in_t[r] & in_t[s] & (vals[r] == vals[s]),
+                    axis=1, keepdims=True,
+                )
+                cells_coll |= self.valid[s] & hit
+        self.oob, self.lens_bad, self.cells_coll = oob, lens_bad, cells_coll
+
+        # Value-presence bit planes (same construction as VerdictAlgebra).
+        self.n_planes = (w + 31) // 32
+        pm = [jnp.zeros((n_p, size_l), jnp.int32)
+              for _ in range(self.n_planes)]
+        for r in range(max_l):
+            for p_i in range(self.n_planes):
+                lo, hi = 32 * p_i, 32 * (p_i + 1)
+                in_pl = (vals[r] >= lo) & (vals[r] < hi)
+                pm[p_i] |= jnp.where(
+                    self.valid[r] & in_t[r] & in_pl,
+                    jnp.left_shift(jnp.int32(1), vals[r] & 31),
+                    0,
+                )
+        self.pm = pm
+        # Position-folded planes: bit q set iff value q appears at ANY
+        # position of a valid row — the whole `contains` test becomes a
+        # per-receiver bit select.
+        self.pm_any = [_or_fold_lanes(p) for p in pm]
+
+    def _mm(self, lhs_f32, tbl):
+        """[n_p, K] f32 @ [K, n_rv] table -> [n_p, n_rv] f32 — always
+        Precision.HIGHEST: t_li2 carries li^2-1 values beyond bf16's
+        256-integer range, and the dup identity needs exact zero."""
+        return jax.lax.dot_general(
+            lhs_f32, tbl,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    def _select_bit(self, planes_cols, q, bits_per_plane):
+        """Per-receiver bit select: ``planes_cols`` is a list of
+        ``[n_p, n_rv]`` int32 plane columns; ``q`` ``[n_p, n_rv]`` the
+        query value.  Returns bool presence of bit ``q % bits`` in
+        plane ``q // bits``."""
+        shift_bits = bits_per_plane.bit_length() - 1  # 32 -> 5, 16 -> 4
+        sel = planes_cols[0]
+        for j in range(1, len(planes_cols)):
+            sel = jnp.where((q >> shift_bits) == j, planes_cols[j], sel)
+        return (
+            jnp.right_shift(sel, q & (bits_per_plane - 1)) & 1
+        ) != 0
+
+    def flags(self, v2_all, clearp_all, clearl_all, count_eff_all,
+              delivered_all):
+        """All receivers' verdicts in one pass: returns ``ok_all``
+        ``[n_p, n_rv]`` bool — the batched equivalent of running
+        :meth:`VerdictAlgebra.group` over every lane group."""
+        n_p, n_rv, max_l = self.n_p, self.n_rv, self.max_l
+        size_l, w = self.size_l, self.w
+        notcp = jnp.where(clearp_all, 0.0, 1.0)  # (1 - cp) [n_p, n_rv]
+
+        # ---- dup: evidence row == own row, via the integer identity.
+        # own = p2*(li+1) - 1; mism_r = ssq_v - 2*cross + ssq_own with
+        #   cross  = (1-cp) * [p*v]@(li+1) - sum_v
+        #   ssq_own = (1-cp) * [p]@(li^2-1) + size_l
+        # (rounds/engine.py's MXU dup form, here per block).
+        m2 = self._mm(self.p_f32, self.t_li2)  # [n_p, n_rv]
+        ssq_own = notcp * m2 + float(size_l)
+        dup_all = jnp.zeros((n_p, n_rv), jnp.bool_)
+        for r in range(max_l):
+            pv = jnp.where(self.p_b, self.vals[r], 0).astype(jnp.float32)
+            m1 = self._mm(pv, self.t_li1)
+            s_v = jnp.sum(self.vals[r], axis=1, keepdims=True)
+            ssq_v = jnp.sum(
+                self.vals[r] * self.vals[r], axis=1, keepdims=True
+            )
+            cross = notcp * m1 - s_v.astype(jnp.float32)
+            mism = ssq_v.astype(jnp.float32) - 2.0 * cross + ssq_own
+            dup_all |= self.valid[r] & (mism == 0.0)
+        dup_all &= ~clearl_all
+        own_len_all = (
+            notcp * jnp.sum(self.p_f32, axis=1, keepdims=True)
+        ).astype(jnp.int32)
+
+        # ---- contains: v2 present anywhere in a valid row (bit select
+        # on the position-folded planes).
+        any_cols = [
+            jnp.broadcast_to(a, (n_p, n_rv)) for a in self.pm_any
+        ]
+        cont_all = self._select_bit(any_cols, v2_all, 32)
+        cont_or_oob = ~clearl_all & (cont_all | self.oob)
+
+        # ---- own-row collision: exists pos in P with li present in the
+        # evidence there.  PB[(q, pos)] = P & bit q of the presence
+        # plane at pos; contract against the per-receiver li one-hot.
+        pb_planes = []
+        for p_i in range(self.n_planes):
+            reps = min(32, w - 32 * p_i)  # only q < w has Lh2 rows
+            # Concatenate int32 vectors only — tpu.concatenate on i1
+            # picks an unlowerable vreg bitcast relayout.
+            tiled = jnp.concatenate([self.pm[p_i]] * reps, axis=1)
+            p_rep = jnp.concatenate([self.p_i32] * reps, axis=1)
+            q_in_tile = (
+                jax.lax.broadcasted_iota(
+                    jnp.int32, (n_p, reps * size_l), 1
+                )
+                // size_l
+            )
+            bits_i = jnp.right_shift(tiled, q_in_tile) & 1  # 0/1 int32
+            pb_planes.append(bits_i & p_rep)
+        pb_i = (
+            jnp.concatenate(pb_planes, axis=1)
+            if len(pb_planes) > 1 else pb_planes[0]
+        )  # [n_p, w*size_l] 0/1 int32
+        pb = jnp.where(pb_i != 0, 1.0, 0.0).astype(self.gdt)
+        own_coll_cnt = jax.lax.dot_general(
+            pb, self.t_lh2.astype(self.gdt),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        own_coll_all = (notcp * own_coll_cnt) > 0.0
+
+        # ---- bad_own: a P position whose li equals v2 or is oob.
+        oob_cnt = self._mm(self.p_f32, self.t_oob)
+        # counts of P positions with li == q, all (q, receiver) pairs.
+        cq = jax.lax.dot_general(
+            self.p_f32.astype(self.gdt), self.t_lh.astype(self.gdt),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [n_p, w * n_rv], ordered q-major
+        pres = jnp.where(cq > 0.0, 1.0, 0.0).astype(self.gdt)
+        # 16-bit packing matrix, built in-kernel from iota (it is
+        # config-constant — an operand would be force-broadcast per
+        # trial under the trials vmap): row q*n_rv+r contributes
+        # 1 << (q % 16) to plane-major column (q // 16)*n_rv + r.
+        n_half = -(-self.w // 16)
+        row_i = jax.lax.broadcasted_iota(
+            jnp.int32, (self.w * n_rv, n_half * n_rv), 0
+        )
+        col_i = jax.lax.broadcasted_iota(
+            jnp.int32, (self.w * n_rv, n_half * n_rv), 1
+        )
+        rq, rr = row_i // n_rv, row_i % n_rv
+        t_pack = jnp.where(
+            (rq // 16 == col_i // n_rv) & (rr == col_i % n_rv),
+            jnp.left_shift(jnp.int32(1), rq % 16),
+            0,
+        ).astype(self.gdt)
+        packed = jax.lax.dot_general(
+            pres, t_pack,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)  # [n_p, n_half * n_rv], plane-major
+        half_cols = [
+            packed[:, j * n_rv : (j + 1) * n_rv] for j in range(n_half)
+        ]
+        li_eq_v2 = self._select_bit(half_cols, v2_all, 16)
+        bad_own_all = ~clearp_all & ((oob_cnt > 0.0) | li_eq_v2)
+
+        # ---- the shared condition algebra (consistent_after_append).
+        appended_all = ~dup_all & (count_eff_all < max_l)
+        cond2 = ~(cont_or_oob | (appended_all & bad_own_all))
+        new_count_all = jnp.where(
+            appended_all, count_eff_all + 1, count_eff_all
+        )
+        cond1 = (clearl_all | ~self.lens_bad) & (
+            ~appended_all
+            | (count_eff_all == 0)
+            | (own_len_all == self.len0)
+        )
+        cond3 = (clearl_all | ~self.cells_coll) & (
+            ~appended_all | ~(~clearl_all & own_coll_all)
+        )
+        return (
+            delivered_all & cond1 & cond2 & cond3
+            & (new_count_all == self.r_idx + 1)
+        )
+
+
+def all_receiver_supported(size_l: int, w: int) -> bool:
+    """Static exactness gate for :class:`AllReceiverVerdict`: bit
+    planes need ``w <= 64``; the f32 dup identity needs
+    ``size_l * (w+1)^2 < 2^24`` (values live in [-1, w])."""
+    return w <= 64 and size_l * (w + 1) * (w + 1) < 2**24
+
+
+def make_receiver_tables(li, size_l: int, w: int, gdt):
+    """Per-trial receiver tables for :class:`AllReceiverVerdict` (built
+    ONCE outside the round scan — li is round-invariant):
+
+    * ``t_li1`` f32 ``[size_l, n_rv]`` = ``(li+1)^T``; ``t_li2`` =
+      ``(li^2-1)^T`` — the dup identity's contraction tables;
+    * ``t_oob`` f32 ``[size_l, n_rv]`` = own-value out-of-bound flags;
+    * ``t_lh`` ``[size_l, w*n_rv]`` one-hot ``li[r, pos] == q``,
+      columns q-major — P-masked per-value counts;
+    * ``t_lh2`` ``[w*size_l, n_rv]`` the same one-hot with ``(q, pos)``
+      rows — the own-collision contraction.
+    """
+    li_f = li.astype(jnp.float32)
+    t_li1 = (li_f + 1.0).T
+    t_li2 = (li_f * li_f - 1.0).T
+    t_oob = jnp.where((li > w) | (li < 0), 1.0, 0.0).T
+    oh = (li[:, :, None] == jnp.arange(w)[None, None, :])  # [n_rv, s, w]
+    t_lh = (
+        oh.transpose(1, 2, 0).reshape(size_l, w * li.shape[0]).astype(gdt)
+    )
+    t_lh2 = (
+        oh.transpose(2, 1, 0).reshape(w * size_l, li.shape[0]).astype(gdt)
+    )
+    return t_li1, t_li2, t_oob, t_lh, t_lh2
+
+
+def accept_first_per_value_all(ok_all, v2_all, vi, idx_col, n_p, n_rv, w):
+    """All-receiver first-candidate-per-order dedup (``tfg.py:294``):
+    the batched form of :func:`accept_first_per_value` — receivers' vi
+    rows are disjoint, so one ``[n_p, n_rv, w]`` pass computes every
+    receiver's column with no serial chain.  ``vi`` is the CURRENT
+    ``[n_rv, w]`` int32 accepted-set matrix (read once by the caller);
+    returns ``(acc [n_p, n_rv] int32, new_vi [n_rv, w] int32)``.  The
+    cross-block sequential carry stays with the caller's revisited
+    output block."""
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (n_p, n_rv, w), 2)
+    onehot = v2_all[:, :, None] == iota_w  # [n_p, n_rv, w]
+    # Minor-dim insertion on an i1 vector is not lowerable (Mosaic:
+    # "Insertion of minor dim that is not a no-op only supported for
+    # 32-bit types") — expand ok as int32, compare back to bool.
+    ok_i = jnp.where(ok_all, 1, 0)
+    cand = onehot & (ok_i[:, :, None] != 0) & (vi[None, :, :] == 0)
+    masked_idx = jnp.where(cand, idx_col[:, :, None], n_p)
+    first = jnp.min(masked_idx, axis=0)  # [n_rv, w]
+    acc_i = jnp.where(
+        cand & (first[None, :, :] == idx_col[:, :, None]), 1, 0
+    )  # int32 throughout — i1 reduces pick unlowerable vreg bitcasts
+    acc = jnp.max(acc_i, axis=2)
+    # [n_p, n_rv] — at most one lane per (packet, receiver)
+    new_vi = jnp.where(
+        (vi != 0) | (jnp.max(acc_i, axis=0) != 0), 1, 0
+    )
+    return acc, new_vi
 
 
 def accept_first_per_value_group(r0, grp, ok_g, v2_g, ovi_ref,

@@ -92,7 +92,8 @@ def init_state(n: int) -> jnp.ndarray:
 def apply_1q(state: jnp.ndarray, mat: jnp.ndarray, target: int) -> jnp.ndarray:
     """Apply a 2x2 ``mat`` to qubit ``target``."""
     moved = jnp.moveaxis(state, target, 0)
-    out = jnp.tensordot(mat, moved, axes=([1], [0]))
+    out = jnp.tensordot(mat, moved, axes=([1], [0]),
+                        precision=jax.lax.Precision.HIGHEST)
     return jnp.moveaxis(out, 0, target)
 
 
@@ -109,7 +110,8 @@ def apply_controlled_1q(
     perm = ctrls + [target] + rest
     moved = jnp.transpose(state, perm)
     sub = moved[(1,) * len(ctrls)]  # controls all |1>, target is axis 0
-    sub = jnp.tensordot(mat, sub, axes=([1], [0]))
+    sub = jnp.tensordot(mat, sub, axes=([1], [0]),
+                        precision=jax.lax.Precision.HIGHEST)
     moved = moved.at[(1,) * len(ctrls)].set(sub)
     return jnp.transpose(moved, _inverse_permutation(perm))
 

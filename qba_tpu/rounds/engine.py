@@ -305,14 +305,20 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     if cfg.size_l * cfg.w * cfg.w < 2**24:
         li_f = li.astype(jnp.float32)
         pv = jnp.where(p_f[:, None, :], vals_f, 0).astype(jnp.float32)
+        # Precision.HIGHEST: the identity needs exact integer dots, and
+        # a default-precision f32 dot may lower through bf16, rounding
+        # operands > 256 (li^2-1 here; vals/li at w > 256) — the round-5
+        # wrong-draw bug class (ops/round_kernel_tiled._prec).
         m1 = jax.lax.dot_general(
             pv.reshape(n_pk * max_l, cfg.size_l),
             (li_f + 1.0)[:, None],
             (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
         ).reshape(n_pk, max_l)
         m2 = jax.lax.dot_general(
             p_f.astype(jnp.float32), (li_f * li_f - 1.0)[:, None],
             (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
         )[:, 0]
         s_v = jnp.sum(vals_f, axis=-1)  # int32, exact
         ssq_v = jnp.sum(vals_f * vals_f, axis=-1)
@@ -523,14 +529,19 @@ def run_rounds_tiled(
         build_rebuild_kernel,
         build_verdict_kernel,
         honest_cells as honest_cells_fn,
+        make_verdict_tables,
         pool_from_step3a,
         rebuild_pool,
         resolve_rebuild_block,
         resolve_tiled_block,
+        resolve_verdict_variant,
     )
 
+    variant = resolve_verdict_variant(cfg)
     blk = resolve_tiled_block(cfg)
-    verdict = build_verdict_kernel(cfg, blk, interpret=interpret)
+    verdict = build_verdict_kernel(
+        cfg, blk, interpret=interpret, variant=variant
+    )
     blk_d = resolve_rebuild_block(cfg)
     rebuild_k = (
         build_rebuild_kernel(cfg, blk_d, interpret=interpret)
@@ -539,6 +550,13 @@ def run_rounds_tiled(
     )
     pool = pool_from_step3a(cfg, out_cells)
     honest_cells = honest_cells_fn(honest, cfg)
+    # The all-receiver variant consumes per-receiver tables instead of
+    # li — round-invariant, so built once here, outside the scan.
+    li_arg = (
+        make_verdict_tables(cfg, lieu_lists)
+        if variant == "allrecv"
+        else lieu_lists
+    )
 
     def round_body(carry, round_idx):
         vi_i32, pool = carry
@@ -550,7 +568,7 @@ def run_rounds_tiled(
         att_c = attack.astype(jnp.int32)
         rv_c = rand_v.astype(jnp.int32)
         acc, vi_i32 = verdict(
-            round_idx, *pool, lieu_lists, vi_i32,
+            round_idx, *pool, li_arg, vi_i32,
             honest_cells, att_c, rv_c, late.astype(jnp.int32),
         )
         if rebuild_k is not None:

@@ -92,6 +92,30 @@ class TestTiledEquivalence:
         assert cfg.w == 64
         assert_equal(*both(cfg, 8, 2, 64))
 
+    def test_group_variant_bit_identical(self, monkeypatch):
+        # Off-TPU the resolver picks the all-receiver variant whenever
+        # the exactness gate holds — pin the lane-group variant against
+        # the XLA engine too (it remains the TPU fallback and the
+        # party-sharded engine's only variant).
+        import qba_tpu.ops.round_kernel_tiled as rkt
+
+        monkeypatch.setattr(
+            rkt, "resolve_verdict_variant",
+            lambda cfg, n_recv=None: "group",
+        )
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=2)
+        assert_equal(*both(cfg, 1, 8, 8))
+        cfg_w = QBAConfig(n_parties=33, size_l=8, n_dishonest=2)
+        assert_equal(*both(cfg_w, 8, 2, 64))
+
+    def test_variant_static_gate(self):
+        from qba_tpu.ops.verdict_algebra import all_receiver_supported
+
+        assert all_receiver_supported(64, 64)  # north star
+        assert all_receiver_supported(1000, 16)  # reference scale
+        assert not all_receiver_supported(64, 128)  # > 2 bit planes
+        assert not all_receiver_supported(2**12, 64)  # f32 identity
+
 
 class TestXlaRebuildFallback:
     def test_rebuild_pool_bit_identical(self, monkeypatch):
@@ -357,3 +381,121 @@ class TestRooflineModel:
         assert m1000["batch_bytes_upper_bound"] > (
             3 * pool["padded_bytes"] * cfg.n_rounds
         )
+
+
+class TestMatmulPrecisionExactness:
+    """Round 5: the wrong-draw bug.  An f32-dtype dot at DEFAULT matmul
+    precision may lower through single-pass bf16 (backend- and
+    lowering-dependent — the same program was exact at batch 1 and lossy
+    at batch 16), rounding integer operands > 256 to even.  The rebuild
+    kernel's meta gather carries cell ids up to n_pool-1 = 2047, so at
+    33-party scale sources at odd cells > 256 were rebuilt with a
+    NEIGHBOR cell's corruption draws — silently corrupting north-star
+    trials while every small-config test stayed green.  Fix: _prec /
+    _exact_prec (Precision.HIGHEST on every integer dot whose operands
+    can exceed bf16's exact range).
+    """
+
+    def test_rebuild_kernel_high_cells_matches_xla_rebuild(self):
+        # Direct contract test at high occupancy: a synthetic compacted
+        # pool whose packets sit at odd cell ids > 256, every receiver
+        # accepting many packets — the regime the protocol-level suites
+        # never reached.  Kernel and XLA rebuild must agree bit-for-bit.
+        import numpy as np
+
+        from qba_tpu.ops.round_kernel_tiled import (
+            META_CELL,
+            build_rebuild_kernel,
+            rebuild_pool,
+            resolve_rebuild_block,
+        )
+
+        cfg = QBAConfig(n_parties=33, size_l=8, n_dishonest=10)
+        n_rv, slots, max_l, s = (
+            cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l,
+        )
+        n_pool = n_rv * slots
+        rng = np.random.default_rng(3)
+        n_sent = 700  # fills cells far past 256
+        cells = np.sort(
+            rng.choice(n_pool, size=n_sent, replace=False)
+        ).astype(np.int32)
+        vals = np.full((max_l, n_pool, s), -1, np.int32)
+        lens = np.zeros((n_pool, max_l), np.int32)
+        meta = np.zeros((n_pool, 4), np.int32)
+        cnt = rng.integers(1, 3, size=n_sent).astype(np.int32)
+        for i in range(n_sent):
+            vals[: cnt[i], i] = rng.integers(0, cfg.w, size=(cnt[i], s))
+            lens[i, : cnt[i]] = s
+        meta[:n_sent, 0] = cnt
+        meta[:n_sent, 1] = rng.integers(0, cfg.w, size=n_sent)
+        meta[:n_sent, 2] = 1
+        meta[:n_sent, META_CELL] = cells
+        p = rng.integers(0, 2, size=(n_pool, s)).astype(np.int32)
+        li = rng.integers(0, cfg.w, size=(n_rv, s)).astype(np.int32)
+        acc = np.zeros((n_pool, n_rv), np.int32)
+        acc[:n_sent] = rng.random((n_sent, n_rv)) < 0.5  # heavy accepts
+        attack = rng.integers(0, 16, size=(n_pool, n_rv)).astype(np.int32)
+        rand_v = rng.integers(0, cfg.n_parties + 1,
+                              size=(n_pool, n_rv)).astype(np.int32)
+        honest = rng.integers(0, 2, size=(n_pool, 1)).astype(np.int32)
+
+        from qba_tpu.ops.round_kernel_tiled import pool_vals_dtype
+
+        vdt = pool_vals_dtype(cfg)
+        pool = (
+            jnp.asarray(vals, vdt), jnp.asarray(lens),
+            jnp.asarray(p, vdt), jnp.asarray(meta),
+        )
+        r_idx = jnp.asarray(2)
+        blk_d = resolve_rebuild_block(cfg)
+        rebuild_k = build_rebuild_kernel(cfg, blk_d, interpret=True)
+        out_k, ovf_k = rebuild_k(
+            r_idx, *pool, jnp.asarray(li), jnp.asarray(acc),
+            jnp.asarray(attack), jnp.asarray(rand_v), jnp.asarray(honest),
+        )
+        cell = pool[3][:, META_CELL]
+        out_x, ovf_x = rebuild_pool(
+            cfg, r_idx, pool, jnp.asarray(li), jnp.asarray(acc),
+            jnp.take(jnp.asarray(attack), cell, axis=0),
+            jnp.take(jnp.asarray(rand_v), cell, axis=0),
+            jnp.take(jnp.asarray(honest), cell, axis=0),
+        )
+        import numpy as _np
+
+        for a_, b_ in zip(out_k, out_x):
+            assert (_np.asarray(a_) == _np.asarray(b_)).all()
+        assert bool(ovf_k) == bool(ovf_x)
+
+    def test_north_star_batch_bit_identical(self):
+        # The end-to-end repro that exposed the bug: the exact 16
+        # vmapped trials (backend key tree, seed 5) at the 33-party
+        # north-star shape, tiled vs XLA engine.  Trials 9/11/12
+        # diverged before the fix (and which trials diverged depended
+        # on the batch composition).  Slow (~minutes on CPU) but guards
+        # the flagship engine's headline configuration.
+        import dataclasses as _dc
+
+        import numpy as _np
+
+        from qba_tpu.backends.jax_backend import (
+            fence, run_trials, trial_keys,
+        )
+
+        cfg = QBAConfig(
+            n_parties=33, size_l=64, n_dishonest=10, trials=16, seed=5,
+            round_engine="pallas_tiled", tiled_block=128,
+        )
+        keys = trial_keys(cfg)
+        r_t = run_trials(cfg, keys)
+        fence(r_t)
+        cfg_x = _dc.replace(cfg, round_engine="xla", tiled_block=None)
+        r_x = run_trials(cfg_x, keys)
+        fence(r_x)
+        assert (
+            _np.asarray(r_t.trials.decisions)
+            == _np.asarray(r_x.trials.decisions)
+        ).all()
+        assert (
+            _np.asarray(r_t.trials.vi) == _np.asarray(r_x.trials.vi)
+        ).all()
