@@ -2,11 +2,14 @@
 
 Headline (from BASELINE.json): protocol rounds/sec at nParties=11,
 sizeL=64, 1000 trials (nDishonest=3 → 4 voting rounds/trial) on the jax
-backend.  ``vs_baseline`` is the speedup over the message-level
-pure-Python reference backend (:mod:`qba_tpu.backends.local_backend`) run
-on host CPU — the in-repo stand-in for the reference's ``mpiexec`` run
-(the reference itself publishes no numbers and needs MPI + qsimov,
-neither available here; BASELINE.md).
+backend.  ``vs_baseline_wall`` / ``vs_baseline_device`` are labeled
+speedups over the message-level pure-Python reference backend
+(:mod:`qba_tpu.backends.local_backend`) run on host CPU — the in-repo
+stand-in for the reference's ``mpiexec`` run (the reference itself
+publishes no numbers and needs MPI + qsimov, neither available here;
+BASELINE.md).  The wall ratio is like-for-like; the device ratio is the
+kernels-only upper bound (tunnel overhead excluded from the numerator
+only).
 
 The single JSON line is variance-aware: it carries every rep's wall time
 (``rep_seconds``) plus the median-derived value next to the best-of
@@ -191,7 +194,7 @@ def main() -> None:
 
         ns_cfg = QBAConfig(**NORTHSTAR, seed=0)
         try:
-            from qba_tpu.rounds.engine import resolve_round_engine
+            from qba_tpu.benchmark import engine_description
 
             ns_times, ns_run = _measure_jax(
                 ns_cfg, reps=4, chunk_trials=NORTHSTAR_CHUNK
@@ -199,7 +202,10 @@ def main() -> None:
             northstar = dict(
                 _rps_stats(ns_cfg, ns_times, ns_run),
                 metric="northstar_rounds_per_sec_n33_l64_d10_t1000",
-                engine=resolve_round_engine(ns_cfg),
+                # engine/variant attribution (e.g. "pallas_tiled/group")
+                # — the accept-path variant is a per-machine compile
+                # probe, so the artifact must say which path it timed.
+                engine=engine_description(ns_cfg),
                 chunk_trials=NORTHSTAR_CHUNK,
             )
             try:
@@ -234,8 +240,20 @@ def main() -> None:
         "value": headline,
         "unit": "rounds/s",
         "headline_source": "device_median" if device else "wall_median",
-        "vs_baseline": (
-            round(headline / baseline_rps, 2) if baseline_rps else None
+        # Two LABELED baseline ratios (VERDICT r5 weak point 2 — the
+        # old single `vs_baseline` divided device-only seconds by the
+        # baseline's CPU wall time, an apples-to-oranges headline):
+        # the wall ratio is like-for-like (both sides carry host +
+        # tunnel overhead); the device ratio is the kernels-only upper
+        # bound and overstates the end-to-end speedup wherever tunnel
+        # overhead matters.
+        "vs_baseline_wall": (
+            round(stats["median_value"] / baseline_rps, 2)
+            if baseline_rps else None
+        ),
+        "vs_baseline_device": (
+            round(device["device_rounds_per_sec"] / baseline_rps, 2)
+            if (device and baseline_rps) else None
         ),
         "wall_best_value": rps,
         "median_value": stats["median_value"],

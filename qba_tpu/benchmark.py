@@ -28,6 +28,23 @@ NORTHSTAR = dict(n_parties=33, size_l=64, n_dishonest=10, trials=1000)
 NORTHSTAR_CHUNK = 1000
 
 
+def engine_description(cfg: QBAConfig) -> str:
+    """Engine attribution string for benchmark artifacts: the resolved
+    round engine, plus the verdict-kernel variant when the tiled engine
+    runs (e.g. ``"pallas_tiled/group"``) — so a ``BENCH_r*.json`` row
+    can be tied to the kernel path that produced it (the round-6
+    accept-path split makes "pallas_tiled" alone ambiguous across
+    machines: the variant is a per-machine compile probe)."""
+    from qba_tpu.rounds.engine import resolve_round_engine
+
+    engine = resolve_round_engine(cfg)
+    if engine == "pallas_tiled":
+        from qba_tpu.ops.round_kernel_tiled import resolve_verdict_variant
+
+        return f"{engine}/{resolve_verdict_variant(cfg)}"
+    return engine
+
+
 def measure_batch(
     cfg: QBAConfig,
     reps: int,

@@ -50,7 +50,13 @@ from qba_tpu.core.types import SENTINEL
 from qba_tpu.ops.verdict_algebra import (
     VerdictAlgebra,
     _exact_prec,
-    accept_first_per_value,
+    accept_first_per_value_all,
+)
+
+# Compiler-params compat: older jax builds name the Pallas-TPU params
+# class ``TPUCompilerParams``; newer ones ``CompilerParams``.
+CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
 )
 
 
@@ -110,12 +116,29 @@ def honest_packets(honest, cfg: QBAConfig):
 # step and both tiled kernels import these — ONE copy of the promotion
 # rule, not three hand-synchronized closures).
 
+def _detect_vma_support() -> bool:
+    """Whether this jax build has the varying-manual-axes machinery
+    (``ShapeDtypeStruct(..., vma=...)`` / ``lax.pcast``).  Older builds
+    predate it — their shard_map replication checker (``check_rep``)
+    has its own pallas rules, so the declarations below degrade to
+    no-ops rather than crashing every party-sharded kernel build."""
+    try:
+        jax.ShapeDtypeStruct((1,), jnp.int32, vma=frozenset())
+        return True
+    except TypeError:
+        return False
+
+
+_HAVE_VMA = _detect_vma_support()
+
+
 def promote_vma(out_vma, x):
     """Promote ``x`` to carry every axis in ``out_vma``: under the
     replication checker every pallas operand must match the declared
     vma; constants and replicated values get pcast explicitly.
-    No-op when ``out_vma`` is None (checker off)."""
-    if out_vma is None:
+    No-op when ``out_vma`` is None (checker off) or the build has no
+    vma machinery."""
+    if out_vma is None or not _HAVE_VMA:
         return x
     have = getattr(jax.typeof(x), "vma", frozenset())
     need = tuple(a for a in out_vma if a not in have)
@@ -126,7 +149,7 @@ def vma_struct(out_vma, dims, dt=jnp.int32):
     """``ShapeDtypeStruct`` carrying the declared output vma (pallas
     outputs must state which mesh axes they vary over under the
     replication checker)."""
-    if out_vma is None:
+    if out_vma is None or not _HAVE_VMA:
         return jax.ShapeDtypeStruct(dims, dt)
     return jax.ShapeDtypeStruct(dims, dt, vma=out_vma)
 
@@ -262,20 +285,6 @@ def build_round_step(
         )
         count_eff_all = jnp.where(clearl_all, 0, count)
 
-        def accept_and_store(recv, ok, dup, own_len):
-            """Per-receiver acceptance (shared first-candidate dedup,
-            ops/verdict_algebra.py), vi update, and the scratch columns
-            for the batched rebuild.  NOT idempotent (reads ovi_ref) —
-            must run exactly once per receiver."""
-            acc, new_vi = accept_first_per_value(
-                ok, v2_all[:, recv : recv + 1],
-                ovi_ref[recv : recv + 1, :], idx_col, n_pk, w,
-            )
-            ovi_ref[recv : recv + 1, :] = new_vi.astype(jnp.int32)
-            acc_scr[:, recv : recv + 1] = acc.astype(jnp.int32)
-            dup_scr[:, recv : recv + 1] = dup.astype(jnp.int32)
-            olen_scr[:, recv : recv + 1] = own_len
-
         # ---- Loop A: the shared per-group acceptance flag algebra ------
         # (ops/verdict_algebra.py — one implementation for both Pallas
         # kernels; lane-packs grp receivers per tile, value-presence as
@@ -288,23 +297,45 @@ def build_round_step(
             lioob_vals=lioob_ref[:], r_idx=r_idx,
         )
         done: set[int] = set()
+        ok_parts = []
+        next_col = 0
         for gi, r0 in enumerate(r0_list):
             sl = slice(r0, r0 + grp)
             ok_g, dup_g, own_len_g = va.group(
                 gi, v2_all[:, sl], clearp_all[:, sl], clearl_all[:, sl],
                 count_eff_all[:, sl], delivered_all[:, sl],
             )
+            # int32 before slicing/concatenating (Mosaic rejects i1
+            # tpu.concatenate); tail-group overlap keeps only the not-
+            # yet-covered columns (the recomputed flags are identical).
+            ok_i = jnp.where(ok_g, 1, 0)
+            ok_parts.append(ok_i[:, next_col - r0 :])
+            next_col = r0 + grp
             for j in range(grp):
                 recv = r0 + j
                 if recv in done:  # tail-group overlap: already done
                     continue
                 done.add(recv)
-                accept_and_store(
-                    recv,
-                    ok_g[:, j : j + 1],
-                    dup_g[:, j : j + 1],
-                    own_len_g[:, j : j + 1],
+                dup_scr[:, recv : recv + 1] = dup_g[:, j : j + 1].astype(
+                    jnp.int32
                 )
+                olen_scr[:, recv : recv + 1] = own_len_g[:, j : j + 1]
+        ok_all = (
+            jnp.concatenate(ok_parts, axis=1)
+            if len(ok_parts) > 1 else ok_parts[0]
+        )
+        # Round 6 — parallel first-accept reduction (mirrors the tiled
+        # kernel's "group" variant): one segmented first-index pass
+        # dedups every receiver at once, replacing the per-receiver
+        # accept chain through ovi_ref that the round-5 roofline named
+        # as the dominant serial term.  Receivers' vi rows are disjoint,
+        # so batching is observationally identical to the sequential
+        # drain (tfg.py:294).
+        acc_all_i, new_vi = accept_first_per_value_all(
+            ok_all != 0, v2_all, ovi_ref[:], idx_col, n_pk, n_rv, w
+        )
+        ovi_ref[:] = new_vi
+        acc_scr[:] = acc_all_i
 
         # ---- Batched slot allocation (tfg.py:298-299), all receivers -----
         # One triangular MXU matmul computes every receiver's exclusive
@@ -454,7 +485,7 @@ def build_round_step(
             pltpu.VMEM((n_pk, n_rv), jnp.int32),  # olen_scr
             pltpu.VMEM((n_pk, n_c), gdt),  # g_scr
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             # Raise Mosaic's ~16 MB default scoped-vmem cap toward the
             # physical VMEM: large vmap batches multi-buffer operands
             # (see round_kernel_tiled.py), and configs like the
@@ -528,13 +559,17 @@ def _probe_cache_path() -> str:
     )
 
 
-_PROBE_VERSION = 7  # bump when kernel structure/compiler params change
+_PROBE_VERSION = 8  # bump when kernel structure/compiler params change
 # v6: tiled kernels take the meta-packed pool (count/v/sent/cell in one
 # [cap, 4] tensor) + donation; block ordering recalibrated on honest
 # timings (docs/PERF.md round 4 erratum).
 # v7: Precision.HIGHEST on exactness-critical dots (KI-3 — changes the
 # kernels' scoped-vmem footprint, so v6 block plans are stale) + the
 # all-receiver verdict variant.
+# v8: parallel first-accept reduction in both kernels and the
+# group/group-serial accept-path split (v7 "group" block plans
+# measured a different kernel body; the new [blk, n_rv, w] accept
+# intermediates change the scoped-vmem footprint).
 
 
 def _probe_disk_key(kernel: str, cfg: QBAConfig, extra: str = "") -> str:

@@ -67,7 +67,7 @@ from qba_tpu.adversary import (
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
-from qba_tpu.ops.round_kernel import _lane_group
+from qba_tpu.ops.round_kernel import CompilerParams, _lane_group
 from qba_tpu.ops.verdict_algebra import (
     AllReceiverVerdict,
     VerdictAlgebra,
@@ -132,6 +132,23 @@ def build_verdict_kernel(
     reads the block's own data rather than an ``n_sent`` scalar: a
     per-trial scalar operand cannot be batched into SMEM under vmap.)
 
+    ``variant`` selects the verdict formulation (all bit-identical;
+    :func:`resolve_verdict_variant` picks):
+
+    * ``"group"`` — lane-group flag algebra + the round-6
+      block-parallel first-accept reduction: one
+      :func:`accept_first_per_value_all` pass dedups every receiver at
+      once, with no per-receiver chain through ``ovi_ref``.  The
+      default; covers every config, including the ones the round-4
+      group-batched dedup excludes (``grp == 1`` and
+      ``grp * w > 512``).
+    * ``"group-serial"`` — the pre-round-6 accept path (group-batched
+      dedup inside the ``grp * w <= 512`` window, serial per-receiver
+      chains elsewhere).  Kept as the TPU compile fallback and as the
+      in-repo reference the parallel reduction is pinned against.
+    * ``"allrecv"`` — all-receiver flag algebra (docs/PERF.md round 5),
+      gated by :func:`all_receiver_supported`.
+
     ``n_recv`` builds the party-sharded variant for
     :mod:`qba_tpu.parallel.spmd` (mirroring the monolithic kernel's
     ``build_round_step(n_recv=...)``): the kernel drains a contiguous
@@ -154,7 +171,7 @@ def build_verdict_kernel(
         raise ValueError(f"blk={blk} must divide n_pool={n_pool}")
     n_blocks = n_pool // blk
     gdt = _gdt(cfg)
-    if variant not in ("group", "allrecv"):
+    if variant not in ("group", "group-serial", "allrecv"):
         raise ValueError(f"unknown verdict variant {variant!r}")
     if variant == "allrecv" and not all_receiver_supported(size_l, w):
         raise ValueError(
@@ -321,6 +338,50 @@ def build_verdict_kernel(
                 e_vals=e_ref[:], lip_vals=lip_ref[:],
                 lioob_vals=lioob_ref[:], r_idx=r_idx,
             )
+            if variant == "group":
+                # Round 6 — block-parallel first-accept reduction: the
+                # lane-group loop still produces the ok flags (its MXU
+                # batching over grp receivers is the win the round-4
+                # pass bought), but the dedup is ONE segmented
+                # first-index reduction over all receivers instead of a
+                # per-receiver chain through ovi_ref — the roofline's
+                # dominant serial term (docs/PERF.md round 6).  The
+                # cross-block vi carry stays: acceptance in later blocks
+                # depends on earlier blocks' accepted values (see the
+                # carry-dependency repro in tests/test_verdict_algebra
+                # .py), and TPU grid steps execute in order anyway, so
+                # the carry is free — only the within-block chain was
+                # the floor.
+                ok_parts = []
+                next_col = 0
+                for gi, r0 in enumerate(r0_list):
+                    sl = slice(r0, r0 + grp)
+                    ok_g, _dup_g, _olen_g = va.group(
+                        gi, v2_all[:, sl], clearp_all[:, sl],
+                        clearl_all[:, sl], count_eff_all[:, sl],
+                        delivered_all[:, sl],
+                    )
+                    # int32 before slicing/concatenating: Mosaic rejects
+                    # i1 tpu.concatenate and i1 lane relayouts.
+                    ok_i = jnp.where(ok_g, 1, 0)
+                    # Tail-group overlap: keep only the columns not
+                    # already covered (the recomputed flags are
+                    # identical either way).
+                    ok_parts.append(ok_i[:, next_col - r0 :])
+                    next_col = r0 + grp
+                ok_all = (
+                    jnp.concatenate(ok_parts, axis=1)
+                    if len(ok_parts) > 1 else ok_parts[0]
+                )
+                acc, new_vi = accept_first_per_value_all(
+                    ok_all != 0, v2_all, ovi_ref[:], idx_col, blk,
+                    n_rv, w,
+                )
+                ovi_ref[:] = new_vi
+                acc_ref[:] = acc
+                return
+
+            # variant == "group-serial": the pre-round-6 accept chain.
             done: set[int] = set()
             for gi, r0 in enumerate(r0_list):
                 sl = slice(r0, r0 + grp)
@@ -415,7 +476,7 @@ def build_verdict_kernel(
         # kernel's aliasing note).  Safe: vi_ref is copied into the
         # revisited ovi block at grid step 0 and only ovi is read after.
         input_output_aliases={(2 if local else 1) + 4: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             # See build_rebuild_kernel: large vmap batches multi-buffer
             # operands past the compiler's ~16 MB default scoped cap.
             vmem_limit_bytes=100 * 2**20,
@@ -1051,7 +1112,7 @@ def build_rebuild_kernel(
             pltpu.VMEM((n_rv, n_pool), jnp.int32),  # sT (clamped slots)
             pltpu.VMEM((8, n_rv), jnp.int32),  # offs / k_r rows
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             # The resident full-pool operands get multi-buffered at large
             # vmap batches; raise the compiler's scoped-vmem cap (default
             # ~16 MB) toward the physical VMEM so that's allowed.
@@ -1116,22 +1177,28 @@ def _block_estimate(cfg: QBAConfig, blk: int,
     round_kernel.fits_kernel — a screen before the authoritative compile
     probe, not a guarantee).  ``n_recv`` estimates the party-sharded
     local-receiver variant (smaller flag tiles and lane groups);
-    ``variant`` None is the conservative max over both verdict
-    variants."""
+    ``variant`` None is a conservative over-approximation covering
+    every verdict variant."""
     n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
     tile = 4 * blk * cfg.size_l
     est = tile * (2 * cfg.max_l + 10)
     grp = _lane_group(cfg.size_l, n_rv)
     if grp > 1:
         est += tile * grp * (cfg.max_l + 6)
-        if grp * cfg.w <= 512:
+        if variant != "group" and grp * cfg.w <= 512:
             # Group-batched dedup intermediates (~7 [blk, grp*w] int32
-            # tiles — see accept_first_per_value_group).
+            # tiles — see accept_first_per_value_group); only the
+            # serial-accept variant runs this pass.
             est += 4 * blk * grp * cfg.w * 7
+    if variant in (None, "group"):
+        # Block-parallel accept intermediates (~5 [blk, n_rv, w] int32
+        # tiles — see accept_first_per_value_all, the round-6 default
+        # accept path for the group variant).
+        est += 4 * blk * n_rv * cfg.w * 5
     est += 4 * blk * n_rv * 6  # flag algebra tiles
     est = int(est * (1.0 + cfg.max_l / 4.0))
     if (
-        variant != "group"
+        variant not in ("group", "group-serial")
         and n_recv is None
         and all_receiver_supported(cfg.size_l, cfg.w)
     ):
@@ -1412,23 +1479,66 @@ def roofline_model(cfg: QBAConfig, trials: int = 1) -> dict:
     }
 
 
-_VARIANT_CACHE: dict[tuple, str] = {}
+_VARIANT_CACHE: dict[tuple, bool] = {}
 
 
-def resolve_verdict_variant(cfg: QBAConfig,
-                            n_recv: int | None = None) -> str:
-    """Which verdict-kernel variant this config runs: ``"allrecv"``
-    (all receivers batched per block — docs/PERF.md round 5) where the
-    exactness gate holds and the kernel compiles, else ``"group"`` (the
-    lane-group loop).  On TPU the verdict is a cached compile probe
-    (same machinery as the block-size plans); off-TPU (interpret mode)
-    the static gate alone decides, so the CPU equivalence suites
-    exercise the same math the TPU runs.  The party-sharded engine
-    (``n_recv``) keeps the group variant."""
-    if n_recv is not None or not all_receiver_supported(cfg.size_l, cfg.w):
-        return "group"
+def _probe_verdict_compile(cfg: QBAConfig, blk_probe: int, variant: str,
+                           n_recv: int | None = None) -> None:
+    """Data-free compile probe of one verdict-kernel build (raises on
+    failure, never executes).  Shared by the variant resolvers; on
+    success the caller may seed the block plan with ``blk_probe``."""
+    shp, i32, vdt = _probe_shapes(cfg)
+    n_pool = cfg.n_lieutenants * cfg.slots
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
+    local = n_recv is not None
+    s, w, gdt = cfg.size_l, cfg.w, _gdt(cfg)
+    if variant == "allrecv":
+        li_shape = (
+            shp(s, n_rv, dt=jnp.float32), shp(s, n_rv, dt=jnp.float32),
+            shp(s, n_rv, dt=jnp.float32), shp(s, w * n_rv, dt=gdt),
+            shp(w * s, n_rv, dt=gdt),
+        )
+    else:
+        li_shape = shp(n_rv, s)
+    verdict = build_verdict_kernel(
+        cfg, blk_probe, n_recv=n_recv, variant=variant
+    )
+    off = (jax.ShapeDtypeStruct((), i32),) if local else ()
+    in_axes = (None,) * (1 + len(off)) + (0,) * 10
+    jax.jit(jax.vmap(verdict, in_axes=in_axes)).lower(
+        jax.ShapeDtypeStruct((), i32),
+        *off,
+        shp(cfg.max_l, n_pool, s, dt=vdt),
+        shp(n_pool, cfg.max_l),
+        shp(n_pool, s, dt=vdt), shp(n_pool, 4),
+        li_shape, shp(n_rv, w), shp(n_pool, 1),
+        shp(n_pool, n_rv), shp(n_pool, n_rv), shp(n_pool, n_rv),
+    ).compile()
+
+
+def _seed_block_plan(cfg: QBAConfig, blk_probe: int, extra: str) -> None:
+    """Seed the block plan with a just-compiled candidate so
+    tiled_kernel_plan does not pay the same ~2-minute remote compile a
+    second time (it probes the same first candidate)."""
+    plan_key = _shape_key(cfg) + (extra,)
+    _TILED_PROBE_CACHE.setdefault(plan_key, blk_probe)
+    _probe_disk_put(
+        _probe_disk_key("tiled-verdict", cfg, extra=extra), blk_probe
+    )
+
+
+def _resolve_group_accept(cfg: QBAConfig,
+                          n_recv: int | None = None) -> str:
+    """Accept-path resolution within the group family: ``"group"`` (the
+    round-6 block-parallel first-accept reduction) when that kernel
+    compiles, demoting to ``"group-serial"`` (the pre-round-6
+    per-receiver accept chain, which has compiled at every supported
+    shape since round 3) on a deterministic compile failure.  Off-TPU
+    there is no real compile to probe: the parallel path is the static
+    default, so the CPU equivalence suites exercise the same math the
+    TPU runs."""
     if jax.default_backend() != "tpu":
-        return "allrecv"
+        return "group"
     # Probe at the block size the engine will actually run with — an
     # explicit tiled_block bypasses the block-plan probe entirely, so a
     # variant verdict from a different block would not transfer.
@@ -1436,59 +1546,130 @@ def resolve_verdict_variant(cfg: QBAConfig,
     if cfg.tiled_block is not None and n_pool % cfg.tiled_block == 0:
         blk_probe = cfg.tiled_block
     else:
+        cands = block_candidates(cfg, n_recv, "group")
+        if not cands:
+            return "group-serial"
+        blk_probe = cands[0]
+    key = _shape_key(cfg) + ("accept", n_recv, blk_probe)
+    if key in _VARIANT_CACHE:
+        return "group" if _VARIANT_CACHE[key] else "group-serial"
+    dkey = _probe_disk_key(
+        "tiled-verdict-accept", cfg,
+        extra=f"blk{blk_probe}"
+        + (f"recv{n_recv}" if n_recv is not None else ""),
+    )
+    hit = _probe_disk_get(dkey)
+    if hit is not None:
+        _VARIANT_CACHE[key] = hit > 0
+        return "group" if hit > 0 else "group-serial"
+    from qba_tpu.ops.round_kernel import probe_error_transient
+
+    err: Exception | None = None
+    try:
+        _probe_verdict_compile(cfg, blk_probe, "group", n_recv)
+        if cfg.tiled_block is None:
+            _seed_block_plan(
+                cfg, blk_probe,
+                (f"recv{n_recv}" if n_recv is not None else ""),
+            )
+    except Exception as e:
+        if probe_error_transient(e):
+            # Unknown verdict — do not cache; take the proven serial
+            # path for this process only (observable, mirroring the
+            # _probe_plan fallback message — ADVICE r5 item 2).
+            warnings.warn(
+                "tiled-verdict accept-path compile probe hit a "
+                f"transient error at (n_parties={cfg.n_parties}, "
+                f"size_l={cfg.size_l}, slots={cfg.slots}); falling back "
+                "to the serial accept chain ('group-serial') for this "
+                f"process without caching: {e!r:.500}",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            return "group-serial"
+        err = e
+    ok = err is None
+    _VARIANT_CACHE[key] = ok
+    _probe_disk_put(dkey, 1 if ok else 0)
+    if not ok:
+        warnings.warn(
+            "tiled-verdict parallel accept reduction failed to compile "
+            f"at (n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
+            f"slots={cfg.slots}, blk={blk_probe}); demoting to the "
+            f"serial accept chain ('group-serial'): {err!r:.500}",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+    return "group" if ok else "group-serial"
+
+
+def resolve_verdict_variant(cfg: QBAConfig,
+                            n_recv: int | None = None) -> str:
+    """Which verdict-kernel variant this config runs: ``"allrecv"``
+    (all receivers batched per block — docs/PERF.md round 5) where the
+    exactness gate holds and the kernel compiles, else the group family
+    — ``"group"`` (lane-group flag algebra + the round-6 block-parallel
+    first-accept reduction) when it compiles, ``"group-serial"`` (the
+    pre-round-6 accept chain) as the compile fallback.  On TPU the
+    verdicts are cached compile probes (same machinery as the
+    block-size plans); off-TPU (interpret mode) the static gates alone
+    decide, so the CPU equivalence suites exercise the same math the
+    TPU runs.  The party-sharded engine (``n_recv``) stays in the group
+    family."""
+    if n_recv is not None or not all_receiver_supported(cfg.size_l, cfg.w):
+        return _resolve_group_accept(cfg, n_recv)
+    if jax.default_backend() != "tpu":
+        return "allrecv"
+    # Probe at the block size the engine will actually run with (see
+    # _resolve_group_accept).
+    n_pool = cfg.n_lieutenants * cfg.slots
+    if cfg.tiled_block is not None and n_pool % cfg.tiled_block == 0:
+        blk_probe = cfg.tiled_block
+    else:
         cands = block_candidates(cfg, variant="allrecv")
         if not cands:
-            return "group"
+            return _resolve_group_accept(cfg)
         blk_probe = cands[0]
     key = _shape_key(cfg) + (blk_probe,)
     if key in _VARIANT_CACHE:
-        return _VARIANT_CACHE[key]
+        return (
+            "allrecv" if _VARIANT_CACHE[key]
+            else _resolve_group_accept(cfg)
+        )
     dkey = _probe_disk_key(
         "tiled-verdict-variant", cfg, extra=f"blk{blk_probe}"
     )
     hit = _probe_disk_get(dkey)
     if hit is not None:
-        var = "allrecv" if hit > 0 else "group"
-        _VARIANT_CACHE[key] = var
-        return var
+        _VARIANT_CACHE[key] = hit > 0
+        return "allrecv" if hit > 0 else _resolve_group_accept(cfg)
     from qba_tpu.ops.round_kernel import probe_error_transient
 
-    shp, i32, vdt = _probe_shapes(cfg)
-    n_rv = cfg.n_lieutenants
-    s, w, gdt = cfg.size_l, cfg.w, _gdt(cfg)
     try:
-        verdict = build_verdict_kernel(cfg, blk_probe, variant="allrecv")
-        jax.jit(jax.vmap(verdict, in_axes=(None,) + (0,) * 10)).lower(
-            jax.ShapeDtypeStruct((), i32),
-            shp(cfg.max_l, n_pool, s, dt=vdt),
-            shp(n_pool, cfg.max_l),
-            shp(n_pool, s, dt=vdt), shp(n_pool, 4),
-            (
-                shp(s, n_rv, dt=jnp.float32), shp(s, n_rv, dt=jnp.float32),
-                shp(s, n_rv, dt=jnp.float32), shp(s, w * n_rv, dt=gdt),
-                shp(w * s, n_rv, dt=gdt),
-            ),
-            shp(n_rv, w), shp(n_pool, 1),
-            shp(n_pool, n_rv), shp(n_pool, n_rv), shp(n_pool, n_rv),
-        ).compile()
-        var = "allrecv"
-        # Seed the block plan with the just-compiled candidate so
-        # tiled_kernel_plan does not pay the same ~2-minute remote
-        # compile a second time (it probes the same first candidate).
+        _probe_verdict_compile(cfg, blk_probe, "allrecv")
+        ok = True
         if cfg.tiled_block is None:
-            plan_key = _shape_key(cfg) + ("+allrecv",)
-            _TILED_PROBE_CACHE.setdefault(plan_key, blk_probe)
-            _probe_disk_put(
-                _probe_disk_key("tiled-verdict", cfg, extra="+allrecv"),
-                blk_probe,
-            )
+            _seed_block_plan(cfg, blk_probe, "+allrecv")
     except Exception as e:
         if probe_error_transient(e):
-            return "group"  # unknown verdict — do not cache
-        var = "group"
-    _VARIANT_CACHE[key] = var
-    _probe_disk_put(dkey, 1 if var == "allrecv" else 0)
-    return var
+            # Unknown verdict — do not cache.  Warn so variant flapping
+            # across processes is observable (ADVICE r5 item 2; mirrors
+            # the _probe_plan fallback message), then resolve within
+            # the group family for this process.
+            warnings.warn(
+                "tiled-verdict variant compile probe hit a transient "
+                f"error at (n_parties={cfg.n_parties}, "
+                f"size_l={cfg.size_l}, slots={cfg.slots}); falling back "
+                "to the group variant for this process without caching "
+                f"(the variant may flap across runs): {e!r:.500}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return _resolve_group_accept(cfg)
+        ok = False
+    _VARIANT_CACHE[key] = ok
+    _probe_disk_put(dkey, 1 if ok else 0)
+    return "allrecv" if ok else _resolve_group_accept(cfg)
 
 
 def tiled_kernel_plan(cfg: QBAConfig, n_recv: int | None = None,
@@ -1500,47 +1681,22 @@ def tiled_kernel_plan(cfg: QBAConfig, n_recv: int | None = None,
     be modeled reliably from outside.  ``n_recv`` probes the
     party-sharded local-receiver variant; ``variant`` defaults to
     :func:`resolve_verdict_variant`'s pick."""
-    shp, i32, vdt = _probe_shapes(cfg)
-    slots = cfg.slots
-    n_pool = cfg.n_lieutenants * slots
-    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
     local = n_recv is not None
 
     if variant is None:
         variant = resolve_verdict_variant(cfg, n_recv)
 
-    def _li_shape():
-        if variant == "allrecv":
-            s, w, f32, gdt = cfg.size_l, cfg.w, jnp.float32, _gdt(cfg)
-            return (
-                shp(s, n_rv, dt=f32), shp(s, n_rv, dt=f32),
-                shp(s, n_rv, dt=f32), shp(s, w * n_rv, dt=gdt),
-                shp(w * s, n_rv, dt=gdt),
-            )
-        return shp(n_rv, cfg.size_l)
-
     def compile_one(blk):
-        verdict = build_verdict_kernel(
-            cfg, blk, n_recv=n_recv, variant=variant
-        )
-        off = (jax.ShapeDtypeStruct((), i32),) if local else ()
-        in_axes = (None,) * (1 + len(off)) + (0,) * 10
-        jax.jit(jax.vmap(verdict, in_axes=in_axes)).lower(
-            jax.ShapeDtypeStruct((), i32),
-            *off,
-            shp(cfg.max_l, n_pool, cfg.size_l, dt=vdt),
-            shp(n_pool, cfg.max_l),
-            shp(n_pool, cfg.size_l, dt=vdt), shp(n_pool, 4),
-            _li_shape(), shp(n_rv, cfg.w), shp(n_pool, 1),
-            shp(n_pool, n_rv), shp(n_pool, n_rv), shp(n_pool, n_rv),
-        ).compile()
+        _probe_verdict_compile(cfg, blk, variant, n_recv)
 
     return _probe_plan(
         "tiled-verdict", cfg, block_candidates(cfg, n_recv, variant),
         compile_one,
         _TILED_PROBE_CACHE, "falling back to the XLA round engine",
         extra=(f"recv{n_recv}" if local else "")
-        + ("+allrecv" if variant == "allrecv" else ""),
+        + {"allrecv": "+allrecv", "group-serial": "+accser"}.get(
+            variant, ""
+        ),
     )
 
 
@@ -1614,5 +1770,9 @@ def resolve_tiled_block(cfg: QBAConfig, n_recv: int | None = None) -> int:
         blk = tiled_kernel_plan(cfg, n_recv)
         if blk is not None:
             return blk
-    cands = block_candidates(cfg, n_recv)
+    # Pass the resolved variant so the VMEM estimate matches the kernel
+    # the engine actually builds (ADVICE r5 item 4 — a variant=None
+    # estimate over-approximates across all variants and can pick a
+    # different block than the probed plan would).
+    cands = block_candidates(cfg, n_recv, resolve_verdict_variant(cfg, n_recv))
     return cands[0] if cands else cfg.n_lieutenants * cfg.slots

@@ -468,6 +468,13 @@ class AllReceiverVerdict:
             pres, t_pack,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            # KI-3: t_pack carries 1 << (q % 16) up to 2^15 — far past
+            # bf16's 256-integer range — and the gdt here can be f32,
+            # whose DEFAULT precision may still lower through
+            # single-pass bf16.  Exact today only because powers of two
+            # survive bf16 rounding; pin the precision so the packing
+            # stays exact if the plane width ever changes.
+            precision=jax.lax.Precision.HIGHEST,
         ).astype(jnp.int32)  # [n_p, n_half * n_rv], plane-major
         half_cols = [
             packed[:, j * n_rv : (j + 1) * n_rv] for j in range(n_half)
@@ -536,7 +543,18 @@ def accept_first_per_value_all(ok_all, v2_all, vi, idx_col, n_p, n_rv, w):
     ``[n_rv, w]`` int32 accepted-set matrix (read once by the caller);
     returns ``(acc [n_p, n_rv] int32, new_vi [n_rv, w] int32)``.  The
     cross-block sequential carry stays with the caller's revisited
-    output block."""
+    output block (the carry is irreducible in the sense that later
+    blocks' candidates depend on earlier blocks' accepted values — see
+    the dependency repro in tests/test_verdict_algebra.py — but it IS
+    associative: this per-block first-index + the caller's vi merge is
+    exactly the associative combine, and TPU grid steps run in order
+    anyway, so the carry costs O(n_rv*w) elementwise work per block).
+
+    Since round 6 this is the accept path of BOTH verdict-kernel
+    variants ("group" assembles ok_all from the lane-group flag passes;
+    "allrecv" from the all-receiver algebra) and of the monolithic
+    kernel — exact for any ``w`` (no dots, pure compare/min/max), so no
+    KI-3 precision concern."""
     iota_w = jax.lax.broadcasted_iota(jnp.int32, (n_p, n_rv, w), 2)
     onehot = v2_all[:, :, None] == iota_w  # [n_p, n_rv, w]
     # Minor-dim insertion on an i1 vector is not lowerable (Mosaic:

@@ -184,6 +184,7 @@ def _trial_party_sharded(
             rebuild_pool,
             resolve_rebuild_block,
             resolve_tiled_block,
+            resolve_verdict_variant,
         )
 
         interpret = jax.default_backend() != "tpu"
@@ -193,10 +194,15 @@ def _trial_party_sharded(
         # checker is off, where the declarations would be dead
         # machinery.
         out_vma = tiled_out_vma
+        # Resolve the accept-path variant explicitly so the kernel built
+        # here matches the one the block plan probed (the party-sharded
+        # engine stays in the group family; on TPU the probe may demote
+        # to "group-serial").
+        variant = resolve_verdict_variant(cfg, n_recv=n_local)
         blk = resolve_tiled_block(cfg, n_recv=n_local)
         verdict = build_verdict_kernel(
             cfg, blk, interpret=interpret, n_recv=n_local,
-            out_vma=out_vma,
+            out_vma=out_vma, variant=variant,
         )
         blk_d = resolve_rebuild_block(cfg, n_recv=n_local)
         rebuild_k = (
@@ -321,11 +327,31 @@ def _spmd_batch(
             )
         )(local_keys)
 
-    shard = jax.shard_map(
+    shard = _shard_map(
         body, mesh=mesh, in_specs=key_spec, out_specs=key_spec,
         check_vma=check_vma,
     )
     return shard(keys)
+
+
+def _shard_map(body, *, mesh, in_specs, out_specs, check_vma):
+    """``jax.shard_map`` across jax versions: older builds expose it
+    only at ``jax.experimental.shard_map`` and name the replication
+    checker ``check_rep`` instead of ``check_vma``."""
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma,
+            )
+        except AttributeError:
+            pass  # deprecated stub that raises on access
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
 
 
 def _resolve_check_vma(engine: str) -> bool:

@@ -41,6 +41,33 @@ from qba_tpu.qsim import generate_lists_for
 from qba_tpu.rounds.mailbox import Mailbox, empty_mailbox
 
 
+def _register_barrier_batching() -> bool:
+    """Some jax builds ship ``lax.optimization_barrier`` without a vmap
+    batching rule, which aborts every vmapped trial batch that reaches
+    the barrier below.  The rule is trivial (the barrier is per-element
+    identity: bind the batched operands, pass the batch dims through),
+    so register it when missing.  Returns False when the primitive's
+    internals are not reachable — the caller then skips the barrier
+    (a perf hint only; semantics are unaffected)."""
+    try:
+        from jax._src.lax import lax as _lax_internal
+        from jax.interpreters import batching
+
+        prim = _lax_internal.optimization_barrier_p
+        if prim not in batching.primitive_batchers:
+
+            def _rule(args, dims, **params):
+                return prim.bind(*args, **params), dims
+
+            batching.primitive_batchers[prim] = _rule
+        return True
+    except Exception:
+        return False
+
+
+_HAVE_BARRIER_BATCHING = _register_barrier_batching()
+
+
 @dataclasses.dataclass(frozen=True)
 class PartitionHints:
     """Optional internal sharding constraints for :func:`run_trial`.
@@ -363,7 +390,8 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     # XLA fuses the [max_l, size_l] reductions above into every consumer,
     # recomputing them per use (three ~70 ms loop fusions at the headline
     # config).
-    v_all, ok_all = jax.lax.optimization_barrier((v_all, ok_all))
+    if _HAVE_BARRIER_BATCHING:
+        v_all, ok_all = jax.lax.optimization_barrier((v_all, ok_all))
 
     # Acceptance with first-occurrence-wins dedup against Vi (tfg.py:294):
     # for each order value, only the first candidate packet carrying it
@@ -371,7 +399,13 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     # matrix, and no advanced indexing: the previous `vi_row[v_all]` /
     # `first_idx[v_all]` per-element gathers lowered to serialized TPU
     # gather loops that dominated the whole engine at scale (2 x ~2.2 s
-    # of a 7.9 s 33-party batch; docs/PERF.md round 3).
+    # of a 7.9 s 33-party batch; docs/PERF.md round 3).  This one-hot
+    # formulation is also the differential oracle for the kernels'
+    # round-6 parallel first-accept reduction
+    # (ops/verdict_algebra.py accept_first_per_value_all): the engine
+    # equivalence suites pin the batched all-receiver dedup against this
+    # per-receiver sequential walk bit for bit, so KEEP this code
+    # independent of the kernels' shared helpers.
     onehot_v = v_all[:, None] == jnp.arange(cfg.w)[None, :]  # [n_pk, w]
     cand = ok_all & ~jnp.any(onehot_v & vi_row[None, :], axis=1)
     cand_idx = jnp.where(cand, idxs, n_pk)

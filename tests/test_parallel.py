@@ -404,8 +404,12 @@ from qba_tpu.parallel import make_mesh
 devs = jax.devices()
 assert len(devs) == 4, devs
 mesh = make_mesh({"dp": 4}, devices=devs)
+try:  # older jax: only jax.experimental.shard_map (jax.shard_map raises)
+    shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map
 out = jax.jit(
-    jax.shard_map(
+    shard_map(
         lambda x: jax.lax.psum(x, "dp"),
         mesh=mesh, in_specs=P("dp"), out_specs=P(),
     )
@@ -456,7 +460,11 @@ def test_two_process_distributed_cpu_smoke(tmp_path):
         pytest.skip("distributed CPU smoke timed out (environment)")
     for rc, out in outs:
         if rc != 0 and "DIST_SMOKE_RESULT" not in out:
-            if "Connection refused" in out or "UNAVAILABLE" in out:
+            if (
+                "Connection refused" in out
+                or "UNAVAILABLE" in out
+                or "aren't implemented on the CPU backend" in out
+            ):
                 pytest.skip(f"distributed service unavailable: {out[-200:]}")
             pytest.fail(f"distributed smoke rc={rc}:\n{out[-2000:]}")
         assert f"DIST_SMOKE_RESULT {outs.index((rc, out))} 6.0" in out, out

@@ -94,9 +94,10 @@ class TestTiledEquivalence:
 
     def test_group_variant_bit_identical(self, monkeypatch):
         # Off-TPU the resolver picks the all-receiver variant whenever
-        # the exactness gate holds — pin the lane-group variant against
-        # the XLA engine too (it remains the TPU fallback and the
-        # party-sharded engine's only variant).
+        # the exactness gate holds — pin the group variant (lane-group
+        # flag algebra + the round-6 parallel first-accept reduction)
+        # against the XLA engine too (it is the TPU fallback and the
+        # party-sharded engine's only family).
         import qba_tpu.ops.round_kernel_tiled as rkt
 
         monkeypatch.setattr(
@@ -107,6 +108,49 @@ class TestTiledEquivalence:
         assert_equal(*both(cfg, 1, 8, 8))
         cfg_w = QBAConfig(n_parties=33, size_l=8, n_dishonest=2)
         assert_equal(*both(cfg_w, 8, 2, 64))
+
+    def test_parallel_accept_outside_group_window(self, monkeypatch):
+        # The round-6 parallel accept must cover the configs the
+        # round-4 group-batched dedup excluded: grp == 1 (sizeL >= 128
+        # — one receiver already fills a lane tile) and grp * w > 512
+        # (the 33p/sizeL=8 shape: grp=16, w=64 -> 1024 lanes).  Both
+        # previously fell to the serial per-receiver chain; force the
+        # group variant and pin bit-identity to the XLA engine.
+        import qba_tpu.ops.round_kernel_tiled as rkt
+        from qba_tpu.ops.round_kernel import _lane_group
+
+        monkeypatch.setattr(
+            rkt, "resolve_verdict_variant",
+            lambda cfg, n_recv=None: "group",
+        )
+        cfg_grp1 = QBAConfig(n_parties=4, size_l=128, n_dishonest=1)
+        assert _lane_group(cfg_grp1.size_l, cfg_grp1.n_lieutenants) == 1
+        assert_equal(*both(cfg_grp1, 5, 4, 8))
+        cfg_wide = QBAConfig(n_parties=33, size_l=8, n_dishonest=2)
+        grp = _lane_group(cfg_wide.size_l, cfg_wide.n_lieutenants)
+        assert grp * cfg_wide.w > 512
+        assert_equal(*both(cfg_wide, 8, 2, 64))
+
+    def test_serial_accept_variant_bit_identical(self, monkeypatch):
+        # "group-serial" (the pre-round-6 accept chain) stays reachable
+        # as the TPU compile-demotion fallback — pin it against the XLA
+        # engine at the same configs the parallel path covers, so the
+        # three accept formulations are mutually bit-identical.
+        import qba_tpu.ops.round_kernel_tiled as rkt
+
+        monkeypatch.setattr(
+            rkt, "resolve_verdict_variant",
+            lambda cfg, n_recv=None: "group-serial",
+        )
+        assert_equal(
+            *both(QBAConfig(n_parties=5, size_l=16, n_dishonest=2), 1, 8, 8)
+        )
+        assert_equal(
+            *both(QBAConfig(n_parties=4, size_l=128, n_dishonest=1), 5, 4, 8)
+        )
+        assert_equal(
+            *both(QBAConfig(n_parties=33, size_l=8, n_dishonest=2), 8, 2, 64)
+        )
 
     def test_variant_static_gate(self):
         from qba_tpu.ops.verdict_algebra import all_receiver_supported
@@ -467,13 +511,16 @@ class TestMatmulPrecisionExactness:
             assert (_np.asarray(a_) == _np.asarray(b_)).all()
         assert bool(ovf_k) == bool(ovf_x)
 
+    @pytest.mark.slow
     def test_north_star_batch_bit_identical(self):
         # The end-to-end repro that exposed the bug: the exact 16
         # vmapped trials (backend key tree, seed 5) at the 33-party
         # north-star shape, tiled vs XLA engine.  Trials 9/11/12
         # diverged before the fix (and which trials diverged depended
-        # on the batch composition).  Slow (~minutes on CPU) but guards
-        # the flagship engine's headline configuration.
+        # on the batch composition).  Marked slow (~minutes on CPU —
+        # the tier-1 run filters `-m 'not slow'`; run explicitly via
+        # `pytest -m slow` or without the filter) but guards the
+        # flagship engine's headline configuration.
         import dataclasses as _dc
 
         import numpy as _np

@@ -218,3 +218,77 @@ def test_accept_first_per_value_group_matches_serial():
             np.testing.assert_array_equal(
                 np.asarray(new_rows[j]) != 0, np.asarray(want_vi) != 0
             )
+
+
+def test_accept_first_per_value_all_matches_serial():
+    # The round-6 parallel reduction (now the accept path of every
+    # kernel variant) must equal n_rv independent serial applications
+    # of accept_first_per_value — including the shapes the round-4
+    # group-batched pass excluded: a single receiver (grp == 1 configs)
+    # and wide n_rv * w products.
+    from qba_tpu.ops.verdict_algebra import accept_first_per_value_all
+
+    rng = np.random.default_rng(13)
+    for n_p, n_rv, w in ((24, 4, 8), (16, 1, 4), (8, 32, 64)):
+        for case in range(12):
+            ok = rng.random((n_p, n_rv)) < (0.0, 0.5, 1.0)[case % 3]
+            v2 = rng.integers(0, w, size=(n_p, n_rv)).astype(np.int32)
+            vi0 = (rng.random((n_rv, w)) < 0.3).astype(np.int32)
+            acc, new_vi = accept_first_per_value_all(
+                jnp.asarray(ok), jnp.asarray(v2), jnp.asarray(vi0),
+                jnp.arange(n_p)[:, None], n_p, n_rv, w,
+            )
+            for r in range(n_rv):
+                want_acc, want_vi = accept_first_per_value(
+                    jnp.asarray(ok[:, r : r + 1]),
+                    jnp.asarray(v2[:, r : r + 1]),
+                    jnp.asarray(vi0[r : r + 1, :]),
+                    jnp.arange(n_p)[:, None], n_p, w,
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(acc[:, r]) != 0,
+                    np.asarray(want_acc[:, 0]),
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(new_vi[r : r + 1]) != 0,
+                    np.asarray(want_vi) != 0,
+                )
+
+
+def test_accept_cross_block_carry_dependency():
+    # Minimal repro of the cross-block dependency (docs/PERF.md round
+    # 6): a value accepted in an earlier packet block must suppress
+    # later blocks' candidates, so the per-block vi carry cannot be
+    # DROPPED — but it can be REASSOCIATED: per-block first-index +
+    # the vi or-merge is an associative combine, and chaining it block
+    # to block (what the kernels' revisited output block does, on a
+    # grid that executes sequentially anyway) recomposes the one-pass
+    # answer exactly.
+    from qba_tpu.ops.verdict_algebra import accept_first_per_value_all
+
+    n_p, n_rv, w = 4, 1, 4
+    ok = jnp.ones((n_p, n_rv), bool)
+    v2 = jnp.zeros((n_p, n_rv), jnp.int32)  # every packet carries value 0
+    vi0 = jnp.zeros((n_rv, w), jnp.int32)
+    idx2 = jnp.arange(2)[:, None]
+    # One pass over the whole pool: only packet 0 is accepted.
+    acc_full, vi_full = accept_first_per_value_all(
+        ok, v2, vi0, jnp.arange(n_p)[:, None], n_p, n_rv, w,
+    )
+    assert np.asarray(acc_full)[:, 0].tolist() == [1, 0, 0, 0]
+    # Blocked WITHOUT the carry (each block against the initial vi):
+    # block 1 also accepts its first packet — over-acceptance.
+    acc_b0, vi_b0 = accept_first_per_value_all(
+        ok[:2], v2[:2], vi0, idx2, 2, n_rv, w,
+    )
+    acc_b1_nocarry, _ = accept_first_per_value_all(
+        ok[2:], v2[2:], vi0, idx2, 2, n_rv, w,
+    )
+    assert np.asarray(acc_b1_nocarry)[:, 0].tolist() == [1, 0]  # wrong
+    # With the carry, the blocked result recomposes the one-pass answer.
+    acc_b1, vi_b1 = accept_first_per_value_all(
+        ok[2:], v2[2:], vi_b0, idx2, 2, n_rv, w,
+    )
+    assert np.asarray(acc_b0)[:, 0].tolist() == [1, 0]
+    assert np.asarray(acc_b1)[:, 0].tolist() == [0, 0]
+    np.testing.assert_array_equal(np.asarray(vi_b1), np.asarray(vi_full))
