@@ -194,7 +194,7 @@ def main() -> None:
 
         ns_cfg = QBAConfig(**NORTHSTAR, seed=0)
         try:
-            from qba_tpu.benchmark import engine_description
+            from qba_tpu.benchmark import engine_description, kernel_plan
 
             ns_times, ns_run = _measure_jax(
                 ns_cfg, reps=4, chunk_trials=NORTHSTAR_CHUNK
@@ -202,10 +202,15 @@ def main() -> None:
             northstar = dict(
                 _rps_stats(ns_cfg, ns_times, ns_run),
                 metric="northstar_rounds_per_sec_n33_l64_d10_t1000",
-                # engine/variant attribution (e.g. "pallas_tiled/group")
-                # — the accept-path variant is a per-machine compile
-                # probe, so the artifact must say which path it timed.
+                # engine/variant/packing attribution (e.g.
+                # "pallas_fused/group/pack4") — the accept-path variant,
+                # the fusion demotion, and the packing factor are all
+                # per-machine compile probes, so the artifact must say
+                # which path it timed; kernel_plan decomposes it
+                # per-kernel (verdict/rebuild/fused block sizes +
+                # launches per round).
                 engine=engine_description(ns_cfg),
+                kernel_plan=kernel_plan(ns_cfg),
                 chunk_trials=NORTHSTAR_CHUNK,
             )
             try:
@@ -235,11 +240,24 @@ def main() -> None:
     headline = (
         device["device_rounds_per_sec"] if device else stats["median_value"]
     )
+    # Headline-config attribution mirrors the north-star row's: the
+    # engine string names the path (fusion + packing are per-machine
+    # compile probes), kernel_plan decomposes it per kernel.
+    from qba_tpu.benchmark import engine_description, kernel_plan
+
+    try:
+        headline_engine = engine_description(cfg)
+        headline_plan = kernel_plan(cfg)
+    except Exception as e:  # attribution must never sink the metric
+        print(f"engine attribution failed: {e!r}", file=sys.stderr)
+        headline_engine, headline_plan = None, None
     out = {
         "metric": f"protocol_rounds_per_sec_n11_l64_t{cfg.trials}",
         "value": headline,
         "unit": "rounds/s",
         "headline_source": "device_median" if device else "wall_median",
+        "engine": headline_engine,
+        "kernel_plan": headline_plan,
         # Two LABELED baseline ratios (VERDICT r5 weak point 2 — the
         # old single `vs_baseline` divided device-only seconds by the
         # baseline's CPU wall time, an apples-to-oranges headline):

@@ -64,10 +64,33 @@ def _run_trials_jit(cfg: QBAConfig, keys: jax.Array) -> MonteCarloResult:
     return aggregate(batched_trials(cfg, keys))
 
 
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _run_trials_packed_jit(
+    cfg: QBAConfig, keys: jax.Array, pack: int
+) -> MonteCarloResult:
+    from qba_tpu.rounds.engine import run_trials_fused_packed
+
+    return aggregate(run_trials_fused_packed(cfg, keys, pack))
+
+
 def run_trials(cfg: QBAConfig, keys: jax.Array | None = None) -> MonteCarloResult:
-    """Run ``cfg.trials`` independent protocol executions, batched."""
+    """Run ``cfg.trials`` independent protocol executions, batched.
+
+    On the fused round engine with a resolved trial-pack factor
+    ``k > 1`` that divides the batch, dispatch goes through the packed
+    runner (:func:`qba_tpu.rounds.engine.run_trials_fused_packed` —
+    ``k`` trials per kernel grid); results are bit-identical to the
+    plain vmap path trial for trial."""
     if keys is None:
         keys = trial_keys(cfg)
+    from qba_tpu.rounds.engine import resolve_round_engine
+
+    if resolve_round_engine(cfg) == "pallas_fused":
+        from qba_tpu.ops.round_kernel_tiled import resolve_trial_pack
+
+        pack = resolve_trial_pack(cfg)
+        if pack > 1 and keys.shape[0] % pack == 0:
+            return _run_trials_packed_jit(cfg, keys, pack)
     return _run_trials_jit(cfg, keys)
 
 

@@ -28,20 +28,87 @@ NORTHSTAR = dict(n_parties=33, size_l=64, n_dishonest=10, trials=1000)
 NORTHSTAR_CHUNK = 1000
 
 
-def engine_description(cfg: QBAConfig) -> str:
-    """Engine attribution string for benchmark artifacts: the resolved
-    round engine, plus the verdict-kernel variant when the tiled engine
-    runs (e.g. ``"pallas_tiled/group"``) — so a ``BENCH_r*.json`` row
-    can be tied to the kernel path that produced it (the round-6
-    accept-path split makes "pallas_tiled" alone ambiguous across
-    machines: the variant is a per-machine compile probe)."""
+def kernel_plan(cfg: QBAConfig) -> dict:
+    """Resolved per-kernel execution plan for benchmark attribution.
+
+    One dict per config, embedded in the ``BENCH_r*.json`` rows so a
+    measurement can be tied to the exact kernel path that produced it:
+
+    - ``engine``: the resolved round engine.
+    - ``variant``: verdict accept-path variant (tiled/fused engines).
+    - ``verdict_block`` / ``rebuild_block``: packet-block sizes of the
+      two-kernel tiled path (None where not applicable).
+    - ``fused_block``: the fused kernel's output block size (None when
+      the fused path is unavailable/demoted).
+    - ``trial_pack``: trials folded per fused kernel grid (1 = no
+      packing).
+    - ``launches_per_round``: pallas_call launches each round costs —
+      1 on the fused path, 2 on the tiled pair, 1 monolithic, 0 XLA.
+
+    Every field is a cached compile-probe verdict (or a static plan
+    off-TPU), so calling this after a measurement re-reads the memoized
+    resolution the run actually used."""
     from qba_tpu.rounds.engine import resolve_round_engine
 
     engine = resolve_round_engine(cfg)
-    if engine == "pallas_tiled":
-        from qba_tpu.ops.round_kernel_tiled import resolve_verdict_variant
+    plan = {
+        "engine": engine,
+        "variant": None,
+        "verdict_block": None,
+        "rebuild_block": None,
+        "fused_block": None,
+        "trial_pack": 1,
+        "launches_per_round": {"xla": 0, "pallas": 1}.get(engine, 2),
+    }
+    if engine in ("pallas_tiled", "pallas_fused"):
+        from qba_tpu.ops.round_kernel_tiled import (
+            resolve_rebuild_block,
+            resolve_tiled_block,
+            resolve_verdict_variant,
+        )
 
-        return f"{engine}/{resolve_verdict_variant(cfg)}"
+        plan["variant"] = resolve_verdict_variant(cfg)
+        plan["verdict_block"] = resolve_tiled_block(cfg)
+        plan["rebuild_block"] = resolve_rebuild_block(cfg)
+    if engine == "pallas_fused":
+        from qba_tpu.ops.round_kernel_tiled import (
+            resolve_fused_block,
+            resolve_trial_pack,
+        )
+
+        pack = resolve_trial_pack(cfg)
+        plan["fused_block"] = resolve_fused_block(cfg, trial_pack=pack)
+        if plan["fused_block"] is None and pack != 1:
+            # The packed plan failed to compile; the runner falls back
+            # to the unpacked fused kernel (or tiled).  Attribute what
+            # actually runs.
+            pack = 1
+            plan["fused_block"] = resolve_fused_block(cfg)
+        plan["trial_pack"] = pack
+        plan["launches_per_round"] = (
+            1 if plan["fused_block"] is not None else 2
+        )
+    return plan
+
+
+def engine_description(cfg: QBAConfig) -> str:
+    """Engine attribution string for benchmark artifacts: the resolved
+    round engine, plus the verdict-kernel variant when a tiled-family
+    engine runs, plus the trial-packing factor on the fused path (e.g.
+    ``"pallas_tiled/group"``, ``"pallas_fused/group/pack4"``) — so a
+    ``BENCH_r*.json`` row can be tied to the kernel path that produced
+    it (the round-6 accept-path split and the round-7 fusion/packing
+    split make the engine name alone ambiguous across machines: both
+    are per-machine compile probes)."""
+    plan = kernel_plan(cfg)
+    engine = plan["engine"]
+    if engine == "pallas_fused":
+        desc = f"{engine}/{plan['variant']}"
+        if plan["fused_block"] is None:
+            return desc + "/demoted-to-tiled"
+        return desc + f"/pack{plan['trial_pack']}"
+    if engine == "pallas_tiled":
+        return f"{engine}/{plan['variant']}"
     return engine
 
 

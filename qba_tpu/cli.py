@@ -53,11 +53,19 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
     )
     p.add_argument(
         "--round-engine",
-        choices=("auto", "xla", "pallas", "pallas_tiled"), default="auto",
+        choices=("auto", "xla", "pallas", "pallas_tiled", "pallas_fused"),
+        default="auto",
         help="voting-round engine: auto = the fastest engine that "
-        "compiles for this config (packet-tiled kernel first, fused "
-        "monolithic kernel second, pure XLA as the final fallback); "
+        "compiles for this config (fused single-launch round kernel "
+        "first where it compiles, the packet-tiled kernel pair next, "
+        "monolithic kernel, pure XLA as the final fallback); "
         "all engines are bit-identical",
+    )
+    p.add_argument(
+        "--trial-pack", type=int, default=None,
+        help="fused engine only: fold this many trials into one kernel "
+        "grid (must divide --trials to take effect); default = "
+        "probe-chosen on TPU, 1 off-TPU",
     )
     p.add_argument(
         "--delivery", choices=("sync", "racy"), default="sync",
@@ -89,6 +97,7 @@ def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
         seed=args.seed,
         qsim_path=args.qsim_path,
         round_engine=args.round_engine,
+        trial_pack=args.trial_pack,
         delivery=args.delivery,
         p_late=args.p_late,
         racy_mode=args.racy_mode,

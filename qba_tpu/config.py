@@ -56,13 +56,22 @@ class QBAConfig:
         second, pure XLA as the final fallback — see
         :func:`qba_tpu.rounds.engine.resolve_round_engine`), "xla",
         "pallas" (forces the monolithic kernel; interpreter mode
-        off-TPU), or "pallas_tiled" (forces the tiled engine —
+        off-TPU), "pallas_tiled" (forces the tiled engine —
         lossless at scales the monolithic kernel cannot compile,
-        :mod:`qba_tpu.ops.round_kernel_tiled`).  All engines are
+        :mod:`qba_tpu.ops.round_kernel_tiled`), or "pallas_fused"
+        (forces the fused single-launch round kernel — verdict +
+        rebuild in one ``pallas_call`` per round, optionally
+        trial-packed; demotes to the two-kernel tiled path with a
+        warning where it doesn't compile).  All engines are
         bit-identical (tests/test_round_kernel.py,
-        tests/test_round_kernel_tiled.py).
+        tests/test_round_kernel_tiled.py,
+        tests/test_round_kernel_fused.py).
       tiled_block: explicit packet-block size for the tiled engine
         (must divide ``n_lieutenants * slots``); None = probe-chosen.
+      trial_pack: explicit trial-pack factor ``k`` for the fused round
+        kernel (``k`` trials folded into one kernel grid — must be
+        >= 1 and divide ``trials`` to take effect); None =
+        probe-chosen on TPU, 1 off-TPU.
       max_evidence_rows: static bound on |L| (``max_l``); None = the
         derived ``n_dishonest + 2``.  Validated ``>= n_rounds + 1`` —
         the batched engines compute the own-row consistency terms under
@@ -114,6 +123,7 @@ class QBAConfig:
     attack_scope: str = "delivery"
     racy_mode: str = "loss"
     tiled_block: int | None = None
+    trial_pack: int | None = None
     max_evidence_rows: int | None = None
 
     def __post_init__(self) -> None:
@@ -146,7 +156,9 @@ class QBAConfig:
             raise ValueError("p_late must be in [0, 1]")
         if self.p_late > 0.0 and self.delivery != "racy":
             raise ValueError("p_late > 0 requires delivery='racy'")
-        if self.round_engine not in ("auto", "xla", "pallas", "pallas_tiled"):
+        if self.round_engine not in (
+            "auto", "xla", "pallas", "pallas_tiled", "pallas_fused"
+        ):
             raise ValueError(f"unknown round_engine {self.round_engine!r}")
         if self.tiled_block is not None:
             n_pool = self.n_lieutenants * self.slots
@@ -155,6 +167,10 @@ class QBAConfig:
                     f"tiled_block={self.tiled_block} must divide "
                     f"n_lieutenants * slots = {n_pool}"
                 )
+        if self.trial_pack is not None and self.trial_pack < 1:
+            raise ValueError(
+                f"trial_pack={self.trial_pack} must be >= 1"
+            )
         if self.max_evidence_rows is not None and (
             self.max_evidence_rows < self.n_rounds + 1
         ):

@@ -112,11 +112,12 @@ def _trial_party_sharded(
 
     # Step 3b (tfg.py:337-348): each round's traffic = one all_gather of
     # the local mailbox rows over tp (replaces the reference's Isend
-    # storm + Iprobe drain + Barrier).  Three bit-identical engines,
+    # storm + Iprobe drain + Barrier).  Four bit-identical engines,
     # like the single-device path: vectorized XLA, the fused monolithic
-    # Pallas round kernel, or the packet-tiled kernel pair — each in a
-    # party-sharded variant where the device's kernels drain only its
-    # receiver block against the gathered global mailbox/pool.
+    # Pallas round kernel, the packet-tiled kernel pair, or the fused
+    # single-launch round kernel — each in a party-sharded variant
+    # where the device's kernels drain only its receiver block against
+    # the gathered global mailbox/pool.
     if engine == "pallas":
         from qba_tpu.ops.round_kernel import (
             build_round_step,
@@ -159,6 +160,75 @@ def _trial_party_sharded(
             return (out[6], tuple(out[:6])), out[7][0, 0] > 0
 
         init = (vi_l.astype(jnp.int32), pack_local(mb_local))
+        (vi_i32, _), overflows = jax.lax.scan(
+            round_body, init, jnp.arange(1, cfg.n_rounds + 1)
+        )
+        vi_l = vi_i32 != 0
+    elif engine == "pallas_fused":
+        # The fused single-launch engine's party-sharded variant: same
+        # local-pool / all_gather dance as the tiled branch below, but
+        # verdict + rebuild run in ONE pallas_call per round (the
+        # device's kernel drains its receiver block against the
+        # gathered global pool and writes the rebuilt local pool
+        # directly).  Trial packing stays single-device — under
+        # shard_map the trial axis is dp-sharded outside this body.
+        from qba_tpu.ops.round_kernel_tiled import (
+            build_fused_round_kernel,
+            honest_cells as honest_cells_fn,
+            pool_from_step3a,
+            resolve_fused_block,
+            resolve_tiled_block,
+            resolve_verdict_variant,
+        )
+
+        interpret = jax.default_backend() != "tpu"
+        variant = resolve_verdict_variant(cfg, n_recv=n_local)
+        blk_v = resolve_tiled_block(cfg, n_recv=n_local)
+        blk_d = resolve_fused_block(cfg, n_recv=n_local)
+        if blk_d is None:
+            # Same demotion discipline as the single-device engine
+            # (run_rounds_fused): the two-kernel tiled path is the
+            # probe-demotion target.
+            warnings.warn(
+                f"party-sharded fused round kernel unavailable at "
+                f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
+                f"slots={cfg.slots}, n_local={n_local}); demoting to "
+                "the two-kernel tiled path",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return _trial_party_sharded(
+                cfg, n_tp, key, "pallas_tiled", vma_axes, tiled_out_vma
+            )
+        fused = build_fused_round_kernel(
+            cfg, blk_d, blk_v, interpret=interpret, n_recv=n_local,
+            out_vma=tiled_out_vma, variant=variant,
+        )
+        pool_l = pool_from_step3a(
+            cfg, out_cells, start=start, n_recv=n_local
+        )
+        honest_cells = honest_cells_fn(honest, cfg)
+
+        def round_body(carry, round_idx):
+            vi_i32, pool_l = carry
+            pool_g = tuple(
+                gather_tp(x, axis=1 if i == 0 else 0)
+                for i, x in enumerate(pool_l)
+            )
+            k_round = jax.random.fold_in(k_rounds, round_idx)
+            draws = sample_attacks_round(cfg, k_round)
+            att_c, rv_c, late_c = (
+                jax.lax.dynamic_slice_in_dim(d, start, n_local, 1)
+                .astype(jnp.int32)
+                for d in draws
+            )
+            pool_new, vi_i32, ovf = fused(
+                round_idx, start, *pool_g, my_li, my_li, vi_i32,
+                honest_cells, att_c, rv_c, late_c,
+            )
+            return (vi_i32, pool_new), ovf
+
+        init = (vi_l.astype(jnp.int32), pool_l)
         (vi_i32, _), overflows = jax.lax.scan(
             round_body, init, jnp.arange(1, cfg.n_rounds + 1)
         )
@@ -366,7 +436,7 @@ def _resolve_check_vma(engine: str) -> bool:
     literal indices lack the operand's vma, which the checker rejects.
     The tiled engine additionally honors the ``QBA_TILED_CHECK_VMA``
     escape hatch (:func:`_tiled_check_vma`)."""
-    if engine == "pallas_tiled":
+    if engine in ("pallas_tiled", "pallas_fused"):
         return _tiled_check_vma()
     return not (engine == "pallas" and jax.default_backend() != "tpu")
 
@@ -427,14 +497,19 @@ def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:
     (packet-tiled first everywhere since round 4, monolithic second,
     XLA last), probing the LOCAL-receiver kernel variants.
     """
-    if cfg.round_engine in ("pallas", "pallas_tiled"):
+    if cfg.round_engine in ("pallas", "pallas_tiled", "pallas_fused"):
         return cfg.round_engine
     if cfg.round_engine != "auto" or jax.default_backend() != "tpu":
         return "xla"
     from qba_tpu.ops.round_kernel import kernel_compiles
-    from qba_tpu.ops.round_kernel_tiled import tiled_kernel_plan
+    from qba_tpu.ops.round_kernel_tiled import (
+        fused_kernel_plan,
+        tiled_kernel_plan,
+    )
 
     if tiled_kernel_plan(cfg, n_recv=n_local) is not None:
+        if fused_kernel_plan(cfg, n_recv=n_local) is not None:
+            return "pallas_fused"
         return "pallas_tiled"
     if kernel_compiles(cfg, n_recv=n_local):
         return "pallas"

@@ -628,6 +628,177 @@ def run_rounds_tiled(
     return vi_i32 != 0, jnp.any(overflows)
 
 
+def run_rounds_fused(
+    cfg: QBAConfig, vi, out_cells, lieu_lists, honest, k_rounds,
+    *, interpret: bool,
+):
+    """Step 3b on the FUSED round engine
+    (:func:`qba_tpu.ops.round_kernel_tiled.build_fused_round_kernel`):
+    verdict + rebuild in ONE ``pallas_call`` per round — no
+    intermediate ``acc``/``vi`` HBM materialization, half the launches
+    of :func:`run_rounds_tiled`.  Bit-identical to the two-kernel path
+    and the XLA oracle (tests/test_round_kernel_fused.py); demotes to
+    :func:`run_rounds_tiled` with a warning where the fused kernel
+    doesn't compile."""
+    import warnings
+
+    from qba_tpu.ops.round_kernel_tiled import (
+        build_fused_round_kernel,
+        honest_cells as honest_cells_fn,
+        make_verdict_tables,
+        pool_from_step3a,
+        resolve_fused_block,
+        resolve_tiled_block,
+        resolve_verdict_variant,
+    )
+
+    variant = resolve_verdict_variant(cfg)
+    blk_v = resolve_tiled_block(cfg)
+    blk_d = resolve_fused_block(cfg)
+    if blk_d is None:
+        warnings.warn(
+            "fused round kernel unavailable at (n_parties="
+            f"{cfg.n_parties}, size_l={cfg.size_l}, slots={cfg.slots});"
+            " demoting to the two-kernel tiled path",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return run_rounds_tiled(
+            cfg, vi, out_cells, lieu_lists, honest, k_rounds,
+            interpret=interpret,
+        )
+    fused = build_fused_round_kernel(
+        cfg, blk_d, blk_v, interpret=interpret, variant=variant
+    )
+    pool = pool_from_step3a(cfg, out_cells)
+    honest_cells = honest_cells_fn(honest, cfg)
+    li_arg = (
+        make_verdict_tables(cfg, lieu_lists)
+        if variant == "allrecv"
+        else lieu_lists
+    )
+
+    def round_body(carry, round_idx):
+        vi_i32, pool = carry
+        k_round = jax.random.fold_in(k_rounds, round_idx)
+        attack, rand_v, late = sample_attacks_round(cfg, k_round)
+        pool_new, vi_i32, ovf = fused(
+            round_idx, *pool, lieu_lists, li_arg, vi_i32,
+            honest_cells, attack.astype(jnp.int32),
+            rand_v.astype(jnp.int32), late.astype(jnp.int32),
+        )
+        return (vi_i32, tuple(pool_new)), ovf
+
+    init = (vi.astype(jnp.int32), pool)
+    (vi_i32, _), overflows = jax.lax.scan(
+        round_body, init, jnp.arange(1, cfg.n_rounds + 1)
+    )
+    return vi_i32 != 0, jnp.any(overflows)
+
+
+def run_trials_fused_packed(cfg: QBAConfig, keys, pack: int):
+    """Batched fused-engine runner with TRIAL PACKING: ``pack`` trials
+    fold into one kernel grid (a leading ``k`` axis on every
+    trial-varying operand), so the per-grid-step fixed overhead that
+    dominates small configs amortizes ``pack``-fold (docs/PERF.md
+    round 7).  The batch vmaps over ``trials // pack`` GROUPS whose
+    round scan calls the packed fused kernel once per round.
+
+    Trials stay independent — setup, attack draws, and the finish pass
+    are per-trial (the kernel touches only slice ``t`` of every
+    trial-varying ref) — so results are bit-identical to the unpacked
+    path trial for trial (tests/test_round_kernel_fused.py).
+
+    Requires ``pack`` to divide the batch; the caller
+    (:func:`qba_tpu.backends.jax_backend.run_trials`) falls back to the
+    plain vmap path otherwise.  Returns the per-trial
+    :class:`TrialResult` batch (leading axis = trials)."""
+    from qba_tpu.ops.round_kernel_tiled import (
+        build_fused_round_kernel,
+        honest_cells as honest_cells_fn,
+        make_verdict_tables,
+        pool_from_step3a,
+        resolve_fused_block,
+        resolve_tiled_block,
+        resolve_verdict_variant,
+    )
+
+    interpret = jax.default_backend() != "tpu"
+    variant = resolve_verdict_variant(cfg)
+    blk_v = resolve_tiled_block(cfg)
+    blk_d = resolve_fused_block(cfg, trial_pack=pack)
+    if blk_d is None or pack < 2:
+        # No packed plan — the plain per-trial vmap path handles it.
+        return jax.vmap(lambda k: run_trial(cfg, k))(keys)
+    fused = build_fused_round_kernel(
+        cfg, blk_d, blk_v, interpret=interpret, variant=variant,
+        trial_pack=pack,
+    )
+    n_groups = keys.shape[0] // pack
+
+    def setup_one(key):
+        honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = (
+            setup_trial(cfg, key, None)
+        )
+        vi, out_cells = jax.vmap(
+            lambda p, v, li: step3a_one(cfg, p, v, li)
+        )(p_rows, v_sent, lieu_lists)
+        pool = pool_from_step3a(cfg, out_cells)
+        li_arg = (
+            make_verdict_tables(cfg, lieu_lists)
+            if variant == "allrecv"
+            else lieu_lists
+        )
+        return (
+            honest, lieu_lists, li_arg, v_comm, k_rounds,
+            vi.astype(jnp.int32), pool,
+            honest_cells_fn(honest, cfg),
+        )
+
+    (honest_t, li_t, li_arg_t, v_comm_t, k_rounds_t, vi_t, pool_t,
+     hc_t) = jax.vmap(setup_one)(keys)
+
+    def group(x):  # [trials, ...] -> [n_groups, pack, ...]
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape((n_groups, pack) + a.shape[1:]), x
+        )
+
+    def run_group(li_k, li_arg_k, k_rounds_k, vi_k, pool_k, hc_k):
+        vals, lens, p, meta = pool_k
+        # The kernel's packed vals layout is [max_l, k, cap, s].
+        vals = jnp.moveaxis(vals, 0, 1)
+
+        def round_body(carry, round_idx):
+            vi_k, pool = carry
+            att, rv, late = jax.vmap(
+                lambda kr: sample_attacks_round(
+                    cfg, jax.random.fold_in(kr, round_idx)
+                )
+            )(k_rounds_k)
+            pool_new, vi_k, ovf = fused(
+                round_idx, *pool, li_k, li_arg_k, vi_k, hc_k,
+                att.astype(jnp.int32), rv.astype(jnp.int32),
+                late.astype(jnp.int32),
+            )
+            return (vi_k, tuple(pool_new)), ovf
+
+        init = (vi_k, (vals, lens, p, meta))
+        (vi_k, _), ovfs = jax.lax.scan(
+            round_body, init, jnp.arange(1, cfg.n_rounds + 1)
+        )
+        return vi_k != 0, jnp.any(ovfs, axis=0)  # [k, n_rv, w], [k]
+
+    vi_g, ovf_g = jax.vmap(run_group)(
+        group(li_t), group(li_arg_t), group(k_rounds_t),
+        group(vi_t), group(pool_t), group(hc_t),
+    )
+    vi_flat = vi_g.reshape((keys.shape[0],) + vi_g.shape[2:])
+    ovf_flat = ovf_g.reshape((keys.shape[0],))
+    return jax.vmap(
+        lambda vi, vc, h, o: finish_trial(cfg, vi, vc, h, o)
+    )(vi_flat, v_comm_t, honest_t, ovf_flat)
+
+
 def resolve_round_engine(cfg: QBAConfig) -> str:
     """``auto`` -> the fastest engine that compiles for this config.
 
@@ -651,9 +822,18 @@ def resolve_round_engine(cfg: QBAConfig) -> str:
     if jax.default_backend() != "tpu":
         return "xla"
     from qba_tpu.ops.round_kernel import kernel_compiles
-    from qba_tpu.ops.round_kernel_tiled import tiled_kernel_plan
+    from qba_tpu.ops.round_kernel_tiled import (
+        fused_kernel_plan,
+        tiled_kernel_plan,
+    )
 
     if tiled_kernel_plan(cfg) is not None:
+        # Prefer the fused single-launch kernel where it compiles
+        # (docs/PERF.md round 7: one launch per round, no acc/vi HBM
+        # round trip); the two-kernel tiled path is its demotion
+        # target and the bit-identity reference.
+        if fused_kernel_plan(cfg) is not None:
+            return "pallas_fused"
         return "pallas_tiled"
     if kernel_compiles(cfg):
         return "pallas"
@@ -683,6 +863,11 @@ def run_trial(
         )
     elif engine == "pallas_tiled":
         vi, overflow = run_rounds_tiled(
+            cfg, vi, out_cells, lieu_lists, honest, k_rounds,
+            interpret=jax.default_backend() != "tpu",
+        )
+    elif engine == "pallas_fused":
+        vi, overflow = run_rounds_fused(
             cfg, vi, out_cells, lieu_lists, honest, k_rounds,
             interpret=jax.default_backend() != "tpu",
         )

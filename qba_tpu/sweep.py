@@ -164,6 +164,18 @@ def run_sweep(
     if chunk_trials is None:
         chunk_trials = cfg.trials
 
+    # Opt-in persistent compilation cache: long sweeps re-enter the same
+    # per-chunk program across resumes/processes, so a disk-cached
+    # executable turns a tens-of-seconds recompile into a file read.
+    # Strictly env-gated here — run_sweep is a library entry point, and
+    # library code must not silently flip global JAX config (the CLI
+    # tool surfaces enable it unconditionally; see
+    # :mod:`qba_tpu.compile_cache`).
+    if os.environ.get("QBA_COMPILE_CACHE"):
+        from qba_tpu.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
+
     loaded = load_checkpoint(checkpoint, cfg, chunk_trials) if checkpoint else []
     # A checkpoint may hold more chunks than this invocation asks for;
     # aggregate only the requested range (the file keeps the full set).

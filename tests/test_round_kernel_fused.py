@@ -1,0 +1,284 @@
+"""Fused single-pass round engine vs the two-kernel tiled + XLA engines.
+
+The fused engine (:func:`qba_tpu.ops.round_kernel_tiled
+.build_fused_round_kernel`) runs verdict and rebuild in ONE
+``pallas_call`` per round — each grid step drains its pool block and
+writes the rebuilt successor pool directly, so the intermediate
+``acc``/``vi`` HBM round-trip and the second kernel launch disappear.
+It must stay bit-identical to both the two-kernel tiled path (the probe
+-demotion target) and the XLA oracle for the same trial keys, at every
+shape class the tiled suite pins: the headline 11p/64, the ``grp == 1``
+window (sizeL >= 128), the wide-group window (33p/sizeL=8, ``grp * w >
+512``), and the north-star 33p/64/10.  Trial packing (``k`` trials per
+kernel grid) is per-trial independent, so the packed runner is pinned
+trial-for-trial against the unpacked vmap.  Runs in interpreter mode on
+the CPU test mesh; the same kernel compiles for real on TPU (``auto``
+prefers it wherever both it and the tiled plan compile).
+"""
+
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.rounds import run_trial
+
+
+def triad(cfg, seed, n, blk):
+    """(xla, tiled, fused) trial batches for the same keys."""
+    keys = jax.random.split(jax.random.key(seed), n)
+    out = []
+    for engine in ("xla", "pallas_tiled", "pallas_fused"):
+        ecfg = dataclasses.replace(
+            cfg, round_engine=engine, tiled_block=blk
+        )
+        out.append(jax.jit(jax.vmap(lambda k: run_trial(ecfg, k)))(keys))
+    return out
+
+
+def assert_equal(a, b):
+    assert a.vi.tolist() == b.vi.tolist()
+    assert a.decisions.tolist() == b.decisions.tolist()
+    assert a.success.tolist() == b.success.tolist()
+    assert a.overflow.tolist() == b.overflow.tolist()
+
+
+class TestFusedEquivalence:
+    def test_headline_shape(self):
+        # 11p/64 — the headline benchmark config (BASELINE.json), small
+        # trial count for CI.  n_pool = 10 * 16 = 160.
+        cfg = QBAConfig(n_parties=11, size_l=64, n_dishonest=3)
+        xla, tiled, fused = triad(cfg, 0, 2, 32)
+        assert_equal(xla, fused)
+        assert_equal(tiled, fused)
+
+    def test_adversarial_multiblock(self):
+        # Multi-block verdict sweep + multi-step rebuild grid with
+        # Byzantine traffic; overflow and vi must match bit for bit.
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=2)
+        xla, tiled, fused = triad(cfg, 1, 8, 8)
+        assert_equal(xla, fused)
+        assert_equal(tiled, fused)
+        assert not bool(jnp.all(xla.honest))
+
+    def test_grp1_window(self):
+        # grp == 1 (sizeL >= 128): one receiver fills a lane tile, the
+        # window the round-4 group dedup excluded
+        # (test_parallel_accept_outside_group_window's first shape).
+        from qba_tpu.ops.round_kernel import _lane_group
+
+        cfg = QBAConfig(n_parties=4, size_l=128, n_dishonest=1)
+        assert _lane_group(cfg.size_l, cfg.n_lieutenants) == 1
+        xla, tiled, fused = triad(cfg, 5, 4, 8)
+        assert_equal(xla, fused)
+        assert_equal(tiled, fused)
+
+    def test_wide_group_window(self):
+        # grp * w > 512 (33p/sizeL=8: grp=16, w=64 -> 1024 lanes) — the
+        # other excluded window, plus two value-presence planes.
+        from qba_tpu.ops.round_kernel import _lane_group
+
+        cfg = QBAConfig(n_parties=33, size_l=8, n_dishonest=2)
+        grp = _lane_group(cfg.size_l, cfg.n_lieutenants)
+        assert grp * cfg.w > 512
+        xla, tiled, fused = triad(cfg, 8, 2, 64)
+        assert_equal(xla, fused)
+        assert_equal(tiled, fused)
+
+    def test_tight_slot_bound_overflow(self):
+        # slots=1: the fused kernel's in-pass overflow detection (the
+        # packet-major prefix sum) must reproduce the tiled/XLA flag.
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=2, max_accepts_per_round=1
+        )
+        xla, tiled, fused = triad(cfg, 3, 16, 4)
+        assert_equal(xla, fused)
+        assert_equal(tiled, fused)
+
+    @pytest.mark.slow
+    def test_north_star_bit_identical(self):
+        # The 33p/64/10 gate config (BASELINE.md config 5), 2 trials.
+        # Minutes in CPU interpret mode — the tier-1 run filters
+        # `-m 'not slow'`; run explicitly via `pytest -m slow`.
+        cfg = QBAConfig(n_parties=33, size_l=64, n_dishonest=10)
+        xla, tiled, fused = triad(cfg, 9, 2, 128)
+        assert_equal(xla, fused)
+        assert_equal(tiled, fused)
+
+
+class TestTrialPacking:
+    def test_packed_matches_unpacked(self):
+        # k=2 packing folds trial pairs into one kernel grid; per-trial
+        # independence must make it invisible — same keys, same
+        # decisions, trial for trial.
+        from qba_tpu.rounds.engine import run_trials_fused_packed
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=2,
+            round_engine="pallas_fused", tiled_block=16, trial_pack=2,
+        )
+        keys = jax.random.split(jax.random.key(11), 4)
+        packed = run_trials_fused_packed(cfg, keys, 2)
+        unpacked = jax.vmap(lambda k: run_trial(cfg, k))(keys)
+        assert_equal(unpacked, packed)
+
+    def test_packed_matches_xla(self):
+        # And against the independent oracle, k=4 over 8 trials.
+        from qba_tpu.rounds.engine import run_trials_fused_packed
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=2,
+            round_engine="pallas_fused", tiled_block=16, trial_pack=4,
+        )
+        keys = jax.random.split(jax.random.key(13), 8)
+        packed = run_trials_fused_packed(cfg, keys, 4)
+        xla_cfg = dataclasses.replace(cfg, round_engine="xla")
+        oracle = jax.vmap(lambda k: run_trial(xla_cfg, k))(keys)
+        assert_equal(oracle, packed)
+
+    def test_run_trials_dispatch_packed(self):
+        # The backend entry point routes through the packed runner when
+        # the fused engine resolves with k > 1 dividing the batch — and
+        # the Monte-Carlo aggregate is unchanged.
+        from qba_tpu.backends.jax_backend import run_trials, trial_keys
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=2, trials=4,
+            round_engine="pallas_fused", tiled_block=16, trial_pack=2,
+        )
+        res = run_trials(cfg)
+        ref = run_trials(dataclasses.replace(cfg, round_engine="xla"))
+        assert_equal(ref.trials, res.trials)
+        assert float(res.success_rate) == float(ref.success_rate)
+
+    def test_trial_pack_validation(self):
+        with pytest.raises(ValueError):
+            QBAConfig(n_parties=5, size_l=16, trial_pack=0)
+
+
+class TestSingleLaunchPerRound:
+    def test_one_pallas_call_per_round(self):
+        # THE structural claim of the fusion: the fused engine's round
+        # body contains ONE pallas_call where the tiled pair has two.
+        # The round loop is a lax.scan, so each engine's whole-trial
+        # jaxpr mentions pallas_call once per kernel in the body.
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=2)
+        key = jax.random.key(0)
+
+        def n_calls(engine):
+            ecfg = dataclasses.replace(
+                cfg, round_engine=engine, tiled_block=16
+            )
+            jaxpr = jax.make_jaxpr(lambda k: run_trial(ecfg, k))(key)
+            return str(jaxpr).count("pallas_call")
+
+        assert n_calls("pallas_fused") == 1
+        assert n_calls("pallas_tiled") == 2
+
+    def test_demotion_to_tiled_warns(self, monkeypatch):
+        # When the fused plan does not compile (probe demotion), the
+        # forced engine falls back to the two-kernel tiled path with a
+        # RuntimeWarning — and the results are still correct.
+        import qba_tpu.ops.round_kernel_tiled as rkt
+
+        monkeypatch.setattr(
+            rkt, "resolve_fused_block",
+            lambda cfg, n_recv=None, trial_pack=1: None,
+        )
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=2,
+            round_engine="pallas_fused", tiled_block=16,
+        )
+        keys = jax.random.split(jax.random.key(1), 4)
+        with pytest.warns(RuntimeWarning, match="demoting to the two-kernel"):
+            demoted = jax.vmap(lambda k: run_trial(cfg, k))(keys)
+        xla_cfg = dataclasses.replace(cfg, round_engine="xla")
+        oracle = jax.vmap(lambda k: run_trial(xla_cfg, k))(keys)
+        assert_equal(oracle, demoted)
+
+
+class TestResolveMemoization:
+    def test_same_shape_resolves_are_cached(self):
+        # Satellite: repeated same-shape resolutions must hit the
+        # in-process memo, not re-run the probe/planning logic.
+        import qba_tpu.ops.round_kernel_tiled as rkt
+
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=2)
+        rkt.clear_resolve_caches()
+        base = dict(rkt.PROBE_STATS)
+        rkt.resolve_verdict_variant(cfg)
+        rkt.resolve_tiled_block(cfg)
+        rkt.resolve_rebuild_block(cfg)
+        rkt.resolve_fused_block(cfg)
+        rkt.resolve_trial_pack(cfg)
+        misses_after_first = rkt.PROBE_STATS["resolve_misses"]
+        assert misses_after_first >= base["resolve_misses"] + 5
+        probes_after_first = rkt.PROBE_STATS["compile_probes"]
+        rkt.resolve_verdict_variant(cfg)
+        rkt.resolve_tiled_block(cfg)
+        rkt.resolve_rebuild_block(cfg)
+        rkt.resolve_fused_block(cfg)
+        rkt.resolve_trial_pack(cfg)
+        assert rkt.PROBE_STATS["resolve_misses"] == misses_after_first
+        assert rkt.PROBE_STATS["resolve_hits"] >= base["resolve_hits"] + 5
+        # No new compile probes on the second pass.
+        assert rkt.PROBE_STATS["compile_probes"] == probes_after_first
+
+    def test_measure_batch_skips_reprobe(self):
+        # The benchmark harness calls the resolvers through run_trials
+        # + engine attribution; a second same-shape measurement must
+        # not re-resolve.
+        import qba_tpu.ops.round_kernel_tiled as rkt
+        from qba_tpu.benchmark import measure_batch
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=2, trials=2,
+            round_engine="pallas_fused", tiled_block=16,
+        )
+        rkt.clear_resolve_caches()
+        measure_batch(cfg, reps=1, warmup=False)
+        misses = rkt.PROBE_STATS["resolve_misses"]
+        probes = rkt.PROBE_STATS["compile_probes"]
+        measure_batch(cfg, reps=1, warmup=False)
+        assert rkt.PROBE_STATS["resolve_misses"] == misses
+        assert rkt.PROBE_STATS["compile_probes"] == probes
+
+    def test_distinct_shapes_not_conflated(self):
+        import qba_tpu.ops.round_kernel_tiled as rkt
+
+        rkt.clear_resolve_caches()
+        a = QBAConfig(n_parties=5, size_l=16, n_dishonest=2)
+        b = QBAConfig(n_parties=5, size_l=32, n_dishonest=2)
+        blk_a = rkt.resolve_tiled_block(a)
+        blk_b = rkt.resolve_tiled_block(b)
+        # Both resolved independently (two misses, zero hits for the
+        # second shape); explicit values are shape-legal.
+        assert blk_a is None or (a.n_lieutenants * a.slots) % blk_a == 0
+        assert blk_b is None or (b.n_lieutenants * b.slots) % blk_b == 0
+        assert rkt.PROBE_STATS["resolve_misses"] >= 2
+
+
+class TestSpmdFused:
+    def test_spmd_accepts_fused_engine(self):
+        # The party-sharded variant of the fused kernel: forced
+        # pallas_fused under a dp x tp mesh must match the single-device
+        # XLA engine trial for trial.  Needs >= 4 host devices (the CPU
+        # test mesh is configured in conftest).
+        from qba_tpu.backends.jax_backend import run_trials
+        from qba_tpu.parallel import make_mesh
+        from qba_tpu.parallel.spmd import run_trials_spmd
+
+        n_devices = len(jax.devices())
+        if n_devices < 4 or n_devices % 2:
+            pytest.skip("needs an even device count >= 4")
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=2, trials=n_devices,
+            round_engine="pallas_fused", tiled_block=16,
+        )
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        spmd = run_trials_spmd(cfg, mesh)
+        ref = run_trials(dataclasses.replace(cfg, round_engine="xla"))
+        assert_equal(ref.trials, spmd.trials)
