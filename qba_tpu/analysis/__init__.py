@@ -1,0 +1,24 @@
+"""Static invariant checker for the QBA TPU kernels (``qba-tpu lint``).
+
+Turns the Known Issues' hand-enforced conventions into machine-checked
+passes over the traced build paths of every round engine:
+
+* :mod:`qba_tpu.analysis.dots` — KI-3 exact-dot checking via interval
+  abstract interpretation (:mod:`qba_tpu.analysis.intervals`) of the
+  jaxprs in :mod:`qba_tpu.analysis.traces`;
+* :mod:`qba_tpu.analysis.vma` — KI-1 ``out_vma`` threading and
+  ``check_vma`` policy audits;
+* :mod:`qba_tpu.analysis.memory` — KI-2 static VMEM/HBM plan audit;
+* :mod:`qba_tpu.analysis.driver` — the lint orchestrator
+  (:func:`run_lint`) the CLI and CI gate call.
+"""
+
+from qba_tpu.analysis.findings import Finding, Report  # noqa: F401
+
+
+def run_lint(configs=None, engines=None) -> Report:
+    """Lazy forwarder to :func:`qba_tpu.analysis.driver.run_lint` so
+    ``import qba_tpu.analysis`` stays jax-import-free."""
+    from qba_tpu.analysis.driver import run_lint as _run
+
+    return _run(configs=configs, engines=engines)

@@ -1,0 +1,134 @@
+"""KI-3 exact-dot pass.
+
+Mechanizes the KNOWN_ISSUES rule: *any dot whose integer operands can
+exceed 256 must pass ``Precision.HIGHEST``*.  On TPU, default-precision
+``dot_general`` feeds the MXU with bf16 passes regardless of the stored
+dtype, and bf16 represents integers exactly only up to ``2**8 = 256`` —
+beyond that, protocol ids (pool rows, cell ids, lieutenant ids) silently
+round to even and the gather/permute matmuls return the wrong row.
+
+The pass runs over the :class:`~qba_tpu.analysis.intervals.DotRecord`
+list produced by interval interpretation of each traced build path and
+flags every ``dot_general`` that is
+
+* *default precision* (``precision=None`` or a ``DEFAULT`` pair), and
+* has a floating operand (f32/bf16/f16 — integer dots run exactly in
+  the VPU and are safe), that is
+* **provably integer-valued** with magnitude bound above
+  :data:`BF16_EXACT_MAX` — or integral but unbounded, which counts as a
+  violation (the analysis must *prove* safety, not fail to disprove it).
+
+Operands the analysis cannot prove integral (probabilities, averages)
+are skipped: bf16 rounding of real-valued math is an accepted accuracy
+trade handled by the engines' own tolerances, not a KI-3 bug.  Those
+skips err toward false negatives and are counted in the report stats.
+
+Annotating a proven-exact dot
+-----------------------------
+
+If a default-precision dot is genuinely safe for a reason outside the
+interval domain (e.g. the integer values are multiples of 512 and thus
+bf16-exact despite exceeding 256), mark the call site with a trailing
+or preceding comment containing the marker ``qba-lint: exact-ok``
+followed by the justification::
+
+    out = one_hot @ table  # qba-lint: exact-ok (values are powers of 2)
+
+The pass reads the flagged source line (and its two neighbours, for
+wrapped calls) and demotes the finding to a note carrying the
+justification.  See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable
+
+import numpy as np
+
+from qba_tpu.analysis.findings import Finding, Report
+from qba_tpu.analysis.intervals import DotRecord
+
+#: Largest integer magnitude bf16 represents exactly (8 significand bits).
+BF16_EXACT_MAX = 256
+
+ALLOW_MARKER = "qba-lint: exact-ok"
+
+_FLOAT_DTYPES = ("float32", "bfloat16", "float16")
+
+
+def _is_default_precision(precision) -> bool:
+    if precision is None:
+        return True
+    parts = precision if isinstance(precision, (tuple, list)) else (precision,)
+    return all(str(getattr(p, "name", p)).upper() == "DEFAULT" for p in parts)
+
+
+def _allow_justification(where: str) -> str | None:
+    """Return the ``qba-lint: exact-ok`` annotation near ``where`` if any."""
+    if ":" not in where:
+        return None
+    fname, _, lineno_s = where.rpartition(":")
+    try:
+        lineno = int(lineno_s)
+    except ValueError:
+        return None
+    if not os.path.isfile(fname):
+        return None
+    try:
+        with open(fname, encoding="utf-8") as fh:
+            lines = fh.readlines()
+    except OSError:
+        return None
+    for i in range(max(0, lineno - 2), min(len(lines), lineno + 2)):
+        if ALLOW_MARKER in lines[i]:
+            return lines[i].split(ALLOW_MARKER, 1)[1].strip() or "annotated"
+    return None
+
+
+def check_dots(records: Iterable[DotRecord]) -> Report:
+    report = Report()
+    n_checked = n_exact = n_skipped = 0
+    for rec in records:
+        n_checked += 1
+        eqn = rec.eqn
+        if not _is_default_precision(eqn.params.get("precision")):
+            n_exact += 1
+            continue
+        for side, ival, var in (
+            ("lhs", rec.lhs, eqn.invars[0]),
+            ("rhs", rec.rhs, eqn.invars[1]),
+        ):
+            dtype = np.dtype(var.aval.dtype)
+            if dtype.name not in _FLOAT_DTYPES:
+                continue
+            if not ival.integral:
+                n_skipped += 1
+                continue
+            if ival.bounded and ival.mag <= BF16_EXACT_MAX:
+                continue
+            bound = (
+                f"magnitude bound {ival.mag:g}" if ival.bounded
+                else "unbounded integer range"
+            )
+            justification = _allow_justification(rec.where)
+            msg = (
+                f"default-precision dot_general with integer-valued "
+                f"{side} operand ({dtype.name}, {ival!r}): {bound} exceeds "
+                f"bf16's exact range of {BF16_EXACT_MAX}; pass "
+                f"precision=Precision.HIGHEST or prove the bound"
+            )
+            if justification is not None:
+                report.notes.append(
+                    f"allowlisted exact-dot at {rec.where or rec.path}: "
+                    f"{justification}"
+                )
+                continue
+            report.findings.append(Finding(
+                ki="KI-3", check="exact-dot", path=rec.path,
+                message=msg, where=rec.where,
+            ))
+    report.stats["dots_checked"] = n_checked
+    report.stats["dots_explicit_precision"] = n_exact
+    report.stats["dots_skipped_nonintegral"] = n_skipped
+    return report

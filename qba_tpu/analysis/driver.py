@@ -1,0 +1,101 @@
+"""Lint orchestrator: the entry point the CLI and CI gate call.
+
+:func:`run_lint` traces every engine/kernel build path
+(:mod:`qba_tpu.analysis.traces`), interval-interprets each jaxpr
+(:mod:`qba_tpu.analysis.intervals`), and runs the three invariant
+passes — KI-3 exact-dot (:mod:`qba_tpu.analysis.dots`), KI-1
+vma-threading (:mod:`qba_tpu.analysis.vma`), KI-2 plan audit
+(:mod:`qba_tpu.analysis.memory`) — over a small config matrix chosen
+to cover the planner's phase space:
+
+* ``cheap``       — (17, 16, 4): every engine live, fused plan resolves,
+  even lieutenant count so the 2-way sharded variants trace;
+* ``north-star``  — (33, 64, 10): the BASELINE.md flagship; the fused
+  kernel demotes on TPU and the pool meta bounds cross bf16's exact
+  range, so the one-hot structure proofs carry real weight;
+* ``f32-gdt``     — (11, 1000, 3): the reference paper's 11-party
+  scale; size_l pushes the verdict kernel into its f32 gather dtype.
+
+One aggregated :class:`~qba_tpu.analysis.findings.Report` comes back:
+empty findings means the tree upholds KI-1/KI-2/KI-3 by construction.
+The whole run is pure CPU tracing/arithmetic — no TPU, no compile
+probes (the KI-2 pass verifies that last claim against PROBE_STATS).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from qba_tpu.analysis.findings import Report
+from qba_tpu.config import QBAConfig
+
+#: (label, config-kwargs) lint matrix; see the module docstring for why
+#: each point is in it.
+LINT_MATRIX = (
+    ("cheap", dict(n_parties=17, size_l=16, n_dishonest=4)),
+    ("north-star", dict(n_parties=33, size_l=64, n_dishonest=10)),
+    ("f32-gdt", dict(n_parties=11, size_l=1000, n_dishonest=3)),
+)
+
+ENGINE_CHOICES = ("xla", "pallas", "pallas_tiled", "pallas_fused", "spmd")
+
+
+def lint_configs() -> list[tuple[str, QBAConfig]]:
+    """The built-in lint matrix, instantiated."""
+    return [(label, QBAConfig(**kw)) for label, kw in LINT_MATRIX]
+
+
+def _lint_config(
+    label: str, cfg: QBAConfig, engines, sitewide: bool,
+) -> Report:
+    from qba_tpu.analysis.dots import check_dots
+    from qba_tpu.analysis.intervals import IntervalInterpreter
+    from qba_tpu.analysis.memory import check_memory
+    from qba_tpu.analysis.traces import trace_paths
+    from qba_tpu.analysis.vma import check_vma
+
+    engine_set = set(engines) if engines is not None else set(ENGINE_CHOICES)
+    report = Report()
+    paths, notes = trace_paths(cfg, engine_set)
+    report.notes.extend(f"{label}: {n}" for n in notes)
+
+    records = []
+    unhandled: set[str] = set()
+    for p in paths:
+        interp = IntervalInterpreter(f"{label}:{p.name}")
+        interp.run(p.closed_jaxpr, p.seeds)
+        records.extend(interp.dots.values())
+        unhandled |= interp.unhandled
+    report.extend(check_dots(records))
+    report.stats["paths_traced"] = len(paths)
+    report.stats["unhandled_primitives"] = unhandled
+
+    if "spmd" in engine_set:
+        # The KI-1 call-site/policy audits are config-independent —
+        # run them once per lint, not once per matrix point.
+        report.extend(check_vma(cfg, sitewide=sitewide))
+    if engine_set & {"pallas_tiled", "pallas_fused"}:
+        report.extend(check_memory(cfg))
+    return report
+
+
+def run_lint(
+    configs: Sequence[tuple[str, QBAConfig]] | None = None,
+    engines: Iterable[str] | None = None,
+) -> Report:
+    """Run every lint pass over ``configs`` (default: the built-in
+    matrix) restricted to ``engines`` (default: all build paths).
+    Returns one aggregated report; ``report.ok`` is the CI gate."""
+    if engines is not None:
+        bad = set(engines) - set(ENGINE_CHOICES)
+        if bad:
+            raise ValueError(
+                f"unknown lint engine(s) {sorted(bad)}; "
+                f"choose from {ENGINE_CHOICES}"
+            )
+    report = Report()
+    sitewide = True
+    for label, cfg in configs if configs is not None else lint_configs():
+        report.extend(_lint_config(label, cfg, engines, sitewide))
+        sitewide = False
+    return report

@@ -1,0 +1,88 @@
+"""Finding/report data model for the static invariant checker.
+
+A *finding* is one violated invariant, tagged with the Known Issue it
+mechanizes (``docs/KNOWN_ISSUES.md``):
+
+* ``KI-1`` — ``out_vma`` dead machinery / ``check_vma`` policy drift
+  on the party-sharded kernel builders (:mod:`qba_tpu.analysis.vma`).
+* ``KI-2`` — a kernel/HBM plan that is statically inconsistent with
+  its own budget (:mod:`qba_tpu.analysis.memory`).
+* ``KI-3`` — a default-precision float dot whose integer operand bound
+  exceeds bf16's exact range of 256
+  (:mod:`qba_tpu.analysis.dots`).
+
+A *note* is an informational line the report carries alongside the
+findings (plan predictions, probe-counter reality checks) — notes
+never fail the lint gate; findings always do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+KI_TAGS = ("KI-1", "KI-2", "KI-3")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violated invariant."""
+
+    ki: str  # "KI-1" | "KI-2" | "KI-3"
+    check: str  # pass name, e.g. "exact-dot", "vma-threading"
+    path: str  # traced build path, e.g. "pallas_tiled/rebuild"
+    message: str  # human-readable statement of the violation
+    where: str = ""  # source location "file:line" when recoverable
+
+    def __post_init__(self) -> None:
+        if self.ki not in KI_TAGS:
+            raise ValueError(f"unknown KI tag {self.ki!r}")
+
+    def render(self) -> str:
+        loc = f" [{self.where}]" if self.where else ""
+        return f"{self.ki} {self.check} ({self.path}){loc}: {self.message}"
+
+
+@dataclasses.dataclass
+class Report:
+    """Aggregated lint result: findings fail the gate, notes inform."""
+
+    findings: list[Finding] = dataclasses.field(default_factory=list)
+    notes: list[str] = dataclasses.field(default_factory=list)
+    stats: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def extend(self, other: "Report") -> None:
+        self.findings.extend(other.findings)
+        self.notes.extend(other.notes)
+        for k, v in other.stats.items():
+            if isinstance(v, (int, float)) and k in self.stats:
+                self.stats[k] += v
+            elif isinstance(v, (set, frozenset)):
+                self.stats[k] = set(self.stats.get(k, set())) | set(v)
+            else:
+                self.stats[k] = v
+
+    def add(self, findings: Iterable[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def render(self, verbose: bool = False) -> str:
+        lines: list[str] = []
+        for f in self.findings:
+            lines.append("FINDING " + f.render())
+        if verbose or not self.findings:
+            for n in self.notes:
+                lines.append("note: " + n)
+        unhandled = self.stats.get("unhandled_primitives")
+        if unhandled:
+            lines.append(
+                "note: interval analysis skipped unmodeled primitives "
+                f"(treated as unknown/non-integer): {sorted(unhandled)}"
+            )
+        lines.append(
+            f"{len(self.findings)} finding(s), {len(self.notes)} note(s)"
+        )
+        return "\n".join(lines)

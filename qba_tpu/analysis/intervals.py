@@ -1,0 +1,861 @@
+"""Interval abstract interpretation over jaxprs.
+
+The KI-3 rule ("any dot whose integer operands can exceed 256 must pass
+``Precision.HIGHEST``", docs/KNOWN_ISSUES.md) is a statement about the
+*value ranges* flowing into each ``dot_general``.  This module proves
+those ranges statically: every array in a traced jaxpr is abstracted to
+one interval ``[lo, hi]`` plus an ``integral`` bit ("provably
+integer-valued"), seeded at the jaxpr inputs from ``QBAConfig``-derived
+bounds (:mod:`qba_tpu.analysis.traces`) and propagated through a
+transfer function per primitive.
+
+The domain is a product of the interval with three per-axis structure
+facts, because the kernels' central idiom — gather/permute as a one-hot
+MXU matmul — is invisible to plain intervals (a sound sum-over-K bound
+inflates every gathered value by the contraction size):
+
+* ``onehot``: axes along which at most ONE element per fiber is
+  nonzero.  Established by ``eq(iota_d, c)`` where ``c`` is constant
+  along ``d``, preserved by 0-masking selects and multiplies.  A dot
+  whose contracted axis is onehot on either side sums at most one
+  nonzero term, so its bound is the plain product of operand bounds —
+  exactly the "one-hot gather is exact while gathered values fit"
+  reasoning the kernels are built on.
+* ``const``: axes along which the array is constant (what broadcasting
+  a ``[n, 1]`` column across lanes produces).
+* ``distinct``: axes along which all values differ (``iota``).
+
+Other design points:
+
+* **One interval per array**, not per element — coarse, but the
+  protocol's operands are bounded uniformly (ids, flags, counts).
+* **Refs** (Pallas kernel operands/outputs/scratch) map to mutable
+  :class:`RefCell` s holding the join of everything ever stored;
+  ``pallas_call`` bodies run to a *fixpoint* (grid steps carry state
+  through revisited output blocks, e.g. the verdict kernel's
+  cross-block ``vi`` carry) with widening to TOP after
+  :data:`MAX_FIXPOINT_PASSES`.
+* **Unknown primitives degrade to TOP with ``integral=False``** — the
+  KI-3 checker then *skips* those dots (it flags only provably-integer
+  operands), so an unmodeled primitive can cause a false negative but
+  never a false positive.  Unmodeled names surface in the report's
+  ``unhandled_primitives`` stat so gaps stay visible.
+* Every ``dot_general`` encountered (including inside ``pallas_call``
+  kernel jaxprs, ``pjit`` bodies, and ``cond`` branches) is recorded
+  with its operand abstractions for :mod:`qba_tpu.analysis.dots`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import numpy as np
+
+INF = math.inf
+MAX_FIXPOINT_PASSES = 5
+_EMPTY = frozenset()
+#: Skip numeric structure detection on constants larger than this.
+_CONCRETE_STRUCTURE_CAP = 1 << 22
+
+
+@dataclasses.dataclass(frozen=True)
+class IVal:
+    """Abstract value: interval + integrality + per-axis structure."""
+
+    lo: float
+    hi: float
+    integral: bool
+    onehot: frozenset = _EMPTY   # axes with <= 1 nonzero per fiber
+    const: frozenset = _EMPTY    # axes the array is constant along
+    distinct: frozenset = _EMPTY  # axes with all-distinct values
+    #: For rank-2 arrays packing heterogeneous columns (the pool's
+    #: ``meta`` ``[cap, 4]`` = count/v/sent/cell), a per-index interval
+    #: along the LAST axis — static column slices refine to it.
+    cols: tuple | None = None
+
+    @property
+    def mag(self) -> float:
+        return max(abs(self.lo), abs(self.hi))
+
+    @property
+    def bounded(self) -> bool:
+        return math.isfinite(self.lo) and math.isfinite(self.hi)
+
+    def plain(self) -> "IVal":
+        """The same interval with structure dropped (shape changed)."""
+        if not (self.onehot or self.const or self.distinct or self.cols):
+            return self
+        return IVal(self.lo, self.hi, self.integral)
+
+    def __repr__(self) -> str:  # compact in finding messages
+        tag = "int" if self.integral else "real"
+        return f"[{self.lo:g}, {self.hi:g}]({tag})"
+
+
+TOP = IVal(-INF, INF, False)
+BOOL = IVal(0.0, 1.0, True)
+
+
+def join(a: IVal, b: IVal) -> IVal:
+    cols = None
+    if a.cols and b.cols and len(a.cols) == len(b.cols):
+        cols = tuple(join(x, y) for x, y in zip(a.cols, b.cols))
+    return IVal(
+        min(a.lo, b.lo), max(a.hi, b.hi), a.integral and b.integral,
+        a.onehot & b.onehot, a.const & b.const, a.distinct & b.distinct,
+        cols,
+    )
+
+
+def join_all(vals) -> IVal:
+    out = None
+    for v in vals:
+        out = v if out is None else join(out, v)
+    return out if out is not None else TOP
+
+
+def from_concrete(value) -> IVal:
+    """Interval + structure of a literal / jaxpr constant."""
+    try:
+        a = np.asarray(value)
+        if a.size == 0:
+            return IVal(0.0, 0.0, True)
+        if a.dtype == bool:
+            a = a.astype(np.int32)
+        if not np.issubdtype(a.dtype, np.number):
+            return TOP
+        af = a.astype(np.float64)
+        if not np.all(np.isfinite(af)):
+            return TOP
+        integral = bool(
+            np.issubdtype(a.dtype, np.integer)
+            or np.all(af == np.floor(af))
+        )
+        onehot, const, distinct = _concrete_structure(af)
+        return IVal(
+            float(af.min()), float(af.max()), integral,
+            onehot, const, distinct,
+        )
+    except Exception:
+        return TOP
+
+
+def _concrete_structure(af: np.ndarray):
+    """Detect per-axis structure of a constant numerically (captured
+    one-hot tables, ``jnp.arange`` index vectors, ...)."""
+    if af.ndim == 0 or af.size > _CONCRETE_STRUCTURE_CAP:
+        return _EMPTY, _EMPTY, _EMPTY
+    onehot, const, distinct = set(), set(), set()
+    nz = af != 0.0
+    for d in range(af.ndim):
+        if af.shape[d] == 1:
+            const.add(d)
+            distinct.add(d)
+            if nz.sum() <= max(
+                1, af.size // max(1, af.shape[d])
+            ) and np.all(nz.sum(axis=d) <= 1):
+                onehot.add(d)
+            continue
+        if np.all(nz.sum(axis=d) <= 1):
+            onehot.add(d)
+        fibers = np.moveaxis(af, d, 0).reshape(af.shape[d], -1)
+        if np.all(fibers == fibers[0]):
+            const.add(d)
+        else:
+            srt = np.sort(fibers, axis=0)
+            if np.all(np.diff(srt, axis=0) != 0):
+                distinct.add(d)
+    return frozenset(onehot), frozenset(const), frozenset(distinct)
+
+
+def _mul_bound(x: float, y: float) -> float:
+    if x == 0.0 or y == 0.0:
+        return 0.0  # inf * 0 convention: arrays of zeros stay zero
+    return x * y
+
+
+def interval_mul(a: IVal, b: IVal) -> IVal:
+    corners = [
+        _mul_bound(a.lo, b.lo), _mul_bound(a.lo, b.hi),
+        _mul_bound(a.hi, b.lo), _mul_bound(a.hi, b.hi),
+    ]
+    # A product is nonzero only where both factors are, so either
+    # factor's onehot axes carry over.
+    return IVal(
+        min(corners), max(corners), a.integral and b.integral,
+        a.onehot | b.onehot, a.const & b.const,
+    )
+
+
+class RefCell:
+    """Abstract contents of one mutable ref (kernel operand, output
+    block, or scratch buffer).  ``None`` means "never written" — a read
+    before any write returns TOP (uninitialized scratch)."""
+
+    __slots__ = ("content",)
+
+    def __init__(self, content: IVal | None = None):
+        self.content = content
+
+    def read(self) -> IVal:
+        return self.content if self.content is not None else TOP
+
+    def store(self, val: IVal) -> None:
+        self.content = val if self.content is None else join(self.content, val)
+
+
+@dataclasses.dataclass
+class DotRecord:
+    """One ``dot_general`` site with the operand intervals proven for it."""
+
+    eqn: Any
+    lhs: IVal
+    rhs: IVal
+    path: str
+    where: str
+
+
+def source_location(eqn) -> str:
+    try:
+        from jax._src import source_info_util as siu
+
+        fr = siu.user_frame(eqn.source_info)
+        if fr is not None:
+            return f"{fr.file_name}:{fr.start_line}"
+    except Exception:
+        pass
+    return ""
+
+
+def _is_ref(var) -> bool:
+    aval = getattr(var, "aval", None)
+    return hasattr(aval, "inner_aval") or type(aval).__name__ in (
+        "AbstractRef", "AbstractMemoryRef",
+    )
+
+
+def _aval_size(var) -> int:
+    shape = getattr(var.aval, "shape", ())
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+class IntervalInterpreter:
+    """Abstractly interprets one traced build path (a ClosedJaxpr)."""
+
+    def __init__(self, path: str = ""):
+        self.path = path
+        self.unhandled: set[str] = set()
+        # keyed by id(eqn): fixpoint passes overwrite with the widest
+        # (final) operand intervals — the join is monotone per pass.
+        self.dots: dict[int, DotRecord] = {}
+
+    # -- public entry -----------------------------------------------------
+
+    def run(self, closed_jaxpr, arg_ivals: list[IVal]) -> list[IVal]:
+        jaxpr = closed_jaxpr.jaxpr
+        consts = closed_jaxpr.consts
+        env: dict[Any, Any] = {}
+        for var, const in zip(jaxpr.constvars, consts):
+            env[var] = from_concrete(const)
+        if len(arg_ivals) != len(jaxpr.invars):
+            raise ValueError(
+                f"{self.path}: seeded {len(arg_ivals)} intervals for "
+                f"{len(jaxpr.invars)} jaxpr inputs"
+            )
+        for var, ival in zip(jaxpr.invars, arg_ivals):
+            env[var] = RefCell(ival) if _is_ref(var) else ival
+        self._eval_jaxpr(jaxpr, env)
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    # -- environment ------------------------------------------------------
+
+    def _read(self, env, var):
+        if type(var).__name__ == "Literal":
+            return from_concrete(var.val)
+        val = env.get(var, TOP)
+        if isinstance(val, RefCell):
+            return val.read()
+        return val
+
+    def _read_raw(self, env, var):
+        """Like _read but refs come back as their RefCell (aliasing)."""
+        if type(var).__name__ == "Literal":
+            return from_concrete(var.val)
+        return env.get(var, TOP)
+
+    # -- interpreter core -------------------------------------------------
+
+    def _eval_jaxpr(self, jaxpr, env) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            handler = getattr(self, f"_prim_{name.replace('-', '_')}", None)
+            if handler is not None:
+                outs = handler(eqn, env)
+            elif name in _IDENTITY_PRIMS:
+                src = self._read(env, eqn.invars[0])
+                if getattr(eqn.invars[0].aval, "shape", None) != getattr(
+                    eqn.outvars[0].aval, "shape", None
+                ):
+                    src = src.plain()  # axes moved; structure is stale
+                outs = [src] * len(eqn.outvars)
+            elif name in _BOOL_PRIMS:
+                outs = [BOOL] * len(eqn.outvars)
+            elif name in _CALL_PRIMS or "call_jaxpr" in eqn.params:
+                outs = self._call(eqn, env)
+            else:
+                self.unhandled.add(name)
+                outs = [TOP] * len(eqn.outvars)
+            for var, out in zip(eqn.outvars, outs):
+                if type(var).__name__ != "DropVar":
+                    env[var] = out
+
+    def _sub_run(self, sub, env, operands):
+        """Run a sub-jaxpr with the given operand objects (IVals and/or
+        RefCells — cells alias, so mutations propagate to the caller)."""
+        jaxpr = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+        consts = list(getattr(sub, "consts", ()))
+        sub_env: dict[Any, Any] = {}
+        for var, const in zip(jaxpr.constvars, consts):
+            sub_env[var] = from_concrete(const)
+        for var, op in zip(jaxpr.invars, operands):
+            sub_env[var] = op
+        self._eval_jaxpr(jaxpr, sub_env)
+        return [self._read(sub_env, v) for v in jaxpr.outvars]
+
+    # -- structured / call primitives -------------------------------------
+
+    def _call(self, eqn, env):
+        sub = eqn.params.get("call_jaxpr") or eqn.params.get("jaxpr")
+        jaxpr = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+        ops = [self._read_raw(env, v) for v in eqn.invars]
+        # custom_jvp/vjp calls prepend rule closures to invars; align on
+        # the trailing operands the sub-jaxpr actually takes.
+        ops = ops[len(ops) - len(jaxpr.invars):]
+        return self._sub_run(sub, env, ops)
+
+    def _prim_pjit(self, eqn, env):
+        return self._call(eqn, env)
+
+    def _prim_closed_call(self, eqn, env):
+        return self._call(eqn, env)
+
+    def _prim_custom_jvp_call(self, eqn, env):
+        return self._call(eqn, env)
+
+    def _prim_custom_vjp_call(self, eqn, env):
+        return self._call(eqn, env)
+
+    def _prim_remat(self, eqn, env):
+        return self._call(eqn, env)
+
+    def _prim_checkpoint(self, eqn, env):
+        return self._call(eqn, env)
+
+    def _prim_cond(self, eqn, env):
+        branches = eqn.params["branches"]
+        ops = [self._read_raw(env, v) for v in eqn.invars[1:]]
+        outs = None
+        for br in branches:
+            res = self._sub_run(br, env, ops)
+            outs = res if outs is None else [join(a, b) for a, b in zip(outs, res)]
+        return outs if outs is not None else [TOP] * len(eqn.outvars)
+
+    def _prim_while(self, eqn, env):
+        # Conservative: analyze the body once with TOP carries (collects
+        # any dots inside without claiming bounds for them).
+        body = eqn.params["body_jaxpr"]
+        jaxpr = body.jaxpr if hasattr(body, "jaxpr") else body
+        self._sub_run(body, env, [TOP] * len(jaxpr.invars))
+        return [TOP] * len(eqn.outvars)
+
+    def _prim_scan(self, eqn, env):
+        # Conservative: consts keep their intervals, carries are TOP
+        # (they evolve across iterations), xs keep theirs (each
+        # iteration sees a slice of the same array).
+        sub = eqn.params["jaxpr"]
+        n_consts = eqn.params.get("num_consts", 0)
+        n_carry = eqn.params.get("num_carry", 0)
+        ops = [self._read_raw(env, v) for v in eqn.invars]
+        for i in range(n_consts, n_consts + n_carry):
+            ops[i] = TOP
+        self._sub_run(sub, env, ops)
+        return [TOP] * len(eqn.outvars)
+
+    def _prim_pallas_call(self, eqn, env):
+        gm = eqn.params["grid_mapping"]
+        sub = eqn.params["jaxpr"]
+        jaxpr = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+        n_in = gm.num_inputs + gm.num_index_operands
+        n_out = gm.num_outputs
+        in_ivals = [self._read(env, v) for v in eqn.invars]
+        aliases = dict(eqn.params.get("input_output_aliases") or ())
+        out_cells = [RefCell() for _ in range(n_out)]
+        for in_idx, out_idx in aliases.items():
+            out_cells[out_idx] = RefCell(in_ivals[in_idx])
+        n_scratch = len(jaxpr.invars) - n_in - n_out
+        scratch = [RefCell() for _ in range(max(0, n_scratch))]
+        operands = (
+            [RefCell(iv) for iv in in_ivals] + out_cells + scratch
+        )
+        # Fixpoint over grid steps: revisited output blocks / scratch
+        # carry state between steps, so re-run until contents settle,
+        # then widen whatever is still moving and do one final pass.
+        cells = [c for c in operands if isinstance(c, RefCell)]
+        for _ in range(MAX_FIXPOINT_PASSES):
+            before = [c.content for c in cells]
+            self._sub_run(sub, env, operands)
+            if [c.content for c in cells] == before:
+                break
+        else:
+            for c, b in zip(cells, before):
+                if c.content != b:
+                    c.content = TOP
+            self._sub_run(sub, env, operands)
+        return [c.read() for c in out_cells]
+
+    # -- state primitives --------------------------------------------------
+
+    def _prim_get(self, eqn, env):
+        cell = self._read_raw(env, eqn.invars[0])
+        if not isinstance(cell, RefCell):
+            return [TOP]
+        val = cell.read()
+        ref_shape = tuple(getattr(eqn.invars[0].aval, "shape", ()) or ())
+        out_shape = tuple(getattr(eqn.outvars[0].aval, "shape", ()) or ())
+        if ref_shape == out_shape:
+            return [val]
+        # Indexed read: axis identities shift, so drop per-axis facts —
+        # but a read that leaves the trailing axis whole (row slicing /
+        # leading-index selection, e.g. meta_ref[t, sl]) preserves the
+        # column partition.
+        out = val.plain()
+        if (
+            val.cols is not None and ref_shape and out_shape
+            and out_shape[-1] == ref_shape[-1]
+        ):
+            out = dataclasses.replace(out, cols=val.cols)
+        return [out]
+
+    def _prim_swap(self, eqn, env):
+        cell = self._read_raw(env, eqn.invars[0])
+        val = self._read(env, eqn.invars[1])
+        if isinstance(cell, RefCell):
+            old = cell.read() if cell.content is not None else TOP
+            if getattr(eqn.invars[0].aval, "shape", None) != getattr(
+                eqn.invars[1].aval, "shape", None
+            ):
+                val = val.plain()
+            cell.store(val)
+            return [old]
+        return [TOP]
+
+    def _prim_addupdate(self, eqn, env):
+        cell = self._read_raw(env, eqn.invars[0])
+        val = self._read(env, eqn.invars[1])
+        if isinstance(cell, RefCell):
+            if cell.content is None:
+                cell.content = TOP
+            else:
+                base = cell.content
+                cell.content = IVal(
+                    base.lo + min(val.lo, 0.0), base.hi + max(val.hi, 0.0),
+                    base.integral and val.integral,
+                )
+        return []
+
+    # -- arithmetic --------------------------------------------------------
+
+    def _prim_add(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        return [IVal(a.lo + b.lo, a.hi + b.hi, a.integral and b.integral,
+                     _EMPTY, a.const & b.const)]
+
+    def _prim_sub(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        return [IVal(a.lo - b.hi, a.hi - b.lo, a.integral and b.integral,
+                     _EMPTY, a.const & b.const)]
+
+    def _prim_mul(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        return [interval_mul(a, b)]
+
+    def _prim_div(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        if b.bounded and (b.lo > 0 or b.hi < 0):
+            corners = [a.lo / b.lo, a.lo / b.hi, a.hi / b.lo, a.hi / b.hi]
+            is_int = np.issubdtype(eqn.outvars[0].aval.dtype, np.integer)
+            if is_int:
+                return [IVal(
+                    math.floor(min(corners)), math.floor(max(corners)), True
+                )]
+            return [IVal(min(corners), max(corners), False)]
+        return [TOP]
+
+    def _prim_rem(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        if b.bounded and b.lo > 0:
+            hi = b.hi - (1 if (a.integral and b.integral) else 0)
+            lo = 0.0 if a.lo >= 0 else -hi
+            return [IVal(lo, hi, a.integral and b.integral)]
+        return [TOP]
+
+    def _prim_neg(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        return [IVal(-a.hi, -a.lo, a.integral, a.onehot, a.const)]
+
+    def _prim_abs(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        lo = 0.0 if a.lo <= 0 <= a.hi else min(abs(a.lo), abs(a.hi))
+        return [IVal(lo, a.mag, a.integral, a.onehot, a.const)]
+
+    def _prim_sign(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        return [IVal(-1.0, 1.0, True, a.onehot, a.const)]
+
+    def _prim_max(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        return [IVal(max(a.lo, b.lo), max(a.hi, b.hi),
+                     a.integral and b.integral, _EMPTY, a.const & b.const)]
+
+    def _prim_min(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        return [IVal(min(a.lo, b.lo), min(a.hi, b.hi),
+                     a.integral and b.integral, _EMPTY, a.const & b.const)]
+
+    def _prim_clamp(self, eqn, env):
+        lo_b, x, hi_b = (self._read(env, v) for v in eqn.invars)
+        t = IVal(max(x.lo, lo_b.lo), max(x.hi, lo_b.hi),
+                 x.integral and lo_b.integral)
+        return [IVal(min(t.lo, hi_b.lo), min(t.hi, hi_b.hi),
+                     t.integral and hi_b.integral)]
+
+    def _prim_integer_pow(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        k = int(eqn.params["y"])
+        if k < 0 or not a.bounded:
+            return [TOP]
+        corners = [a.lo ** k, a.hi ** k] + ([0.0] if a.lo <= 0 <= a.hi else [])
+        return [IVal(min(corners), max(corners), a.integral,
+                     a.onehot, a.const)]
+
+    def _prim_floor(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        return [IVal(math.floor(a.lo) if a.bounded else a.lo,
+                     math.floor(a.hi) if a.bounded else a.hi, True)]
+
+    def _prim_ceil(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        return [IVal(math.ceil(a.lo) if a.bounded else a.lo,
+                     math.ceil(a.hi) if a.bounded else a.hi, True)]
+
+    def _prim_round(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        return [IVal(round(a.lo) if a.bounded else a.lo,
+                     round(a.hi) if a.bounded else a.hi, True)]
+
+    def _prim_convert_element_type(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        dt = eqn.params.get("new_dtype")
+        if dt is not None and (
+            np.issubdtype(dt, np.integer) or dt == np.bool_
+        ):
+            # float -> int truncates toward zero: stays inside the
+            # outward-rounded interval.
+            lo = math.floor(a.lo) if math.isfinite(a.lo) else a.lo
+            hi = math.ceil(a.hi) if math.isfinite(a.hi) else a.hi
+            return [IVal(lo, hi, True, a.onehot, a.const, a.distinct)]
+        return [a]
+
+    def _prim_select_n(self, eqn, env):
+        pred = self._read(env, eqn.invars[0])
+        cases = [self._read(env, v) for v in eqn.invars[1:]]
+        out = join_all(cases)
+        onehot = frozenset(out.onehot)
+        # jnp.where(mask, x, 0): nonzeros of the result are a subset of
+        # the mask's trues, so the mask's onehot axes carry over.
+        if len(cases) == 2:
+            if cases[0].lo == cases[0].hi == 0.0:
+                onehot = onehot | pred.onehot | cases[1].onehot
+            elif cases[1].lo == cases[1].hi == 0.0:
+                onehot = onehot | cases[0].onehot
+        return [dataclasses.replace(out, onehot=onehot)]
+
+    # -- bitwise / shifts --------------------------------------------------
+
+    def _prim_eq(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        out_rank = len(getattr(eqn.outvars[0].aval, "shape", ()))
+
+        def const_axes(ival, var):
+            # A size-1 axis is trivially constant; rank-0 operands
+            # (implicitly broadcast) are constant along every out axis.
+            shape = tuple(getattr(var.aval, "shape", ()))
+            if not shape:
+                return frozenset(range(out_rank))
+            return ival.const | frozenset(
+                d for d, n in enumerate(shape) if n == 1
+            )
+
+        a_const, b_const = const_axes(a, eqn.invars[0]), const_axes(b, eqn.invars[1])
+        # eq(iota_d, c) with c constant along d: at most one index along
+        # d can match — the one-hot construction idiom.
+        onehot = frozenset(
+            {d for d in a.distinct if d in b_const}
+            | {d for d in b.distinct if d in a_const}
+        )
+        return [IVal(0.0, 1.0, True, onehot, a.const & b.const)]
+
+    def _bitwise(self, eqn, env, op: str):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        if eqn.outvars[0].aval.dtype == np.bool_:
+            if op == "and":
+                # true only where both are: either side's onehot holds.
+                return [IVal(0.0, 1.0, True, a.onehot | b.onehot,
+                             a.const & b.const)]
+            return [IVal(0.0, 1.0, True, _EMPTY, a.const & b.const)]
+        if a.bounded and b.bounded and a.lo >= 0 and b.lo >= 0:
+            if op == "and":
+                return [IVal(0.0, min(a.hi, b.hi), True,
+                             a.onehot | b.onehot)]
+            bits = max(int(a.hi), int(b.hi)).bit_length()
+            return [IVal(0.0, float((1 << bits) - 1), True)]
+        return [TOP]
+
+    def _prim_and(self, eqn, env):
+        return self._bitwise(eqn, env, "and")
+
+    def _prim_or(self, eqn, env):
+        return self._bitwise(eqn, env, "or")
+
+    def _prim_xor(self, eqn, env):
+        return self._bitwise(eqn, env, "xor")
+
+    def _prim_not(self, eqn, env):
+        if eqn.outvars[0].aval.dtype == np.bool_:
+            return [BOOL]
+        return [TOP]
+
+    def _prim_shift_left(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        if a.bounded and b.bounded and a.lo >= 0 and b.lo >= 0:
+            return [IVal(
+                float(int(a.lo) << int(b.lo)),
+                float(int(a.hi) << int(b.hi)), True, a.onehot,
+            )]
+        return [TOP]
+
+    def _shift_right(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars)
+        if a.bounded and b.bounded and a.lo >= 0 and b.lo >= 0:
+            return [IVal(
+                float(int(a.lo) >> int(b.hi)),
+                float(int(a.hi) >> int(b.lo)), True,
+            )]
+        return [TOP]
+
+    def _prim_shift_right_logical(self, eqn, env):
+        return self._shift_right(eqn, env)
+
+    def _prim_shift_right_arithmetic(self, eqn, env):
+        return self._shift_right(eqn, env)
+
+    def _prim_population_count(self, eqn, env):
+        bits = np.dtype(eqn.invars[0].aval.dtype).itemsize * 8
+        return [IVal(0.0, float(bits), True)]
+
+    # -- shape / indexing --------------------------------------------------
+
+    def _prim_iota(self, eqn, env):
+        dim = eqn.params.get("dimension", 0)
+        shape = tuple(eqn.params.get("shape") or eqn.outvars[0].aval.shape)
+        n = int(shape[dim]) if shape else 1
+        const = frozenset(d for d in range(len(shape)) if d != dim)
+        return [IVal(0.0, float(max(0, n - 1)), True,
+                     _EMPTY, const, frozenset({dim}))]
+
+    def _prim_broadcast_in_dim(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        bd = tuple(eqn.params["broadcast_dimensions"])
+        in_shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+        out_shape = tuple(eqn.outvars[0].aval.shape)
+        mapped = dict(zip(range(len(in_shape)), bd))
+        const = {d for d in range(len(out_shape)) if d not in bd}
+        onehot, distinct = set(), set()
+        for i, d in mapped.items():
+            expanded = in_shape[i] == 1 and out_shape[d] > 1
+            if expanded or i in a.const:
+                const.add(d)
+            if not expanded:
+                if i in a.onehot:
+                    onehot.add(d)
+                if i in a.distinct:
+                    distinct.add(d)
+        return [IVal(a.lo, a.hi, a.integral, frozenset(onehot),
+                     frozenset(const), frozenset(distinct))]
+
+    def _prim_transpose(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        perm = tuple(eqn.params["permutation"])
+
+        def remap(axes):
+            return frozenset(j for j, i in enumerate(perm) if i in axes)
+
+        return [IVal(a.lo, a.hi, a.integral, remap(a.onehot),
+                     remap(a.const), remap(a.distinct))]
+
+    def _prim_concatenate(self, eqn, env):
+        return [join_all(
+            self._read(env, v) for v in eqn.invars
+        ).plain()]
+
+    def _prim_pad(self, eqn, env):
+        op, pad_val = (self._read(env, v) for v in eqn.invars)
+        return [join(op, pad_val).plain()]
+
+    def _prim_dynamic_update_slice(self, eqn, env):
+        op, upd = (self._read(env, v) for v in eqn.invars[:2])
+        return [join(op, upd).plain()]
+
+    def _prim_gather(self, eqn, env):
+        return [self._read(env, eqn.invars[0]).plain()]
+
+    def _prim_scatter(self, eqn, env):
+        op = self._read(env, eqn.invars[0])
+        upd = self._read(env, eqn.invars[2])
+        return [join(op, upd).plain()]
+
+    def _prim_scatter_add(self, eqn, env):
+        op = self._read(env, eqn.invars[0])
+        upd = self._read(env, eqn.invars[2])
+        n = max(1, _aval_size(eqn.invars[2]))
+        return [IVal(
+            op.lo + min(0.0, upd.lo * n), op.hi + max(0.0, upd.hi * n),
+            op.integral and upd.integral,
+        )]
+
+    def _prim_slice(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        start = tuple(eqn.params["start_indices"])
+        limit = tuple(eqn.params["limit_indices"])
+        strides = eqn.params.get("strides") or (1,) * len(start)
+        # Subsetting preserves per-axis structure; a static slice along
+        # the column axis of a column-partitioned array refines the
+        # interval to the selected columns (meta[:, V:V+1] etc.).
+        if (
+            a.cols is not None and len(start) == 2 and strides[-1] == 1
+            and 0 <= start[1] < limit[1] <= len(a.cols)
+        ):
+            sel = a.cols[start[1]:limit[1]]
+            j = join_all(sel)
+            return [IVal(
+                j.lo, j.hi, j.integral, a.onehot, a.const, a.distinct,
+                sel if len(sel) > 1 else None,
+            )]
+        return [dataclasses.replace(a, cols=None)]
+
+    def _prim_program_id(self, eqn, env):
+        return [IVal(0.0, INF, True)]
+
+    def _prim_num_programs(self, eqn, env):
+        return [IVal(1.0, INF, True)]
+
+    # -- reductions --------------------------------------------------------
+
+    def _prim_reduce_sum(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        axes = tuple(eqn.params.get("axes") or ())
+        shape = tuple(eqn.invars[0].aval.shape)
+        if any(ax in a.onehot for ax in axes):
+            # One nonzero per fiber along a onehot axis: the sum over
+            # the remaining reduced axes counts at most one term each.
+            n = 1
+            skipped = False
+            for ax in axes:
+                if not skipped and ax in a.onehot:
+                    skipped = True
+                    continue
+                n *= int(shape[ax])
+        else:
+            n = 1
+            for ax in axes:
+                n *= int(shape[ax])
+            if not axes:
+                n = max(1, _aval_size(eqn.invars[0])
+                        // max(1, _aval_size(eqn.outvars[0])))
+        n = max(1, n)
+        return [IVal(min(a.lo * n, min(a.lo, 0.0)),
+                     max(a.hi * n, max(a.hi, 0.0)), a.integral)]
+
+    def _prim_cumsum(self, eqn, env):
+        a = self._read(env, eqn.invars[0])
+        axis = eqn.params.get("axis", 0)
+        n = int(eqn.invars[0].aval.shape[axis])
+        if axis in a.onehot:
+            n = 1
+        return [IVal(min(a.lo * n, min(a.lo, 0.0)),
+                     max(a.hi * n, max(a.hi, 0.0)), a.integral)]
+
+    def _prim_reduce_max(self, eqn, env):
+        return [self._read(env, eqn.invars[0]).plain()]
+
+    def _prim_reduce_min(self, eqn, env):
+        return [self._read(env, eqn.invars[0]).plain()]
+
+    def _prim_argmax(self, eqn, env):
+        axes = eqn.params.get("axes", (0,))
+        n = 1
+        for ax in axes:
+            n *= int(eqn.invars[0].aval.shape[ax])
+        return [IVal(0.0, float(max(0, n - 1)), True)]
+
+    def _prim_argmin(self, eqn, env):
+        return self._prim_argmax(eqn, env)
+
+    # -- the dot itself ----------------------------------------------------
+
+    def _prim_dot_general(self, eqn, env):
+        a, b = (self._read(env, v) for v in eqn.invars[:2])
+        self.dots[id(eqn)] = DotRecord(
+            eqn=eqn, lhs=a, rhs=b, path=self.path,
+            where=source_location(eqn),
+        )
+        (lhs_contract, rhs_contract), _ = eqn.params["dimension_numbers"]
+        # A contracted axis that is onehot on EITHER operand contributes
+        # at most one nonzero product to each output sum — the one-hot
+        # gather/permute idiom, whose result is bounded by the plain
+        # operand product rather than K times it.
+        k = 1
+        for la, ra in zip(lhs_contract, rhs_contract):
+            if la in a.onehot or ra in b.onehot:
+                continue
+            k *= int(eqn.invars[0].aval.shape[la])
+        prod = interval_mul(a, b)
+        if not prod.bounded:
+            return [TOP]
+        return [IVal(
+            min(prod.lo * k, min(prod.lo, 0.0)),
+            max(prod.hi * k, max(prod.hi, 0.0)),
+            a.integral and b.integral,
+        )]
+
+
+_IDENTITY_PRIMS = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "expand_dims",
+    "rev", "copy", "dynamic_slice", "reduce_precision",
+    "stop_gradient", "device_put", "optimization_barrier", "real",
+    "copy_p", "sharding_constraint",
+})
+
+_BOOL_PRIMS = frozenset({
+    "ne", "lt", "le", "gt", "ge", "reduce_or", "reduce_and",
+    "is_finite",
+})
+
+_CALL_PRIMS = frozenset({
+    "pjit", "closed_call", "core_call", "xla_call", "named_call",
+})

@@ -1,0 +1,244 @@
+"""KI-2 static VMEM/HBM plan audit.
+
+KNOWN_ISSUES KI-2 is the memory discipline the tiled engines live by:
+every kernel build goes through a VMEM *pre-filter* (a loose static
+estimate screened against a per-kernel budget) before the
+authoritative compile probe, and the resident pool's TPU-padded bytes
+set the HBM trial ceiling.  Nothing at runtime re-checks that the
+resolved plans actually satisfy their own budgets — an estimate edit,
+a budget bump, or a planner change can silently ship a plan the
+pre-filter would reject.  This pass re-derives everything statically:
+
+* **Plan-vs-budget**: for each engine family (verdict / rebuild /
+  fused, global and party-sharded), the resolved block size must
+  divide its pool and its VMEM estimate must fit the budget it was
+  screened against (``_TILED_PREFILTER_BYTES`` / ``_REBUILD_BUDGET`` /
+  ``_FUSED_BUDGET``).  An explicit ``cfg.tiled_block`` override that
+  busts the budget is flagged — off-TPU resolution honors it
+  unchecked, so the lint is the only gate.
+* **HBM trial ceiling**: the planning model
+  ``floor((HBM - reserve) / (occupancy * padded_pool_bytes))`` with
+  the v5e constants below; for the north-star config the prediction
+  must stay inside the measured batch band (the model is calibrated
+  against hardware sweeps — drifting out of band means the padding
+  model or the occupancy factor no longer describes the machine).
+* **Probe hygiene**: resolving plans off-TPU must never fire a compile
+  probe (``PROBE_STATS`` delta) — interpret-mode planning is pure
+  arithmetic by design.
+
+Findings mean the *plan* is statically inconsistent with its own
+budget model; notes carry the derived numbers (ceilings, roofline
+shares) so the lint doubles as a capacity report.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from qba_tpu.analysis.findings import Finding, Report
+from qba_tpu.config import QBAConfig
+
+#: v5e HBM planning constants (docs/PERF.md): usable HBM, runtime
+#: reserve, and the occupancy factor covering the donated pool plus the
+#: transient successor generation the rebuild writes.
+HBM_BYTES = int(15.75 * 2**30)
+HBM_RESERVE = int(1.5 * 2**30)
+POOL_OCCUPANCY = 1.5
+
+#: The north-star config and its measured max-trials band on v5e —
+#: the calibration anchor for the ceiling model.
+NORTH_STAR = (33, 64, 10)
+NORTH_STAR_CEILING_BAND = (1088, 1151)
+
+
+def trial_ceiling(cfg: QBAConfig, hbm_bytes: int = HBM_BYTES) -> int:
+    """Predicted max concurrent trials before the pool exhausts HBM."""
+    from qba_tpu.ops.round_kernel_tiled import pool_bytes
+
+    per_trial = pool_bytes(cfg)["padded_bytes"]
+    return int((hbm_bytes - HBM_RESERVE) // (POOL_OCCUPANCY * per_trial))
+
+
+def _audit_plans(cfg: QBAConfig, n_recv: int | None, report: Report) -> None:
+    from qba_tpu.ops.round_kernel_tiled import (
+        _FUSED_BUDGET,
+        _REBUILD_BUDGET,
+        _TILED_PREFILTER_BYTES,
+        _block_estimate,
+        _fused_estimate,
+        _rebuild_estimate,
+        block_candidates,
+        fused_candidates,
+        rebuild_candidates,
+        resolve_fused_block,
+        resolve_rebuild_block,
+        resolve_tiled_block,
+        resolve_trial_pack,
+        resolve_verdict_variant,
+    )
+
+    prefix = "spmd/" if n_recv is not None else ""
+    n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
+    n_pool = cfg.n_lieutenants * cfg.slots
+    n_out = n_rv * cfg.slots
+    shape = f"(n_parties={cfg.n_parties}, size_l={cfg.size_l})"
+
+    def check(path, cands, pool, est_fn, budget, budget_name,
+              resolved, demote_msg):
+        # 1. Pre-filter self-consistency: every candidate the planner
+        #    would hand the TPU compile probe must fit the budget it
+        #    was screened against and tile the pool exactly.
+        for b in cands:
+            est = est_fn(b)
+            if est > budget:
+                report.findings.append(Finding(
+                    ki="KI-2", check="vmem-plan", path=path,
+                    message=(
+                        f"candidate block {b} at {shape}: VMEM estimate "
+                        f"{est / 2**20:.1f} MiB exceeds {budget_name} "
+                        f"({budget / 2**20:.0f} MiB) — the candidate list "
+                        "violates its own pre-filter"
+                    ),
+                ))
+            if pool % b != 0:
+                report.findings.append(Finding(
+                    ki="KI-2", check="vmem-plan", path=path,
+                    message=(
+                        f"candidate block {b} does not divide its pool "
+                        f"({pool}) at {shape}: the grid would drop or "
+                        "double-visit packets"
+                    ),
+                ))
+        if not cands:
+            report.notes.append(f"{path}: {demote_msg} at {shape}")
+        else:
+            b0 = cands[0]
+            report.notes.append(
+                f"{path}: TPU plan probes block {b0} first, estimate "
+                f"{est_fn(b0) / 2**20:.1f} MiB within {budget_name} "
+                f"{budget / 2**20:.0f} MiB"
+            )
+        # 2. Whatever this backend resolved must still tile the pool
+        #    (interpret mode skips the budget, never the grid math).
+        if resolved is not None and pool % resolved != 0:
+            report.findings.append(Finding(
+                ki="KI-2", check="vmem-plan", path=path,
+                message=(
+                    f"resolved block {resolved} does not divide its pool "
+                    f"({pool}) at {shape}"
+                ),
+            ))
+        # 3. An explicit tiled_block override is honored unchecked
+        #    off-TPU — flag it when it busts the TPU budget, because
+        #    CPU tests would then exercise a plan the TPU rejects.
+        if (
+            cfg.tiled_block is not None and pool % cfg.tiled_block == 0
+            and est_fn(cfg.tiled_block) > budget
+        ):
+            report.findings.append(Finding(
+                ki="KI-2", check="vmem-plan", path=path,
+                message=(
+                    f"explicit tiled_block={cfg.tiled_block} at {shape}: "
+                    f"VMEM estimate "
+                    f"{est_fn(cfg.tiled_block) / 2**20:.1f} MiB exceeds "
+                    f"{budget_name} ({budget / 2**20:.0f} MiB) — off-TPU "
+                    "runs honor the override unchecked, so tests no "
+                    "longer model a plan the TPU would accept"
+                ),
+            ))
+
+    variant = resolve_verdict_variant(cfg, n_recv=n_recv)
+    blk_v = resolve_tiled_block(cfg, n_recv=n_recv)
+    check(
+        f"{prefix}pallas_tiled/verdict",
+        block_candidates(cfg, n_recv, variant), n_pool,
+        lambda b: _block_estimate(cfg, b, n_recv, variant),
+        _TILED_PREFILTER_BYTES, "_TILED_PREFILTER_BYTES",
+        blk_v, "no verdict block fits; engine unavailable on TPU",
+    )
+    check(
+        f"{prefix}pallas_tiled/rebuild",
+        rebuild_candidates(cfg, n_recv), n_out,
+        lambda b: _rebuild_estimate(cfg, b, n_recv),
+        _REBUILD_BUDGET, "_REBUILD_BUDGET",
+        resolve_rebuild_block(cfg, n_recv=n_recv),
+        "demotes to the XLA rebuild on TPU",
+    )
+    pack = resolve_trial_pack(cfg) if n_recv is None else 1
+    check(
+        f"{prefix}pallas_fused/round",
+        fused_candidates(cfg, n_recv, blk_v, pack), n_out,
+        lambda b: _fused_estimate(cfg, b, blk_v, n_recv, pack),
+        _FUSED_BUDGET, "_FUSED_BUDGET",
+        resolve_fused_block(cfg, n_recv=n_recv, trial_pack=pack),
+        "demotes to the two-kernel tiled path on TPU",
+    )
+
+
+def check_memory(cfg: QBAConfig) -> Report:
+    """Run the KI-2 audit for one config (global + 2-way sharded)."""
+    from qba_tpu.ops.round_kernel_tiled import (
+        PROBE_STATS,
+        pool_bytes,
+        roofline_model,
+    )
+
+    report = Report()
+    probes_before = PROBE_STATS["compile_probes"]
+    _audit_plans(cfg, None, report)
+    if cfg.n_lieutenants % 2 == 0:
+        _audit_plans(cfg, cfg.n_lieutenants // 2, report)
+
+    pb = pool_bytes(cfg)
+    ceiling = trial_ceiling(cfg)
+    report.notes.append(
+        f"hbm-ceiling: padded pool {pb['padded_bytes']} B/trial "
+        f"(pad ratio {pb['pad_ratio']}) -> predicted max "
+        f"~{ceiling} concurrent trials on v5e"
+    )
+    if ceiling < 1:
+        report.findings.append(Finding(
+            ki="KI-2", check="hbm-ceiling", path="pallas_tiled",
+            message=(
+                f"padded pool {pb['padded_bytes']} B/trial cannot fit a "
+                f"single trial under the v5e model ({HBM_BYTES} B HBM, "
+                f"{HBM_RESERVE} B reserve, occupancy {POOL_OCCUPANCY})"
+            ),
+        ))
+    key = (cfg.n_parties, cfg.size_l, cfg.n_dishonest)
+    if key == NORTH_STAR:
+        lo, hi = NORTH_STAR_CEILING_BAND
+        if not (lo <= ceiling <= hi):
+            report.findings.append(Finding(
+                ki="KI-2", check="hbm-ceiling", path="pallas_tiled",
+                message=(
+                    f"north-star trial-ceiling prediction {ceiling} left "
+                    f"the measured v5e band [{lo}, {hi}]: the padding "
+                    "model or occupancy factor no longer matches "
+                    "hardware (recalibrate against a measured sweep "
+                    "before trusting batch sizing)"
+                ),
+            ))
+        else:
+            report.notes.append(
+                f"hbm-ceiling: north-star prediction {ceiling} inside "
+                f"the measured band [{lo}, {hi}]"
+            )
+    rf = roofline_model(cfg)
+    report.notes.append(
+        f"roofline: {rf['per_round_per_trial_bytes']} B/round/trial "
+        f"upper bound, pool share {rf['pool_share']}"
+    )
+
+    probes_fired = PROBE_STATS["compile_probes"] - probes_before
+    if jax.default_backend() != "tpu" and probes_fired > 0:
+        report.findings.append(Finding(
+            ki="KI-2", check="probe-hygiene", path="pallas_tiled",
+            message=(
+                f"{probes_fired} compile probe(s) fired while resolving "
+                "plans off-TPU: interpret-mode planning must be pure "
+                "arithmetic (PROBE_STATS)"
+            ),
+        ))
+    report.stats["memory_probes_fired"] = probes_fired
+    return report

@@ -1,0 +1,381 @@
+"""Trace catalog: every engine/kernel build path as a jaxpr + seeds.
+
+Each entry pairs ``jax.make_jaxpr`` of one round-step callable — built
+exactly the way the engines build it (``rounds/engine.py``,
+``parallel/spmd.py``) — with per-operand seed intervals derived from
+the protocol's own invariants:
+
+* evidence values live in ``[-1, w-1]`` (SENTINEL plus particle-list
+  draws from ``[0, w)``);
+* row lengths in ``[0, size_l]``; evidence counts in ``[0, max_l]``;
+* order values in ``[0, w]`` (mailbox ``v < w``; the oob test
+  tolerates ``<= w``); forged ``rand_v < n_parties + 1 <= w``;
+* attack draws are 4-bit actions (``[0, 15]``); honesty/acceptance/
+  P-mask/sent columns are 0/1;
+* pool meta packs ``(count, v, sent, cell)`` with cell ids below the
+  pool capacity ``n_lieutenants * slots``;
+* the all-receiver tables carry ``li + 1`` (``[1, w]``) and
+  ``li^2 - 1`` (``[-1, (w-1)^2 - 1]``).
+
+Operand arrays are built with the repo's own packing helpers
+(``pack_mailbox``, ``empty_pool``, ``make_verdict_tables``, ...) so
+the catalog cannot drift from the layouts the kernels define; block
+plans and variants come from the same ``resolve_*`` probes the engines
+call.  A path whose plan resolves to None (probe demotion) is recorded
+as a note, not silently dropped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from qba_tpu.analysis.intervals import BOOL, IVal
+from qba_tpu.config import QBAConfig
+
+
+@dataclasses.dataclass
+class TracedPath:
+    """One build path: its closed jaxpr plus input seed intervals."""
+
+    name: str  # e.g. "pallas_tiled/verdict" or "spmd/pallas_fused"
+    closed_jaxpr: object
+    seeds: list  # IVal per flattened jaxpr input
+
+
+def _seed_bank(cfg: QBAConfig) -> dict:
+    w = cfg.w
+    cap = cfg.n_lieutenants * cfg.slots
+    return {
+        "round": IVal(1, cfg.n_rounds, True),
+        "vals": IVal(-1, w, True),
+        "lens": IVal(0, cfg.size_l, True),
+        "count": IVal(0, cfg.max_l, True),
+        "v": IVal(0, w, True),
+        "bit": BOOL,
+        "li": IVal(0, w - 1, True),
+        "attack": IVal(0, 15, True),
+        "rand_v": IVal(0, w, True),
+        # Pool meta packs heterogeneous columns [cap, 4]; the per-column
+        # intervals (ops/round_kernel_tiled.py META_* layout) let the
+        # interpreter refine static column slices instead of tainting
+        # the v column with the cell-id bound.
+        "meta": IVal(
+            0, max(cap - 1, w, cfg.max_l), True,
+            cols=(
+                IVal(0, cfg.max_l, True),   # META_COUNT
+                IVal(0, w, True),           # META_V
+                BOOL,                       # META_SENT
+                IVal(0, cap - 1, True),     # META_CELL
+            ),
+        ),
+        "tables": (
+            IVal(1, w, True),                   # t_li1 = li + 1
+            IVal(-1, (w - 1) ** 2 - 1, True),   # t_li2 = li^2 - 1
+            BOOL, BOOL, BOOL,                   # t_oob, t_lh, t_lh2
+        ),
+    }
+
+
+def _flatten_seeds(seeds_tree) -> list:
+    return jax.tree_util.tree_leaves(
+        seeds_tree, is_leaf=lambda x: isinstance(x, IVal)
+    )
+
+
+def _trace(name: str, fn, args, seeds_tree) -> TracedPath:
+    closed = jax.make_jaxpr(fn)(*args)
+    seeds = _flatten_seeds(seeds_tree)
+    n_in = len(closed.jaxpr.invars)
+    if len(seeds) != n_in:
+        raise RuntimeError(
+            f"{name}: seed tree has {len(seeds)} leaves but the traced "
+            f"jaxpr takes {n_in} inputs — the catalog drifted from the "
+            "builder's calling convention"
+        )
+    return TracedPath(name=name, closed_jaxpr=closed, seeds=seeds)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _mailbox_args(cfg: QBAConfig, sb):
+    from qba_tpu.rounds.mailbox import empty_mailbox
+
+    mb = empty_mailbox(cfg)
+    mb_seeds = type(mb)(
+        vals=sb["vals"], lens=sb["lens"], count=sb["count"],
+        p_mask=sb["bit"], v=sb["v"], sent=sb["bit"],
+    )
+    return mb, mb_seeds
+
+
+def _packed_args(cfg: QBAConfig, sb):
+    from qba_tpu.ops.round_kernel import pack_mailbox
+    from qba_tpu.rounds.mailbox import empty_mailbox
+
+    n_pk = cfg.n_lieutenants * cfg.slots
+    packed = pack_mailbox(empty_mailbox(cfg), n_pk, cfg.max_l, cfg.size_l)
+    seeds = (sb["vals"], sb["lens"], sb["count"], sb["bit"], sb["v"],
+             sb["bit"])
+    return packed, seeds
+
+
+def _pool_args(cfg: QBAConfig, sb):
+    from qba_tpu.ops.round_kernel_tiled import empty_pool
+
+    pool = empty_pool(cfg)
+    seeds = (sb["vals"], sb["lens"], sb["bit"], sb["meta"])
+    return pool, seeds
+
+
+def _draws(cfg: QBAConfig, n_rv: int):
+    n_pk = cfg.n_lieutenants * cfg.slots
+    z = jnp.zeros((n_pk, n_rv), jnp.int32)
+    return (z, z, z)
+
+
+def _li_arg(cfg: QBAConfig, variant: str, sb):
+    """The verdict/fused kernels' li operand for ``variant`` plus its
+    seed tree: the receiver-table tuple for "allrecv", the li matrix
+    for the group family."""
+    li = jnp.zeros((cfg.n_lieutenants, cfg.size_l), jnp.int32)
+    if variant == "allrecv":
+        from qba_tpu.ops.round_kernel_tiled import make_verdict_tables
+
+        return make_verdict_tables(cfg, li), sb["tables"]
+    return li, sb["li"]
+
+
+def trace_xla(cfg: QBAConfig) -> list[TracedPath]:
+    """The pure-XLA receiver round (``run_rounds_xla``'s vmapped body)."""
+    from qba_tpu.adversary import sample_attacks_round
+    from qba_tpu.rounds.engine import receiver_round
+
+    sb = _seed_bank(cfg)
+    mb, mb_seeds = _mailbox_args(cfg, sb)
+    d = sample_attacks_round(cfg, jax.random.PRNGKey(0))
+    draws = tuple(x[:, 0] for x in d)
+    args = (
+        jnp.asarray(1, jnp.int32),            # round_idx
+        draws,
+        jnp.asarray(0, jnp.int32),            # receiver_idx
+        jnp.zeros((cfg.w,), bool),            # vi_row
+        jnp.zeros((cfg.size_l,), jnp.int32),  # li
+        mb,
+        jnp.ones((cfg.n_parties + 1,), bool),  # honest
+    )
+    seeds = (
+        sb["round"], (sb["attack"], sb["rand_v"], sb["bit"]),
+        IVal(0, cfg.n_lieutenants - 1, True), sb["bit"], sb["li"],
+        mb_seeds, sb["bit"],
+    )
+    return [_trace(
+        "xla/receiver_round",
+        lambda r, dr, ri, vi, li, mb, h: receiver_round(
+            cfg, r, dr, ri, vi, li, mb, h
+        ),
+        args, seeds,
+    )]
+
+
+def trace_pallas(
+    cfg: QBAConfig, n_recv: int | None = None, out_vma=None,
+) -> list[TracedPath]:
+    """The monolithic round-step kernel, global or party-sharded.
+    ``out_vma`` is forwarded to the builder so the KI-1 threading audit
+    (:mod:`qba_tpu.analysis.vma`) can inject a recorded sentinel."""
+    from qba_tpu.ops.round_kernel import build_round_step, honest_packets
+
+    sb = _seed_bank(cfg)
+    n_lieu = cfg.n_lieutenants
+    n_rv = n_recv if n_recv is not None else n_lieu
+    step = build_round_step(
+        cfg, interpret=_interpret(), n_recv=n_recv, out_vma=out_vma,
+    )
+    packed, packed_seeds = _packed_args(cfg, sb)
+    honest_pk = honest_packets(jnp.ones((cfg.n_parties + 1,), bool), cfg)
+    tail = (
+        jnp.zeros((n_rv, cfg.size_l), jnp.int32),  # li block
+        jnp.zeros((n_rv, cfg.w), jnp.int32),       # vi block
+        honest_pk, *_draws(cfg, n_rv),
+    )
+    tail_seeds = (sb["li"], sb["bit"], sb["bit"], sb["attack"],
+                  sb["rand_v"], sb["bit"])
+    r = jnp.asarray(1, jnp.int32)
+    if n_recv is None:
+        return [_trace(
+            "pallas/round_step", step, (r, *packed, *tail),
+            (sb["round"], packed_seeds, tail_seeds),
+        )]
+    off = jnp.asarray(0, jnp.int32)
+    off_seed = IVal(0, n_lieu - n_rv, True)
+    return [_trace(
+        "spmd/pallas/round_step", step, (r, off, *packed, *tail),
+        (sb["round"], off_seed, packed_seeds, tail_seeds),
+    )]
+
+
+def trace_tiled(cfg: QBAConfig, n_recv: int | None = None, out_vma=None):
+    """The packet-tiled verdict + rebuild kernel pair.  Returns
+    ``(paths, notes)`` — a probe-demoted rebuild plan becomes a note."""
+    from qba_tpu.ops.round_kernel_tiled import (
+        build_rebuild_kernel,
+        build_verdict_kernel,
+        honest_cells,
+        resolve_rebuild_block,
+        resolve_tiled_block,
+        resolve_verdict_variant,
+    )
+
+    sb = _seed_bank(cfg)
+    notes: list[str] = []
+    n_lieu = cfg.n_lieutenants
+    n_rv = n_recv if n_recv is not None else n_lieu
+    prefix = "spmd/" if n_recv is not None else ""
+    variant = resolve_verdict_variant(cfg, n_recv=n_recv)
+    blk = resolve_tiled_block(cfg, n_recv=n_recv)
+    if blk is None:
+        return [], [f"{prefix}pallas_tiled: no block plan at "
+                    f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}); "
+                    "path skipped"]
+    verdict = build_verdict_kernel(
+        cfg, blk, interpret=_interpret(), n_recv=n_recv, variant=variant,
+        out_vma=out_vma,
+    )
+    pool, pool_seeds = _pool_args(cfg, sb)
+    hc = honest_cells(jnp.ones((cfg.n_parties + 1,), bool), cfg)
+    li_mat = jnp.zeros((n_rv, cfg.size_l), jnp.int32)
+    li_arg, li_seed = (
+        _li_arg(cfg, variant, sb) if n_recv is None else (li_mat, sb["li"])
+    )
+    vi = jnp.zeros((n_rv, cfg.w), jnp.int32)
+    draws = _draws(cfg, n_rv)
+    r = jnp.asarray(1, jnp.int32)
+    off = jnp.asarray(0, jnp.int32)
+    off_seed = IVal(0, n_lieu - n_rv, True)
+    if n_recv is None:
+        v_args = (r, *pool, li_arg, vi, hc, *draws)
+        v_seeds = (sb["round"], pool_seeds, li_seed, sb["bit"], sb["bit"],
+                   sb["attack"], sb["rand_v"], sb["bit"])
+    else:
+        v_args = (r, off, *pool, li_mat, vi, hc, *draws)
+        v_seeds = (sb["round"], off_seed, pool_seeds, sb["li"], sb["bit"],
+                   sb["bit"], sb["attack"], sb["rand_v"], sb["bit"])
+    paths = [_trace(f"{prefix}pallas_tiled/verdict", verdict, v_args, v_seeds)]
+
+    blk_d = resolve_rebuild_block(cfg, n_recv=n_recv)
+    if blk_d is None:
+        notes.append(
+            f"{prefix}pallas_tiled: rebuild kernel demoted to the XLA "
+            f"rebuild at (n_parties={cfg.n_parties}, size_l={cfg.size_l})"
+        )
+        return paths, notes
+    rebuild = build_rebuild_kernel(
+        cfg, blk_d, interpret=_interpret(), n_recv=n_recv, out_vma=out_vma,
+    )
+    acc_aval = jax.eval_shape(verdict, *v_args)[0]
+    acc = jnp.zeros(acc_aval.shape, acc_aval.dtype)
+    if n_recv is None:
+        rb_args = (r, *pool, li_mat, acc, draws[0], draws[1], hc)
+        rb_seeds = (sb["round"], pool_seeds, sb["li"], sb["bit"],
+                    sb["attack"], sb["rand_v"], sb["bit"])
+    else:
+        rb_args = (r, off, *pool, li_mat, acc, draws[0], draws[1], hc)
+        rb_seeds = (sb["round"], off_seed, pool_seeds, sb["li"], sb["bit"],
+                    sb["attack"], sb["rand_v"], sb["bit"])
+    paths.append(
+        _trace(f"{prefix}pallas_tiled/rebuild", rebuild, rb_args, rb_seeds)
+    )
+    return paths, notes
+
+
+def trace_fused(cfg: QBAConfig, n_recv: int | None = None, out_vma=None):
+    """The fused single-launch round kernel.  Returns ``(paths, notes)``."""
+    from qba_tpu.ops.round_kernel_tiled import (
+        build_fused_round_kernel,
+        honest_cells,
+        resolve_fused_block,
+        resolve_tiled_block,
+        resolve_verdict_variant,
+    )
+
+    sb = _seed_bank(cfg)
+    n_lieu = cfg.n_lieutenants
+    n_rv = n_recv if n_recv is not None else n_lieu
+    prefix = "spmd/" if n_recv is not None else ""
+    variant = resolve_verdict_variant(cfg, n_recv=n_recv)
+    blk_v = resolve_tiled_block(cfg, n_recv=n_recv)
+    blk_d = resolve_fused_block(cfg, n_recv=n_recv)
+    if blk_v is None or blk_d is None:
+        return [], [
+            f"{prefix}pallas_fused: no fused plan at (n_parties="
+            f"{cfg.n_parties}, size_l={cfg.size_l}); demotes to the "
+            "two-kernel tiled path"
+        ]
+    fused = build_fused_round_kernel(
+        cfg, blk_d, blk_v, interpret=_interpret(), n_recv=n_recv,
+        variant=variant, out_vma=out_vma,
+    )
+    pool, pool_seeds = _pool_args(cfg, sb)
+    hc = honest_cells(jnp.ones((cfg.n_parties + 1,), bool), cfg)
+    li_mat = jnp.zeros((n_rv, cfg.size_l), jnp.int32)
+    vi = jnp.zeros((n_rv, cfg.w), jnp.int32)
+    draws = _draws(cfg, n_rv)
+    r = jnp.asarray(1, jnp.int32)
+    if n_recv is None:
+        li_full = jnp.zeros((n_lieu, cfg.size_l), jnp.int32)
+        li_arg, li_seed = _li_arg(cfg, variant, sb)
+        args = (r, *pool, li_full, li_arg, vi, hc, *draws)
+        seeds = (sb["round"], pool_seeds, sb["li"], li_seed, sb["bit"],
+                 sb["bit"], sb["attack"], sb["rand_v"], sb["bit"])
+    else:
+        off = jnp.asarray(0, jnp.int32)
+        args = (r, off, *pool, li_mat, li_mat, vi, hc, *draws)
+        seeds = (sb["round"], IVal(0, n_lieu - n_rv, True), pool_seeds,
+                 sb["li"], sb["li"], sb["bit"], sb["bit"], sb["attack"],
+                 sb["rand_v"], sb["bit"])
+    return [_trace(f"{prefix}pallas_fused/round", fused, args, seeds)], []
+
+
+def trace_paths(cfg: QBAConfig, engines=None):
+    """Trace every requested build path.  ``engines`` is an iterable of
+    {"xla", "pallas", "pallas_tiled", "pallas_fused", "spmd"}; None
+    traces everything.  Returns ``(paths, notes)``."""
+    engines = set(engines) if engines is not None else {
+        "xla", "pallas", "pallas_tiled", "pallas_fused", "spmd",
+    }
+    paths: list[TracedPath] = []
+    notes: list[str] = []
+    if "xla" in engines:
+        paths += trace_xla(cfg)
+    if "pallas" in engines:
+        paths += trace_pallas(cfg)
+    if "pallas_tiled" in engines:
+        p, n = trace_tiled(cfg)
+        paths += p
+        notes += n
+    if "pallas_fused" in engines:
+        p, n = trace_fused(cfg)
+        paths += p
+        notes += n
+    if "spmd" in engines:
+        n_lieu = cfg.n_lieutenants
+        if n_lieu % 2 == 0:
+            n_local = n_lieu // 2
+            paths += trace_pallas(cfg, n_recv=n_local)
+            p, n = trace_tiled(cfg, n_recv=n_local)
+            paths += p
+            notes += n
+            p, n = trace_fused(cfg, n_recv=n_local)
+            paths += p
+            notes += n
+        else:
+            notes.append(
+                f"spmd: n_lieutenants={n_lieu} not divisible by 2; "
+                "party-sharded variants skipped"
+            )
+    return paths, notes

@@ -1,0 +1,245 @@
+"""KI-1 vma-threading pass.
+
+KNOWN_ISSUES KI-1 records the round-4 regression this pass
+mechanizes: the party-sharded kernel builders *accepted* an
+``out_vma`` argument but hard-coded ``None`` into their
+``ShapeDtypeStruct`` s, so shard_map's replication checker either
+rejected every sharded build or ran with dead declarations — and
+nothing caught it because the machinery failed silent.  Three checks:
+
+1. **Builder threading (dynamic).**  Monkeypatch the two vma plumbing
+   helpers (:func:`qba_tpu.ops.round_kernel.vma_struct` /
+   ``promote_vma``) with recorders that behave like the checker-off
+   path (so the build traces on any backend), build every sharded
+   builder through the same call paths :mod:`qba_tpu.analysis.traces`
+   uses with a sentinel ``out_vma``, and require the sentinel to reach
+   *both* helpers.  A builder that drops, shadows, or defaults its
+   ``out_vma`` reverts to the round-4 bug and fails here.
+
+2. **Call-site audit (static AST).**  Every call to a kernel builder
+   in ``qba_tpu/parallel/spmd.py`` must pass an ``out_vma=`` keyword
+   whose value is not the literal ``None`` — re-introducing
+   ``out_vma=None`` at a sharded call site is the exact KI-1 revert.
+
+3. **Policy audit.**  ``check_vma`` resolution must keep its contract:
+   ON for every engine on real TPU, OFF in kernel interpret mode,
+   ``QBA_TILED_CHECK_VMA=1``/``0`` forcing either way and any other
+   value failing loudly (:func:`qba_tpu.parallel.spmd._tiled_check_vma`
+   / ``_resolve_check_vma``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+import jax
+import jax.numpy as jnp
+
+from qba_tpu.analysis.findings import Finding, Report
+from qba_tpu.config import QBAConfig
+
+#: Builders that take part in sharded builds and must thread out_vma.
+BUILDER_NAMES = (
+    "build_round_step",
+    "build_verdict_kernel",
+    "build_rebuild_kernel",
+    "build_fused_round_kernel",
+)
+
+_SENTINEL = frozenset({"__qba_lint_axis__"})
+
+
+def _check_builder_threading(cfg: QBAConfig) -> Report:
+    """Check 1: a sentinel ``out_vma`` injected at each builder must
+    reach both vma plumbing helpers during the build."""
+    import qba_tpu.ops.round_kernel as rk
+    from qba_tpu.analysis import traces
+
+    report = Report()
+    seen: dict[str, list] = {"vma_struct": [], "promote_vma": []}
+    orig_struct, orig_promote = rk.vma_struct, rk.promote_vma
+
+    def rec_struct(out_vma, dims, dt=jnp.int32):
+        seen["vma_struct"].append(out_vma)
+        return jax.ShapeDtypeStruct(dims, dt)
+
+    def rec_promote(out_vma, x):
+        seen["promote_vma"].append(out_vma)
+        return x
+
+    n_local = cfg.n_lieutenants // 2
+    builds = [
+        ("spmd/pallas/round_step",
+         lambda: traces.trace_pallas(cfg, n_recv=n_local, out_vma=_SENTINEL)),
+        ("spmd/pallas_tiled",
+         lambda: traces.trace_tiled(cfg, n_recv=n_local, out_vma=_SENTINEL)),
+        ("spmd/pallas_fused",
+         lambda: traces.trace_fused(cfg, n_recv=n_local, out_vma=_SENTINEL)),
+    ]
+    rk.vma_struct, rk.promote_vma = rec_struct, rec_promote
+    try:
+        for path, build in builds:
+            seen["vma_struct"].clear()
+            seen["promote_vma"].clear()
+            build()
+            for helper, calls in seen.items():
+                if not calls:
+                    report.findings.append(Finding(
+                        ki="KI-1", check="vma-threading", path=path,
+                        message=(
+                            f"builder never called {helper}() during a "
+                            "sharded build: the output-vma declaration "
+                            "machinery is disconnected (round-4 "
+                            "regression shape)"
+                        ),
+                    ))
+                elif _SENTINEL not in calls:
+                    got = sorted({repr(c) for c in calls})
+                    report.findings.append(Finding(
+                        ki="KI-1", check="vma-threading", path=path,
+                        message=(
+                            f"out_vma passed to the builder never reached "
+                            f"{helper}() (saw {got}): the declaration is "
+                            "dropped or shadowed on the way to pallas_call "
+                            "(round-4 regression: out_vma accepted but "
+                            "hard-coded None)"
+                        ),
+                    ))
+    finally:
+        rk.vma_struct, rk.promote_vma = orig_struct, orig_promote
+    report.stats["vma_builds_checked"] = len(builds)
+    return report
+
+
+def _iter_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else getattr(
+                fn, "attr", None
+            )
+            if name in BUILDER_NAMES:
+                yield name, node
+
+
+def check_spmd_call_sites(source_path: str | None = None) -> Report:
+    """Check 2: AST audit of the builder call sites in spmd.py."""
+    report = Report()
+    if source_path is None:
+        import qba_tpu.parallel.spmd as spmd_mod
+
+        source_path = spmd_mod.__file__
+    with open(source_path, encoding="utf-8") as fh:
+        src = fh.read()
+    tree = ast.parse(src, filename=source_path)
+    n_sites = 0
+    for name, call in _iter_calls(tree):
+        n_sites += 1
+        where = f"{source_path}:{call.lineno}"
+        kw = next((k for k in call.keywords if k.arg == "out_vma"), None)
+        if kw is None:
+            report.findings.append(Finding(
+                ki="KI-1", check="vma-call-site", path="parallel/spmd",
+                where=where,
+                message=(
+                    f"{name}(...) called without an out_vma= keyword: the "
+                    "sharded build silently loses its output-vma "
+                    "declaration"
+                ),
+            ))
+        elif isinstance(kw.value, ast.Constant) and kw.value.value is None:
+            report.findings.append(Finding(
+                ki="KI-1", check="vma-call-site", path="parallel/spmd",
+                where=where,
+                message=(
+                    f"{name}(..., out_vma=None) hard-codes the declaration "
+                    "off — the literal round-4 KI-1 bug; thread the mesh "
+                    "axes (vma_axes / tiled_out_vma) instead"
+                ),
+            ))
+    if n_sites == 0:
+        report.findings.append(Finding(
+            ki="KI-1", check="vma-call-site", path="parallel/spmd",
+            where=source_path,
+            message=(
+                "no kernel-builder call sites found in spmd.py — the AST "
+                "audit no longer matches the module layout; update "
+                "qba_tpu/analysis/vma.py"
+            ),
+        ))
+    report.stats["vma_call_sites_checked"] = n_sites
+    return report
+
+
+def _check_policy() -> Report:
+    """Check 3: the check_vma resolution contract."""
+    from qba_tpu.parallel.spmd import _resolve_check_vma, _tiled_check_vma
+
+    report = Report()
+    on_tpu = jax.default_backend() == "tpu"
+    saved = os.environ.get("QBA_TILED_CHECK_VMA")
+
+    def expect(desc: str, got, want) -> None:
+        if got != want:
+            report.findings.append(Finding(
+                ki="KI-1", check="vma-policy", path="parallel/spmd",
+                message=f"{desc}: resolved {got!r}, policy requires {want!r}",
+            ))
+
+    try:
+        os.environ.pop("QBA_TILED_CHECK_VMA", None)
+        expect("QBA_TILED_CHECK_VMA unset (default = on iff real TPU)",
+               _tiled_check_vma(), on_tpu)
+        for engine in ("pallas_tiled", "pallas_fused"):
+            expect(f"_resolve_check_vma({engine!r}) default",
+                   _resolve_check_vma(engine), on_tpu)
+        expect("_resolve_check_vma('pallas') (on iff real TPU)",
+               _resolve_check_vma("pallas"), on_tpu)
+        expect("_resolve_check_vma('xla') (always on: plain shard_map body)",
+               _resolve_check_vma("xla"), True)
+
+        os.environ["QBA_TILED_CHECK_VMA"] = "1"
+        expect("QBA_TILED_CHECK_VMA=1 (force on)", _tiled_check_vma(), True)
+        os.environ["QBA_TILED_CHECK_VMA"] = "0"
+        expect("QBA_TILED_CHECK_VMA=0 (force off)", _tiled_check_vma(), False)
+
+        os.environ["QBA_TILED_CHECK_VMA"] = "maybe"
+        try:
+            got = _tiled_check_vma()
+        except ValueError:
+            pass
+        else:
+            report.findings.append(Finding(
+                ki="KI-1", check="vma-policy", path="parallel/spmd",
+                message=(
+                    "QBA_TILED_CHECK_VMA='maybe' silently resolved to "
+                    f"{got!r}; an escape hatch must fail loudly on junk "
+                    "values (ValueError)"
+                ),
+            ))
+    finally:
+        if saved is None:
+            os.environ.pop("QBA_TILED_CHECK_VMA", None)
+        else:
+            os.environ["QBA_TILED_CHECK_VMA"] = saved
+    return report
+
+
+def check_vma(cfg: QBAConfig, sitewide: bool = True) -> Report:
+    """Run the KI-1 checks for one config.  The builder-threading check
+    is config-shaped; the call-site and policy audits are not, so a
+    matrix driver passes ``sitewide=False`` after the first config to
+    avoid triplicated findings and inflated site counts."""
+    report = Report()
+    if cfg.n_lieutenants % 2 == 0:
+        report.extend(_check_builder_threading(cfg))
+    else:
+        report.notes.append(
+            f"vma-threading: n_lieutenants={cfg.n_lieutenants} has no "
+            "2-way sharding; builder threading checked on another config"
+        )
+    if sitewide:
+        report.extend(check_spmd_call_sites())
+        report.extend(_check_policy())
+    return report

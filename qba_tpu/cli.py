@@ -13,6 +13,9 @@ config is explicit and validated (:class:`qba_tpu.config.QBAConfig`):
   convergence plot).
 * ``study`` — success-rate curve over a swept parameter (e.g. the
   security-parameter study in ``size_l``), optional plot.
+* ``lint``  — static KI-1/KI-2/KI-3 invariant check over every traced
+  kernel build path (:mod:`qba_tpu.analysis`, docs/ANALYSIS.md); the
+  CI gate.  Exit 1 when findings exist, 0 on a clean tree.
 """
 
 from __future__ import annotations
@@ -164,6 +167,28 @@ def _parser() -> argparse.ArgumentParser:
     sweep.add_argument(
         "--plot", metavar="PNG", default=None,
         help="write a Monte-Carlo convergence plot (requires matplotlib)",
+    )
+
+    lint = sub.add_parser(
+        "lint",
+        help="static KI-1/KI-2/KI-3 invariant check over every kernel "
+        "build path (docs/ANALYSIS.md); exit 1 on findings",
+    )
+    lint.add_argument(
+        "--engines", default=None, metavar="E1,E2,...",
+        help="restrict to these build paths "
+        "(xla,pallas,pallas_tiled,pallas_fused,spmd; default: all)",
+    )
+    lint.add_argument(
+        "--config", action="append", default=None, metavar="P,L,D",
+        dest="lint_configs",
+        help="lint one n_parties,size_l,n_dishonest triple instead of "
+        "the built-in matrix (repeatable)",
+    )
+    lint.add_argument(
+        "-v", "--verbose", action="store_true",
+        help="print notes (plan predictions, HBM ceilings) even when "
+        "there are findings",
     )
 
     study = sub.add_parser(
@@ -493,6 +518,30 @@ def _cmd_study(args: argparse.Namespace, out) -> int:
     return 0
 
 
+def _cmd_lint(args: argparse.Namespace, out) -> int:
+    from qba_tpu.analysis.driver import lint_configs, run_lint
+
+    engines = (
+        [e.strip() for e in args.engines.split(",") if e.strip()]
+        if args.engines else None
+    )
+    if args.lint_configs:
+        configs = []
+        for spec in args.lint_configs:
+            try:
+                p, l, d = (int(x) for x in spec.split(","))
+            except ValueError:
+                raise ValueError(
+                    f"--config wants n_parties,size_l,n_dishonest; got {spec!r}"
+                ) from None
+            configs.append((f"({p},{l},{d})", QBAConfig(p, l, d)))
+    else:
+        configs = lint_configs()
+    report = run_lint(configs=configs, engines=engines)
+    print(report.render(verbose=args.verbose), file=out)
+    return 0 if report.ok else 1
+
+
 def main(argv: Sequence[str] | None = None, out=None) -> int:
     out = out if out is not None else sys.stdout
     args = _parser().parse_args(argv)
@@ -508,6 +557,8 @@ def main(argv: Sequence[str] | None = None, out=None) -> int:
             return _cmd_sweep(args, out)
         if args.command == "study":
             return _cmd_study(args, out)
+        if args.command == "lint":
+            return _cmd_lint(args, out)
     except ValueError as e:  # config validation -> clean CLI failure
         print(f"error: {e}", file=sys.stderr)
         return 2

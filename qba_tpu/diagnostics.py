@@ -1,0 +1,41 @@
+"""Dedicated warning categories for engine demotions and compile probes.
+
+Every engine resolver in this repo degrades gracefully: a kernel that
+fails its compile probe demotes to the next engine in the preference
+order, a transient tunnel error skips caching, a VMEM pre-filter
+rejects a shape without probing.  Those events used to surface as bare
+``RuntimeWarning`` s, so tests (and the ``qba_tpu.analysis`` lint
+driver) could only filter them by message substring.  The categories
+below make the filter structural:
+
+* :class:`QBADemotionWarning` — an engine/variant DEMOTION actually
+  happened: the caller asked for (or auto-resolution preferred) a
+  faster path and got a slower, semantically identical one
+  (fused -> tiled, parallel accept -> serial chain, spmd kernel ->
+  XLA fallback).
+* :class:`QBAProbeWarning` — a compile PROBE failed, was pre-filtered,
+  or hit a transient error whose verdict could not be cached.  A probe
+  warning often precedes a demotion warning; the probe category tells
+  you *why*, the demotion category tells you *what changed*.
+
+Both subclass ``RuntimeWarning`` so existing ``-W`` configurations and
+``pytest.warns(RuntimeWarning)`` assertions keep matching.
+"""
+
+from __future__ import annotations
+
+
+class QBAWarning(RuntimeWarning):
+    """Base class for all qba_tpu runtime diagnostics."""
+
+
+class QBADemotionWarning(QBAWarning):
+    """An engine, kernel variant, or spmd path was demoted to a slower
+    bit-identical fallback (e.g. fused -> two-kernel tiled, parallel
+    accept reduction -> serial chain, party-sharded kernel -> XLA)."""
+
+
+class QBAProbeWarning(QBAWarning):
+    """A kernel compile probe failed, was rejected by a VMEM
+    pre-filter, or hit a transient (tunnel/infrastructure) error whose
+    verdict was deliberately not cached."""

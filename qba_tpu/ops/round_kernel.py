@@ -47,6 +47,7 @@ from qba_tpu.adversary import (
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
+from qba_tpu.diagnostics import QBAProbeWarning
 from qba_tpu.ops.verdict_algebra import (
     VerdictAlgebra,
     _exact_prec,
@@ -148,7 +149,12 @@ def promote_vma(out_vma, x):
 def vma_struct(out_vma, dims, dt=jnp.int32):
     """``ShapeDtypeStruct`` carrying the declared output vma (pallas
     outputs must state which mesh axes they vary over under the
-    replication checker)."""
+    replication checker).
+
+    Contract (KI-1): every kernel builder must route its ``out_vma``
+    argument through this helper and :func:`promote_vma` — the lint's
+    threading audit injects a sentinel at each builder and requires it
+    to arrive here (qba_tpu/analysis/vma.py, docs/ANALYSIS.md)."""
     if out_vma is None or not _HAVE_VMA:
         return jax.ShapeDtypeStruct(dims, dt)
     return jax.ShapeDtypeStruct(dims, dt, vma=out_vma)
@@ -740,7 +746,7 @@ def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
             "fused round kernel VMEM pre-filter rejected "
             f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
             f"slots={cfg.slots}) without a compile probe; " + fallback,
-            RuntimeWarning,
+            QBAProbeWarning,
             stacklevel=2,
         )
         _PROBE_CACHE[key] = False
@@ -798,7 +804,7 @@ def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
                 f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
                 f"slots={cfg.slots}); falling back to the XLA round "
                 f"engine for this config: {e!r:.500}",
-                RuntimeWarning,
+                QBAProbeWarning,
                 stacklevel=2,
             )
     if ok or not transient:

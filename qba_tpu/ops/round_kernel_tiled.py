@@ -67,6 +67,7 @@ from qba_tpu.adversary import (
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
+from qba_tpu.diagnostics import QBADemotionWarning, QBAProbeWarning
 from qba_tpu.ops.round_kernel import CompilerParams, _lane_group
 from qba_tpu.ops.verdict_algebra import (
     AllReceiverVerdict,
@@ -100,7 +101,13 @@ def _prec(dt):
     cell ids (< n_pool, odd values > 256) came back decremented.  Every
     dot whose operands can exceed 256 must therefore pass
     ``Precision.HIGHEST``; bf16-operand dots with proven <= 256 values
-    are exact by construction and keep the fast path."""
+    are exact by construction and keep the fast path.
+
+    The "proven" part is machine-checked: ``qba-tpu lint``'s KI-3 pass
+    interval-bounds every dot operand on every traced build path — the
+    one-hot gathers below lint clean by structure, and removing a
+    HIGHEST from a wide-operand dot (e.g. the meta gather) fails CI
+    (qba_tpu/analysis/dots.py, docs/ANALYSIS.md)."""
     return jax.lax.Precision.HIGHEST if dt == jnp.float32 else None
 
 
@@ -1854,6 +1861,11 @@ from qba_tpu.ops.round_kernel import (  # noqa: E402 — probe cache
     _probe_disk_put,
 )
 
+# KI-2 contract on the three budgets below: every candidate block the
+# planner screens against a budget must also satisfy it in the static
+# re-derivation the lint performs (qba_tpu/analysis/memory.py) — edits
+# to an estimate or budget that let an over-budget plan through fail
+# `qba-tpu lint` before the TPU compile probe ever sees it.
 _TILED_PREFILTER_BYTES = 48 * 2**20
 _MAX_PROBE_CANDIDATES = 4
 
@@ -2100,7 +2112,7 @@ def _probe_plan(kernel_name, cfg, candidates, compile_one, cache,
             f"candidate at (n_parties={cfg.n_parties}, "
             f"size_l={cfg.size_l}, slots={cfg.slots}); "
             f"{fallback_desc}: {last_err!r:.500}",
-            RuntimeWarning,
+            QBAProbeWarning,
             stacklevel=3,
         )
     if chosen is not None or not transient_seen:
@@ -2318,7 +2330,7 @@ def _resolve_group_accept(cfg: QBAConfig,
                 f"size_l={cfg.size_l}, slots={cfg.slots}); falling back "
                 "to the serial accept chain ('group-serial') for this "
                 f"process without caching: {e!r:.500}",
-                RuntimeWarning,
+                QBAProbeWarning,
                 stacklevel=3,
             )
             return "group-serial"
@@ -2332,7 +2344,7 @@ def _resolve_group_accept(cfg: QBAConfig,
             f"at (n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
             f"slots={cfg.slots}, blk={blk_probe}); demoting to the "
             f"serial accept chain ('group-serial'): {err!r:.500}",
-            RuntimeWarning,
+            QBADemotionWarning,
             stacklevel=3,
         )
     return "group" if ok else "group-serial"
@@ -2397,7 +2409,7 @@ def _resolve_verdict_variant_impl(cfg: QBAConfig,
                 f"size_l={cfg.size_l}, slots={cfg.slots}); falling back "
                 "to the group variant for this process without caching "
                 f"(the variant may flap across runs): {e!r:.500}",
-                RuntimeWarning,
+                QBAProbeWarning,
                 stacklevel=2,
             )
             return _resolve_group_accept(cfg)

@@ -37,7 +37,10 @@ def _exact_prec(dt):
     (backend- and lowering-dependent), rounding integer operands > 256.
     bf16-operand dots whose values are proven <= 256 are exact by
     construction and keep the fast path.  (Round-5 root cause of the
-    rebuild kernel's wrong-draw bug — see round_kernel_tiled._prec.)"""
+    rebuild kernel's wrong-draw bug — see round_kernel_tiled._prec.)
+    The proof obligation is machine-checked: ``qba-tpu lint``'s KI-3
+    pass interval-bounds every dot operand on every traced build path
+    (qba_tpu/analysis/dots.py, docs/ANALYSIS.md)."""
     return jax.lax.Precision.HIGHEST if dt == jnp.float32 else None
 
 

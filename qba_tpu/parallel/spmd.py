@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from qba_tpu.adversary import sample_attacks_round
 from qba_tpu.backends.jax_backend import MonteCarloResult, aggregate, trial_keys
 from qba_tpu.config import QBAConfig
+from qba_tpu.diagnostics import QBADemotionWarning
 from qba_tpu.parallel.mesh import axis_sizes, require_divisible
 from qba_tpu.rounds import Mailbox, TrialResult
 from qba_tpu.rounds.engine import (
@@ -194,7 +195,7 @@ def _trial_party_sharded(
                 f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
                 f"slots={cfg.slots}, n_local={n_local}); demoting to "
                 "the two-kernel tiled path",
-                RuntimeWarning,
+                QBADemotionWarning,
                 stacklevel=2,
             )
             return _trial_party_sharded(
@@ -262,7 +263,11 @@ def _trial_party_sharded(
         # on TPU since round 5; resolved by the caller so the flag is
         # part of the jit cache key — see _spmd_batch); None when the
         # checker is off, where the declarations would be dead
-        # machinery.
+        # machinery.  KI-1 contract, machine-checked: every builder call
+        # in this module must pass a non-None-literal out_vma=, and the
+        # builders must thread it into vma_struct/promote_vma — the
+        # lint's AST + sentinel audits fail CI on a revert
+        # (qba_tpu/analysis/vma.py, docs/ANALYSIS.md).
         out_vma = tiled_out_vma
         # Resolve the accept-path variant explicitly so the kernel built
         # here matches the one the block plan probed (the party-sharded
@@ -481,7 +486,7 @@ def run_trials_spmd(
             f"party-sharded '{engine}' round engine failed under "
             f"shard_map despite a passing compile probe; falling back "
             f"to the XLA spmd engine: {e!r:.500}",
-            RuntimeWarning,
+            QBADemotionWarning,
             stacklevel=2,
         )
         return aggregate(

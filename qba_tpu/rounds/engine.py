@@ -37,8 +37,9 @@ from qba_tpu.adversary import (
 from qba_tpu.config import QBAConfig
 from qba_tpu.core import append_own, consistent, decide_order, success_oracle
 from qba_tpu.core.types import SENTINEL, Evidence, Packet, empty_evidence
+from qba_tpu.diagnostics import QBADemotionWarning
 from qba_tpu.qsim import generate_lists_for
-from qba_tpu.rounds.mailbox import Mailbox, empty_mailbox
+from qba_tpu.rounds.mailbox import Mailbox
 
 
 def _register_barrier_batching() -> bool:
@@ -335,7 +336,8 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
         # Precision.HIGHEST: the identity needs exact integer dots, and
         # a default-precision f32 dot may lower through bf16, rounding
         # operands > 256 (li^2-1 here; vals/li at w > 256) — the round-5
-        # wrong-draw bug class (ops/round_kernel_tiled._prec).
+        # wrong-draw bug class (ops/round_kernel_tiled._prec); enforced
+        # by the qba-tpu lint KI-3 pass on this traced path.
         m1 = jax.lax.dot_general(
             pv.reshape(n_pk * max_l, cfg.size_l),
             (li_f + 1.0)[:, None],
@@ -660,7 +662,7 @@ def run_rounds_fused(
             "fused round kernel unavailable at (n_parties="
             f"{cfg.n_parties}, size_l={cfg.size_l}, slots={cfg.slots});"
             " demoting to the two-kernel tiled path",
-            RuntimeWarning,
+            QBADemotionWarning,
             stacklevel=2,
         )
         return run_rounds_tiled(

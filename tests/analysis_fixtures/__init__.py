@@ -1,0 +1,6 @@
+"""Seeded-violation fixtures for the static invariant checker tests.
+
+Each module reproduces one Known-Issue regression shape in isolation so
+``tests/test_analysis.py`` can assert the lint actually fires on it —
+the adversarial half of the clean-tree zero-findings contract.
+"""

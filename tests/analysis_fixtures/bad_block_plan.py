@@ -1,0 +1,16 @@
+"""Seeded KI-2 violation: an explicit ``tiled_block`` override that
+divides its pool (so :class:`~qba_tpu.config.QBAConfig` accepts it)
+but busts the verdict kernel's VMEM pre-filter budget.  Off-TPU
+resolution honors the override unchecked — only the lint's static
+plan audit stands between this config and CPU tests modeling a plan
+the TPU would reject.
+"""
+
+from qba_tpu.config import QBAConfig
+
+
+def bad_config() -> QBAConfig:
+    # North-star shape: pool = 32 * 64 = 2048; block 256 tiles it
+    # exactly but its VMEM estimate (~88 MiB) is nearly double the
+    # 48 MiB _TILED_PREFILTER_BYTES budget.
+    return QBAConfig(33, 64, 10, tiled_block=256)

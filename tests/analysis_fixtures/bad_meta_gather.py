@@ -1,0 +1,26 @@
+"""Seeded KI-3 violation: the round-4 meta-gather bug, reproduced.
+
+The tiled rebuild kernel gathers rows of the packed pool meta
+(``count``/``v``/``sent``/``cell`` columns, cell ids up to the pool
+capacity) through a one-hot float matmul.  Shipped code passes
+``precision=jax.lax.Precision.HIGHEST``; this fixture is the same
+gather *without* it — on TPU the MXU would run it in bf16 passes and
+any id above 256 silently rounds to even.
+"""
+
+import jax.numpy as jnp
+
+
+def bad_meta_gather(onehot, meta):
+    """Default-precision gather of int32 meta rows via a f32 one-hot."""
+    return jnp.dot(onehot, meta.astype(jnp.float32)).astype(jnp.int32)
+
+
+def good_meta_gather(onehot, meta):
+    """The shipped form of the same gather (exact on the MXU)."""
+    import jax
+
+    return jnp.dot(
+        onehot, meta.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(jnp.int32)

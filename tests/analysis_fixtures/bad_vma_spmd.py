@@ -1,0 +1,19 @@
+"""Seeded KI-1 violation: the literal round-4 ``out_vma`` call sites.
+
+This module is parsed by the AST call-site audit
+(:func:`qba_tpu.analysis.vma.check_spmd_call_sites`), never imported
+for execution.  It reproduces both revert shapes of KI-1 inside a
+shard_map-style body: a builder call that drops ``out_vma`` entirely
+and one that hard-codes ``out_vma=None``.
+"""
+
+from qba_tpu.ops.round_kernel import build_round_step
+from qba_tpu.ops.round_kernel_tiled import build_verdict_kernel
+
+
+def shard_body(cfg, blk, n_local, interpret):
+    step = build_round_step(cfg, interpret=interpret, n_recv=n_local)
+    verdict = build_verdict_kernel(
+        cfg, blk, interpret=interpret, n_recv=n_local, out_vma=None,
+    )
+    return step, verdict

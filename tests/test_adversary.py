@@ -246,7 +246,6 @@ class TestBroadcastScope:
         return exp_bits, exp_rv
 
     def test_effective_bits_match_reference_loop(self):
-        import dataclasses
 
         cfg = QBAConfig(
             n_parties=7, size_l=4, n_dishonest=3, attack_scope="broadcast"

@@ -1,0 +1,202 @@
+"""Static invariant checker (``qba-tpu lint``): the lint must be
+silent on the shipped tree and loud on every seeded Known-Issue
+regression in ``tests/analysis_fixtures/``.
+
+The fixture tests are the adversarial half of the contract: a
+clean-tree zero-findings assertion alone would also pass for a lint
+that checks nothing.
+"""
+
+import io
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from qba_tpu.analysis.dots import BF16_EXACT_MAX, check_dots
+from qba_tpu.analysis.driver import lint_configs, run_lint
+from qba_tpu.analysis.intervals import IntervalInterpreter, IVal
+from qba_tpu.analysis.memory import (
+    NORTH_STAR_CEILING_BAND,
+    check_memory,
+    trial_ceiling,
+)
+from qba_tpu.analysis.vma import check_spmd_call_sites, check_vma
+from qba_tpu.config import QBAConfig
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+#: The matrix's cheap point: every engine live (fused plan resolves),
+#: even lieutenant count so the 2-way sharded variants trace.
+CHEAP = QBAConfig(17, 16, 4)
+
+
+# ---------------------------------------------------------------------------
+# Clean tree: the shipped kernels uphold KI-1/KI-2/KI-3 by construction.
+
+
+def test_clean_tree_zero_findings():
+    report = run_lint(configs=[("cheap", CHEAP)])
+    assert report.ok, report.render(verbose=True)
+    # All 9 build paths of the cheap config must actually have traced —
+    # a lint that silently skips paths would also report zero findings.
+    assert report.stats["paths_traced"] == 9
+    assert report.stats["dots_checked"] > 0
+    assert not report.stats["unhandled_primitives"]
+    assert report.stats["vma_builds_checked"] == 3
+    assert report.stats["memory_probes_fired"] == 0
+
+
+def test_lint_matrix_covers_planner_phases():
+    labels = [label for label, _ in lint_configs()]
+    assert labels == ["cheap", "north-star", "f32-gdt"]
+    # The north-star point is the calibration anchor; losing it from
+    # the matrix silently drops the HBM-band check.
+    assert (33, 64, 10) in [
+        (c.n_parties, c.size_l, c.n_dishonest) for _, c in lint_configs()
+    ]
+
+
+def test_cli_lint_clean(capsys):
+    from qba_tpu.cli import main
+
+    out = io.StringIO()
+    rc = main(["lint", "--config", "5,4,1", "--engines", "xla"], out=out)
+    assert rc == 0
+    assert "0 finding(s)" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# KI-3: the exact-dot pass and its interval domain.
+
+
+def _dot_records(fn, args, seeds):
+    closed = jax.make_jaxpr(fn)(*args)
+    interp = IntervalInterpreter("fixture")
+    interp.run(closed, seeds)
+    return list(interp.dots.values())
+
+
+def test_ki3_bad_meta_gather_flagged():
+    from tests.analysis_fixtures.bad_meta_gather import bad_meta_gather
+
+    records = _dot_records(
+        bad_meta_gather,
+        (jnp.zeros((64, 512), jnp.float32), jnp.zeros((512, 4), jnp.int32)),
+        [IVal(0, 1, True), IVal(0, 511, True)],
+    )
+    report = check_dots(records)
+    assert not report.ok
+    assert [f.ki for f in report.findings] == ["KI-3"]
+    f = report.findings[0]
+    assert f.check == "exact-dot"
+    assert "511" in f.message and str(BF16_EXACT_MAX) in f.message
+
+
+def test_ki3_shipped_gather_form_passes():
+    from tests.analysis_fixtures.bad_meta_gather import good_meta_gather
+
+    records = _dot_records(
+        good_meta_gather,
+        (jnp.zeros((64, 512), jnp.float32), jnp.zeros((512, 4), jnp.int32)),
+        [IVal(0, 1, True), IVal(0, 511, True)],
+    )
+    report = check_dots(records)
+    assert report.ok, report.render()
+    assert report.stats["dots_explicit_precision"] == 1
+
+
+def test_ki3_onehot_structure_bounds_gather_result():
+    # The structural half of the domain: a one-hot contraction selects
+    # one row, so the result inherits the table's bound instead of the
+    # sum-over-K blowup — this is what lets the shipped accumulator
+    # dots downstream of a gather stay below 256 without annotations.
+    def gather_then_sum(col, table):
+        oh = (
+            jax.lax.broadcasted_iota(jnp.int32, (8, 512), 1) == col
+        ).astype(jnp.float32)
+        g = jnp.dot(oh, table.astype(jnp.float32),
+                    precision=jax.lax.Precision.HIGHEST)
+        return jnp.dot(jnp.ones((4, 8), jnp.float32), g)
+
+    closed = jax.make_jaxpr(gather_then_sum)(
+        jnp.zeros((8, 1), jnp.int32), jnp.zeros((512, 4), jnp.int32)
+    )
+    interp = IntervalInterpreter("unit")
+    interp.run(closed, [IVal(0, 511, True), IVal(0, 300, True)])
+    report = check_dots(interp.dots.values())
+    # The second dot is default precision with the gathered rows as its
+    # rhs: it must be flagged (300 > 256), and the recorded bound must
+    # be the table's 300 — one row selected — not 300 * K = 153600.
+    assert [f.ki for f in report.findings] == ["KI-3"]
+    gather_rec = next(
+        r for r in interp.dots.values()
+        if "HIGHEST" in str(r.eqn.params.get("precision"))
+    )
+    assert gather_rec.lhs.mag <= 1
+    out_rec = next(
+        r for r in interp.dots.values() if r is not gather_rec
+    )
+    assert out_rec.rhs.bounded and out_rec.rhs.mag == 300
+
+
+# ---------------------------------------------------------------------------
+# KI-1: vma threading, call sites, policy.
+
+
+def test_ki1_clean_tree():
+    report = check_vma(CHEAP)
+    assert report.ok, report.render(verbose=True)
+    assert report.stats["vma_call_sites_checked"] >= 4
+
+
+def test_ki1_bad_call_sites_flagged():
+    report = check_spmd_call_sites(
+        os.path.join(FIXTURES, "bad_vma_spmd.py")
+    )
+    assert {f.ki for f in report.findings} == {"KI-1"}
+    messages = sorted(f.message for f in report.findings)
+    assert len(messages) == 2
+    assert any("without an out_vma" in m for m in messages)
+    assert any("out_vma=None" in m for m in messages)
+    # Both findings carry a clickable fixture location.
+    assert all("bad_vma_spmd.py:" in f.where for f in report.findings)
+
+
+def test_ki1_policy_env_roundtrip(monkeypatch):
+    from qba_tpu.parallel.spmd import _tiled_check_vma
+
+    monkeypatch.setenv("QBA_TILED_CHECK_VMA", "1")
+    assert _tiled_check_vma() is True
+    monkeypatch.setenv("QBA_TILED_CHECK_VMA", "0")
+    assert _tiled_check_vma() is False
+    monkeypatch.setenv("QBA_TILED_CHECK_VMA", "junk")
+    with pytest.raises(ValueError):
+        _tiled_check_vma()
+
+
+# ---------------------------------------------------------------------------
+# KI-2: static plan audit.
+
+
+def test_ki2_bad_block_plan_flagged():
+    from tests.analysis_fixtures.bad_block_plan import bad_config
+
+    report = check_memory(bad_config())
+    assert not report.ok
+    assert {f.ki for f in report.findings} == {"KI-2"}
+    assert any(
+        "explicit tiled_block=256" in f.message for f in report.findings
+    )
+
+
+def test_ki2_clean_tree():
+    report = check_memory(CHEAP)
+    assert report.ok, report.render(verbose=True)
+    assert report.stats["memory_probes_fired"] == 0
+
+
+def test_ki2_north_star_ceiling_in_measured_band():
+    lo, hi = NORTH_STAR_CEILING_BAND
+    assert lo <= trial_ceiling(QBAConfig(33, 64, 10)) <= hi

@@ -6,7 +6,6 @@ Builds the protocol's circuits through the reference's call shapes
 closed-form output properties on the results.
 """
 
-import numpy as np
 import pytest
 
 from qba_tpu.qsim import Drewom, QCircuit, QGate

@@ -8,7 +8,6 @@ in-process backends exactly (the four-way differential: mp / local /
 native / jax).
 """
 
-import dataclasses
 
 import jax
 import pytest

@@ -4,11 +4,10 @@ the same trial keys (the corruption key tree is indexed by global
 (trial, round, receiver, cell), so sharding cannot shift randomness)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from qba_tpu.backends.jax_backend import run_trials, trial_keys
+from qba_tpu.backends.jax_backend import run_trials
 from qba_tpu.config import QBAConfig
 from qba_tpu.parallel import (
     default_mesh_shape,

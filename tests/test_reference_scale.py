@@ -32,7 +32,6 @@ class, including at least one dishonest-commander trial per dishonest
 config (seeds chosen so the random dishonesty assignment hits it).
 """
 
-import jax
 import numpy as np
 import pytest
 

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import pytest
 
 from qba_tpu.config import QBAConfig
+from qba_tpu.diagnostics import QBAProbeWarning
 from qba_tpu.rounds import run_trial
 
 
@@ -131,7 +132,7 @@ class TestEngineSelection:
 
         monkeypatch.setattr(rk, "build_round_step", boom)
         cfg = QBAConfig(n_parties=33, size_l=64, n_dishonest=10)
-        with pytest.warns(RuntimeWarning, match="pre-filter rejected"):
+        with pytest.warns(QBAProbeWarning, match="pre-filter rejected"):
             assert rk.kernel_compiles(cfg) is False
 
     def test_probe_result_cached(self, monkeypatch, clean_probe_cache):
@@ -148,7 +149,7 @@ class TestEngineSelection:
         # On the CPU test platform the real-TPU compile fails; the probe
         # must warn (not raise), cache the verdict, and stay silent on
         # the cached second call.
-        with pytest.warns(RuntimeWarning, match="compile probe failed"):
+        with pytest.warns(QBAProbeWarning, match="compile probe failed"):
             first = rk.kernel_compiles(cfg)
         second = rk.kernel_compiles(cfg)
         assert first == second

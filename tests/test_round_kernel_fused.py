@@ -17,13 +17,13 @@ prefers it wherever both it and the tiled plan compile).
 """
 
 import dataclasses
-import warnings
 
 import jax
 import jax.numpy as jnp
 import pytest
 
 from qba_tpu.config import QBAConfig
+from qba_tpu.diagnostics import QBADemotionWarning
 from qba_tpu.rounds import run_trial
 
 
@@ -143,7 +143,7 @@ class TestTrialPacking:
         # The backend entry point routes through the packed runner when
         # the fused engine resolves with k > 1 dividing the batch — and
         # the Monte-Carlo aggregate is unchanged.
-        from qba_tpu.backends.jax_backend import run_trials, trial_keys
+        from qba_tpu.backends.jax_backend import run_trials
 
         cfg = QBAConfig(
             n_parties=5, size_l=16, n_dishonest=2, trials=4,
@@ -181,7 +181,7 @@ class TestSingleLaunchPerRound:
     def test_demotion_to_tiled_warns(self, monkeypatch):
         # When the fused plan does not compile (probe demotion), the
         # forced engine falls back to the two-kernel tiled path with a
-        # RuntimeWarning — and the results are still correct.
+        # QBADemotionWarning — and the results are still correct.
         import qba_tpu.ops.round_kernel_tiled as rkt
 
         monkeypatch.setattr(
@@ -193,7 +193,7 @@ class TestSingleLaunchPerRound:
             round_engine="pallas_fused", tiled_block=16,
         )
         keys = jax.random.split(jax.random.key(1), 4)
-        with pytest.warns(RuntimeWarning, match="demoting to the two-kernel"):
+        with pytest.warns(QBADemotionWarning, match="demoting to the two-kernel"):
             demoted = jax.vmap(lambda k: run_trial(cfg, k))(keys)
         xla_cfg = dataclasses.replace(cfg, round_engine="xla")
         oracle = jax.vmap(lambda k: run_trial(xla_cfg, k))(keys)

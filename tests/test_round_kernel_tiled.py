@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import pytest
 
 from qba_tpu.config import QBAConfig
+from qba_tpu.diagnostics import QBAProbeWarning
 from qba_tpu.rounds import run_trial
 
 
@@ -242,7 +243,7 @@ class TestProbeTransientHandling:
         def always_transient(blk):
             raise RuntimeError("remote_compile: HTTP 500")
 
-        with pytest.warns(RuntimeWarning, match="compile probe failed"):
+        with pytest.warns(QBAProbeWarning, match="compile probe failed"):
             chosen, cache = self._plan(cfg, always_transient)
         assert chosen is None
         assert not cache  # a flaky tunnel must not pin the verdict
@@ -255,7 +256,7 @@ class TestProbeTransientHandling:
             calls.append(blk)
             raise RuntimeError("Mosaic: scoped vmem limit exceeded")
 
-        with pytest.warns(RuntimeWarning, match="compile probe failed"):
+        with pytest.warns(QBAProbeWarning, match="compile probe failed"):
             chosen, cache = self._plan(cfg, vmem_oom)
         assert chosen is None
         assert calls == [16, 8]  # no retry per candidate; all tried

@@ -10,7 +10,6 @@ evidence — including adversarial states (cleared rows, out-of-range
 values, duplicate rows) the protocol reaches only rarely.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
