@@ -131,8 +131,16 @@ def _measure_device(
 def main() -> None:
     from qba_tpu.compile_cache import enable_compile_cache
     from qba_tpu.config import QBAConfig
+    from qba_tpu.diagnostics import add_decision_hook, remove_decision_hook
+    from qba_tpu.obs.manifest import probe_stats_snapshot
 
     enable_compile_cache()
+
+    # Live dispatch-decision capture + probe-counter baseline for the
+    # manifest embedded in the JSON line (docs/OBSERVABILITY.md).
+    decisions: list = []
+    _hook = add_decision_hook(decisions.append)
+    stats_before = probe_stats_snapshot()
 
     quick = os.environ.get("QBA_BENCH_QUICK") == "1"
     cfg = QBAConfig(
@@ -251,6 +259,22 @@ def main() -> None:
     except Exception as e:  # attribution must never sink the metric
         print(f"engine attribution failed: {e!r}", file=sys.stderr)
         headline_engine, headline_plan = None, None
+    remove_decision_hook(_hook)
+    # Full dispatch-decision manifest for the headline config: the
+    # engine/demotion chain, resolved block plan, probe-stats delta,
+    # and environment fingerprint next to the metric they explain.
+    from qba_tpu.obs.manifest import collect_manifest
+
+    try:
+        manifest = collect_manifest(
+            cfg,
+            command="bench.py",
+            decisions=decisions,
+            probe_stats_before=stats_before,
+        )
+    except Exception as e:  # attribution must never sink the metric
+        print(f"manifest collection failed: {e!r}", file=sys.stderr)
+        manifest = None
     out = {
         "metric": f"protocol_rounds_per_sec_n11_l64_t{cfg.trials}",
         "value": headline,
@@ -279,8 +303,9 @@ def main() -> None:
         "rep_seconds": stats["rep_seconds"],
         **(device or {}),
         "northstar": northstar,
+        "manifest": manifest,
     }
-    print(json.dumps(out))
+    print(json.dumps(out, default=str))
 
 
 if __name__ == "__main__":

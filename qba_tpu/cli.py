@@ -21,6 +21,7 @@ config is explicit and validated (:class:`qba_tpu.config.QBAConfig`):
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 from typing import Sequence
 
@@ -89,6 +90,13 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
         "mutation leak across a broadcast's recipients "
         "(tfg.py:271-284, docs/DIVERGENCES.md D3)",
     )
+    p.add_argument(
+        "--collect-counters", action="store_true",
+        help="emit on-device protocol counters (rounds-to-acceptance, "
+        "per-value accept counts, slot high-water mark) as an auxiliary "
+        "per-trial output; primary outputs are bit-identical either way "
+        "(docs/OBSERVABILITY.md)",
+    )
 
 
 def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
@@ -105,7 +113,23 @@ def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
         p_late=args.p_late,
         racy_mode=args.racy_mode,
         attack_scope=args.attack_scope,
+        collect_counters=args.collect_counters,
     )
+
+
+@contextlib.contextmanager
+def _telemetry(args: argparse.Namespace, cfg: QBAConfig, command: str):
+    """``--telemetry DIR`` -> a live TelemetrySession (manifest + trace
+    written at exit, even on failure), else None.  Entered AFTER the
+    final config is known — bench presets replace the config, and the
+    manifest must fingerprint what actually ran."""
+    if not getattr(args, "telemetry", None):
+        yield None
+        return
+    from qba_tpu.obs.manifest import telemetry_session
+
+    with telemetry_session(args.telemetry, cfg, command) as session:
+        yield session
 
 
 def _parser() -> argparse.ArgumentParser:
@@ -135,6 +159,13 @@ def _parser() -> argparse.ArgumentParser:
         "--profile-dir", default=None, help="write a JAX profiler trace"
     )
     run.add_argument(
+        "--telemetry", metavar="DIR", default=None,
+        help="write run telemetry into DIR: run_manifest.json (engine/"
+        "demotion/probe decisions, validated schema), trace.json "
+        "(Chrome trace events, loadable in Perfetto), spans.jsonl "
+        "(docs/OBSERVABILITY.md)",
+    )
+    run.add_argument(
         "--max-verdicts", type=int, default=8,
         help="print at most this many per-trial verdict blocks; with "
         "--backend native/jax and -v/--jsonl, each displayed trial is "
@@ -156,6 +187,12 @@ def _parser() -> argparse.ArgumentParser:
         help="split the batch into chunks of this many trials (HBM-bound "
         "configs; wall time covers all chunks end to end)",
     )
+    bench.add_argument(
+        "--telemetry", metavar="DIR", default=None,
+        help="write run_manifest.json + trace.json + spans.jsonl into "
+        "DIR; the manifest also lands under the JSON line's 'manifest' "
+        "key (docs/OBSERVABILITY.md)",
+    )
 
     sweep = sub.add_parser("sweep", help="chunked checkpoint-resumable sweep")
     _add_config_args(sweep, trials_default=256)
@@ -167,6 +204,12 @@ def _parser() -> argparse.ArgumentParser:
     sweep.add_argument(
         "--plot", metavar="PNG", default=None,
         help="write a Monte-Carlo convergence plot (requires matplotlib)",
+    )
+    sweep.add_argument(
+        "--telemetry", metavar="DIR", default=None,
+        help="write run_manifest.json + trace.json + spans.jsonl into "
+        "DIR; per-chunk dispatch/readback spans nest under the sweep "
+        "(docs/OBSERVABILITY.md)",
     )
 
     lint = sub.add_parser(
@@ -212,6 +255,12 @@ def _parser() -> argparse.ArgumentParser:
 
 
 def _cmd_run(args: argparse.Namespace, out) -> int:
+    cfg = _config(args)
+    with _telemetry(args, cfg, "run") as session:
+        return _run_impl(args, cfg, session, out)
+
+
+def _run_impl(args: argparse.Namespace, cfg: QBAConfig, session, out) -> int:
     import types
 
     import jax
@@ -219,7 +268,6 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
 
     from qba_tpu.obs import EventLog, Level, PhaseTimers, profile_trace, render_sweep, render_verdict
 
-    cfg = _config(args)
     log = EventLog(
         # --jsonl collects the DEBUG trail for export even without -v;
         # only -v streams it live.
@@ -227,7 +275,7 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
         stream=out,
         stream_level=Level.DEBUG if args.verbose else Level.INFO,
     )
-    timers = PhaseTimers()
+    timers = PhaseTimers(spans=session.spans if session else None)
     log.info("config", "experiment", n_parties=cfg.n_parties, size_l=cfg.size_l,
              n_dishonest=cfg.n_dishonest, w=cfg.w, trials=cfg.trials,
              backend=args.backend, qsim_path=cfg.qsim_path)
@@ -313,8 +361,11 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
             from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
 
             keys = trial_keys(cfg)
-            with timers.time("trials"):
+            with timers.time("trials") as sp:
                 res = fence(run_trials(cfg, keys))
+                # fence() IS the host readback barrier — this span's
+                # duration is attributable device time (docs/PERF.md).
+                sp.fenced = True
             if args.verbose or args.jsonl:
                 # Trail replay: the vectorized engine cannot cheaply emit
                 # per-packet events, but for a given trial key the
@@ -358,14 +409,8 @@ def _cmd_run(args: argparse.Namespace, out) -> int:
 
 def _cmd_bench(args: argparse.Namespace, out) -> int:
     import dataclasses
-    import json
-    import statistics
 
-    import jax.numpy as jnp
-
-    from qba_tpu.benchmark import NORTHSTAR, NORTHSTAR_CHUNK, measure_batch
-    from qba_tpu.obs import profile_trace, throughput
-    from qba_tpu.rounds.engine import resolve_round_engine
+    from qba_tpu.benchmark import NORTHSTAR, NORTHSTAR_CHUNK
 
     if args.reps < 1:
         raise ValueError("bench: --reps must be >= 1")
@@ -375,20 +420,52 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
         # The shared gate literals (qba_tpu.benchmark.NORTHSTAR).
         cfg = dataclasses.replace(cfg, **NORTHSTAR)
         chunk_trials = chunk_trials or NORTHSTAR_CHUNK
-    if args.profile_dir:
-        # Compile + steady-state warmup OUTSIDE the trace so the
-        # profile holds only the timed reps.  Shifted seed: the warmup
-        # rep must not reuse the traced run's rep-0 keys, or the
-        # tunnel's result cache serves that rep in ~0 s (the same
-        # dedupe the per-rep fresh keys exist to defeat).
-        measure_batch(
-            dataclasses.replace(cfg, seed=cfg.seed + 10_000),
-            1, chunk_trials,
-        )
-    with profile_trace(args.profile_dir):
-        rep_seconds, n_run, results = measure_batch(
-            cfg, args.reps, chunk_trials, warmup=not args.profile_dir
-        )
+    with _telemetry(args, cfg, "bench") as session:
+        return _bench_impl(args, cfg, chunk_trials, session, out)
+
+
+def _bench_impl(
+    args: argparse.Namespace,
+    cfg: QBAConfig,
+    chunk_trials: int | None,
+    session,
+    out,
+) -> int:
+    import dataclasses
+    import json
+    import statistics
+
+    import jax.numpy as jnp
+
+    from qba_tpu.benchmark import measure_batch
+    from qba_tpu.diagnostics import record_decisions
+    from qba_tpu.obs import PhaseTimers, profile_trace, throughput
+    from qba_tpu.obs.manifest import collect_manifest, probe_stats_snapshot
+    from qba_tpu.rounds.engine import resolve_round_engine
+
+    timers = PhaseTimers(spans=session.spans if session else None)
+    stats_before = probe_stats_snapshot()
+    with record_decisions() as decisions:
+        if args.profile_dir:
+            # Compile + steady-state warmup OUTSIDE the trace so the
+            # profile holds only the timed reps.  Shifted seed: the warmup
+            # rep must not reuse the traced run's rep-0 keys, or the
+            # tunnel's result cache serves that rep in ~0 s (the same
+            # dedupe the per-rep fresh keys exist to defeat).
+            with timers.time("warmup"):
+                measure_batch(
+                    dataclasses.replace(cfg, seed=cfg.seed + 10_000),
+                    1, chunk_trials,
+                )
+        with profile_trace(args.profile_dir):
+            with timers.time("measure", reps=args.reps) as sp:
+                rep_seconds, n_run, results = measure_batch(
+                    cfg, args.reps, chunk_trials, warmup=not args.profile_dir
+                )
+                # measure_batch fences every rep (the shared fence
+                # recipe), so this span is attributable device+tunnel
+                # time, not async-dispatch enqueue.
+                sp.fenced = True
     best = min(rep_seconds)
     th = throughput(cfg, n_run, best)
     overflow = float(
@@ -404,6 +481,13 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
                 [r.trials.success.astype(jnp.float32) for r in results]
             )
         )
+    )
+    manifest = collect_manifest(
+        cfg,
+        command="bench",
+        decisions=decisions,
+        probe_stats_before=stats_before,
+        spans=timers.spans,
     )
     print(
         json.dumps(
@@ -425,7 +509,12 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
                     "trials": n_run,
                     "chunk_trials": chunk_trials or cfg.trials,
                 },
-            }
+                # The full dispatch-decision record (engine, demotion
+                # chain, block plan, probe-stats delta) next to the
+                # metric — docs/OBSERVABILITY.md.
+                "manifest": manifest,
+            },
+            default=str,
         ),
         file=out,
     )
@@ -437,26 +526,34 @@ def _cmd_sweep(args: argparse.Namespace, out) -> int:
     from qba_tpu.sweep import run_sweep
 
     cfg = _config(args)
-    log = EventLog(stream=out)
-    timers = PhaseTimers()
-    res = run_sweep(
-        cfg,
-        n_chunks=args.n_chunks,
-        chunk_trials=cfg.trials,
-        checkpoint=args.checkpoint,
-        log=log,
-        timers=timers,
-    )
-    # Wall time for throughput = dispatch + readback (the two phases are
-    # disjoint: dispatch returns at async-enqueue, readback blocks).
-    seconds = (timers.total("dispatch") + timers.total("readback")) or None
-    print(render_sweep(cfg, res.success_rate, res.n_trials, seconds), file=out)
-    if res.any_overflow:
-        print("(mailbox slot overflow occurred in some chunks)", file=out)
-    if args.plot:
-        from qba_tpu.obs.plots import plot_convergence
+    with _telemetry(args, cfg, "sweep") as session:
+        log = EventLog(stream=out)
+        timers = PhaseTimers(spans=session.spans if session else None)
+        res = run_sweep(
+            cfg,
+            n_chunks=args.n_chunks,
+            chunk_trials=cfg.trials,
+            checkpoint=args.checkpoint,
+            log=log,
+            timers=timers,
+        )
+        # Wall time for throughput = dispatch + readback (the two phases
+        # are disjoint: dispatch returns at async-enqueue, readback
+        # blocks).
+        seconds = (timers.total("dispatch") + timers.total("readback")) or None
+        print(
+            render_sweep(cfg, res.success_rate, res.n_trials, seconds),
+            file=out,
+        )
+        if res.any_overflow:
+            print("(mailbox slot overflow occurred in some chunks)", file=out)
+        if args.plot:
+            from qba_tpu.obs.plots import plot_convergence
 
-        print(f"convergence plot: {plot_convergence(res, args.plot)}", file=out)
+            print(
+                f"convergence plot: {plot_convergence(res, args.plot)}",
+                file=out,
+            )
     return 0
 
 

@@ -108,6 +108,18 @@ class QBAConfig:
         shows the mechanism.  ``tests/test_racy.py`` pins the
         cross-mode and cross-backend decision match.  See
         docs/DIVERGENCES.md D1.
+      collect_counters: emit on-device protocol counters
+        (:class:`qba_tpu.rounds.engine.ProtocolCounters`) as an
+        auxiliary per-trial output of the round engines:
+        rounds-to-first-acceptance per (receiver, value), per-value
+        accept counts, per-round accept totals, the per-receiver slot
+        high-water mark, and per-round overflow flags.  Computed purely
+        from the accepted-set deltas the round scan already carries, so
+        the PRIMARY outputs (decisions/success/vi/overflow) are
+        bit-identical with counters on or off
+        (tests/test_telemetry.py), and no extra dots enter the traced
+        paths (the ``qba-tpu lint`` KI-3 gate stays clean).  Default
+        off: the counters add scan-carry state and host readback bytes.
     """
 
     n_parties: int
@@ -125,6 +137,7 @@ class QBAConfig:
     tiled_block: int | None = None
     trial_pack: int | None = None
     max_evidence_rows: int | None = None
+    collect_counters: bool = False
 
     def __post_init__(self) -> None:
         if self.n_parties < 2:

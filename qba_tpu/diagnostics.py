@@ -20,9 +20,21 @@ below make the filter structural:
 
 Both subclass ``RuntimeWarning`` so existing ``-W`` configurations and
 ``pytest.warns(RuntimeWarning)`` assertions keep matching.
+
+Every warn site routes through :func:`warn_and_record`, which warns
+exactly as before (same message, category, and effective stacklevel)
+AND hands a structured record to any registered decision hooks — the
+run-manifest machinery (:mod:`qba_tpu.obs.manifest`) registers one so a
+demotion/probe event is simultaneously a warning for humans and a
+manifest entry for machines.  With no hooks registered the helper is
+just ``warnings.warn``.
 """
 
 from __future__ import annotations
+
+import contextlib
+import warnings
+from typing import Any, Callable, Iterator
 
 
 class QBAWarning(RuntimeWarning):
@@ -39,3 +51,78 @@ class QBAProbeWarning(QBAWarning):
     """A kernel compile probe failed, was rejected by a VMEM
     pre-filter, or hit a transient (tunnel/infrastructure) error whose
     verdict was deliberately not cached."""
+
+
+# Decision hooks: callables receiving the structured record of every
+# warn_and_record call.  A hook must never raise (it runs inside engine
+# resolution); exceptions are swallowed so telemetry can never change
+# dispatch behavior.
+_DECISION_HOOKS: list[Callable[[dict], None]] = []
+
+
+def add_decision_hook(hook: Callable[[dict], None]) -> Callable[[dict], None]:
+    _DECISION_HOOKS.append(hook)
+    return hook
+
+
+def remove_decision_hook(hook: Callable[[dict], None]) -> None:
+    try:
+        _DECISION_HOOKS.remove(hook)
+    except ValueError:
+        pass
+
+
+@contextlib.contextmanager
+def record_decisions() -> Iterator[list[dict]]:
+    """Collect every dispatch decision warned inside the block.
+
+    Yields the (live) list of records.  NOTE the resolver memos: probe
+    and demotion warnings fire on the FIRST resolution of a config
+    shape per process (``_RESOLVE_CACHE`` / the probe caches in
+    :mod:`qba_tpu.ops.round_kernel_tiled`), so a block entered after
+    the shape was already resolved collects nothing — the manifest
+    therefore also reads the memoized plan itself
+    (:func:`qba_tpu.benchmark.kernel_plan`), which re-reads the cached
+    verdicts the run actually used."""
+    records: list[dict] = []
+    hook = add_decision_hook(records.append)
+    try:
+        yield records
+    finally:
+        remove_decision_hook(hook)
+
+
+def warn_and_record(
+    message: str,
+    category: type[Warning],
+    *,
+    site: str,
+    stacklevel: int = 2,
+    **fields: Any,
+) -> None:
+    """``warnings.warn`` + structured record, in that order of fidelity:
+    the warning text/category/stacklevel are EXACTLY what the call site
+    used to emit inline (``pytest.warns(..., match=...)`` suites pin
+    them), the record adds the machine-readable context the text loses.
+
+    ``site`` names the emitting resolver (e.g.
+    ``"ops.round_kernel.kernel_compiles"``); ``fields`` carry the
+    decision specifics (engine_from/engine_to, reason, config shape...).
+    ``stacklevel`` is interpreted relative to the *caller* — the extra
+    frame this helper adds is compensated internally.
+    """
+    record = {
+        "kind": (
+            "demotion" if issubclass(category, QBADemotionWarning) else "probe"
+        ),
+        "category": category.__name__,
+        "site": site,
+        "message": message,
+        **{k: v for k, v in fields.items()},
+    }
+    for hook in list(_DECISION_HOOKS):
+        try:
+            hook(record)
+        except Exception:  # telemetry must never alter dispatch
+            pass
+    warnings.warn(message, category, stacklevel=stacklevel + 1)

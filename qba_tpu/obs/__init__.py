@@ -16,11 +16,23 @@ Here observability is a first-class subsystem:
 * :mod:`qba_tpu.obs.report` — human-readable run reports, including the
   reference's closing ``Decisions / Dishonests / Success`` triple.
 * :mod:`qba_tpu.obs.profiling` — optional JAX profiler trace hook.
+* :mod:`qba_tpu.obs.telemetry` — hierarchical spans with fenced
+  device-time attribution; JSONL + Chrome trace (Perfetto) export.
+* :mod:`qba_tpu.obs.manifest` — the run manifest: engine/demotion/
+  probe decisions, environment, and config fingerprint as one
+  validated JSON document (docs/OBSERVABILITY.md).
 """
 
 from qba_tpu.obs.events import Event, EventLog, Level
+from qba_tpu.obs.manifest import (
+    collect_manifest,
+    load_manifest,
+    telemetry_session,
+    validate_manifest,
+)
 from qba_tpu.obs.profiling import profile_trace
 from qba_tpu.obs.report import render_sweep, render_verdict
+from qba_tpu.obs.telemetry import Span, SpanRecorder
 from qba_tpu.obs.timers import PhaseTimers, throughput
 
 __all__ = [
@@ -28,8 +40,14 @@ __all__ = [
     "EventLog",
     "Level",
     "PhaseTimers",
+    "Span",
+    "SpanRecorder",
+    "collect_manifest",
+    "load_manifest",
     "profile_trace",
     "render_sweep",
     "render_verdict",
+    "telemetry_session",
     "throughput",
+    "validate_manifest",
 ]

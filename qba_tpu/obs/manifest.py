@@ -1,0 +1,261 @@
+"""Run manifest: a structured record of every dispatch decision.
+
+The 4-engine auto-dispatch (fused -> tiled -> monolithic -> XLA), the
+verdict-variant and block-plan compile probes, and the trial-pack
+resolution all decide *what actually ran* — and until now those
+decisions surfaced only as one-shot ``QBADemotionWarning`` /
+``QBAProbeWarning`` strings plus per-field accessors scattered across
+:mod:`qba_tpu.benchmark`.  The manifest collects them in one validated
+JSON document next to the environment (jax version, backend, device
+topology) and the config fingerprint, so a benchmark artifact or a bug
+report names its execution path machine-readably.
+
+Two complementary sources feed it, by design:
+
+* ``decisions`` — the structured records captured live by
+  :func:`qba_tpu.diagnostics.record_decisions` while the run executed.
+  Complete for the FIRST resolution of a config shape in a process;
+  empty when the resolver memo already held the verdicts (warnings
+  fire once per shape per process).
+* ``plan`` / ``demotion_chain`` — read back from the memoized
+  resolvers afterwards (:func:`qba_tpu.benchmark.kernel_plan`), which
+  is exactly the resolution the run used regardless of when it was
+  first probed.
+
+Schema id: ``qba-tpu/run-manifest/v1`` (see docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Iterator
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.obs.telemetry import SpanRecorder
+
+MANIFEST_SCHEMA = "qba-tpu/run-manifest/v1"
+
+# Keys validate_manifest requires, with their expected types.
+_REQUIRED: dict[str, type | tuple[type, ...]] = {
+    "schema": str,
+    "environment": dict,
+    "config": dict,
+    "plan": dict,
+    "engine_description": str,
+    "demotion_chain": list,
+    "decisions": list,
+    "probe_stats": dict,
+    "counters_enabled": bool,
+}
+
+_PLAN_KEYS = (
+    "engine", "variant", "verdict_block", "rebuild_block", "fused_block",
+    "trial_pack", "launches_per_round",
+)
+
+
+def environment_info() -> dict[str, Any]:
+    """jax/backend/device-topology fingerprint of this process."""
+    import platform as _platform
+
+    import jax
+
+    devices = jax.devices()
+    return {
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_count": len(devices),
+        "device_kind": devices[0].device_kind if devices else None,
+        "python": _platform.python_version(),
+        "host": _platform.platform(),
+    }
+
+
+def config_fingerprint(cfg: QBAConfig) -> dict[str, Any]:
+    """All explicit fields plus the derived shape parameters the
+    engines actually key on — enough to reconstruct the config AND to
+    read the manifest without re-deriving w/slots by hand."""
+    d = dataclasses.asdict(cfg)
+    d["derived"] = {
+        "w": cfg.w,
+        "slots": cfg.slots,
+        "max_l": cfg.max_l,
+        "n_rounds": cfg.n_rounds,
+        "n_lieutenants": cfg.n_lieutenants,
+    }
+    return d
+
+
+def probe_stats_snapshot() -> dict[str, int]:
+    """Copy of the resolver/probe counters
+    (:data:`qba_tpu.ops.round_kernel_tiled.PROBE_STATS`)."""
+    from qba_tpu.ops.round_kernel_tiled import PROBE_STATS
+
+    return dict(PROBE_STATS)
+
+
+def demotion_chain(cfg: QBAConfig, plan: dict[str, Any]) -> list[str]:
+    """requested -> resolved -> actually-run engine names, deduplicated
+    in order.  ``auto`` resolution is the first hop; a fused engine
+    whose fused block failed to probe runs the tiled path
+    (:func:`qba_tpu.rounds.engine.run_rounds_fused`) — the second."""
+    chain = [cfg.round_engine]
+    engine = plan["engine"]
+    if engine != chain[-1]:
+        chain.append(engine)
+    if engine == "pallas_fused" and plan.get("fused_block") is None:
+        chain.append("pallas_tiled")
+    return chain
+
+
+def collect_manifest(
+    cfg: QBAConfig,
+    *,
+    command: str | None = None,
+    decisions: list[dict] | None = None,
+    probe_stats_before: dict[str, int] | None = None,
+    spans: SpanRecorder | None = None,
+    extra: dict[str, Any] | None = None,
+) -> dict[str, Any]:
+    """Assemble the full manifest for ``cfg`` as run in this process.
+
+    ``probe_stats_before`` should be a :func:`probe_stats_snapshot`
+    taken before the run so the delta isolates this run's resolver
+    traffic; without it the delta equals the absolute counters.
+    """
+    from qba_tpu.benchmark import engine_description, kernel_plan
+
+    plan = kernel_plan(cfg)
+    after = probe_stats_snapshot()
+    before = probe_stats_before or {k: 0 for k in after}
+    manifest: dict[str, Any] = {
+        "schema": MANIFEST_SCHEMA,
+        "created_unix_s": time.time(),
+        "command": command,
+        "environment": environment_info(),
+        "config": config_fingerprint(cfg),
+        "plan": plan,
+        "engine_description": engine_description(cfg),
+        "demotion_chain": demotion_chain(cfg, plan),
+        "decisions": list(decisions or []),
+        "probe_stats": {
+            "before": before,
+            "after": after,
+            "delta": {k: after[k] - before.get(k, 0) for k in after},
+        },
+        "counters_enabled": bool(cfg.collect_counters),
+    }
+    if spans is not None:
+        manifest["phase_totals"] = spans.totals()
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def validate_manifest(manifest: dict[str, Any]) -> dict[str, Any]:
+    """Schema check (all problems at once); returns the manifest so the
+    call composes.  The CI smoke step and the round-trip tests both run
+    this — keep it in sync with :func:`collect_manifest`."""
+    problems: list[str] = []
+    if not isinstance(manifest, dict):
+        raise ValueError(f"manifest must be a dict, got {type(manifest)}")
+    if manifest.get("schema") != MANIFEST_SCHEMA:
+        problems.append(
+            f"schema: expected {MANIFEST_SCHEMA!r}, got {manifest.get('schema')!r}"
+        )
+    for key, typ in _REQUIRED.items():
+        if key not in manifest:
+            problems.append(f"missing key: {key}")
+        elif not isinstance(manifest[key], typ):
+            problems.append(
+                f"{key}: expected {typ}, got {type(manifest[key]).__name__}"
+            )
+    plan = manifest.get("plan")
+    if isinstance(plan, dict):
+        for key in _PLAN_KEYS:
+            if key not in plan:
+                problems.append(f"plan missing key: {key}")
+    chain = manifest.get("demotion_chain")
+    if isinstance(chain, list) and not chain:
+        problems.append("demotion_chain must name at least the run engine")
+    stats = manifest.get("probe_stats")
+    if isinstance(stats, dict):
+        for key in ("before", "after", "delta"):
+            if not isinstance(stats.get(key), dict):
+                problems.append(f"probe_stats.{key} must be a dict")
+    if problems:
+        raise ValueError("invalid run manifest: " + "; ".join(problems))
+    return manifest
+
+
+def write_manifest(path: str, manifest: dict[str, Any]) -> str:
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1, default=str)
+    return path
+
+
+def load_manifest(path: str) -> dict[str, Any]:
+    with open(path) as f:
+        return validate_manifest(json.load(f))
+
+
+@dataclasses.dataclass
+class TelemetrySession:
+    """Live handle yielded by :func:`telemetry_session`: the shared span
+    recorder (hand it to ``PhaseTimers(spans=...)``), plus mutable
+    ``extra`` merged into the manifest at exit."""
+
+    directory: str
+    spans: SpanRecorder
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.directory, "run_manifest.json")
+
+    @property
+    def trace_path(self) -> str:
+        return os.path.join(self.directory, "trace.json")
+
+
+@contextlib.contextmanager
+def telemetry_session(
+    directory: str, cfg: QBAConfig, command: str
+) -> Iterator[TelemetrySession]:
+    """Everything ``--telemetry DIR`` needs in one context manager:
+
+    * opens a :class:`SpanRecorder` with a root span named ``command``,
+    * captures dispatch decisions (:func:`~qba_tpu.diagnostics.record_decisions`)
+      and the PROBE_STATS delta across the block,
+    * on exit writes ``run_manifest.json`` (validated),
+      ``trace.json`` (Chrome trace events, Perfetto-loadable), and
+      ``spans.jsonl`` into ``directory``.
+
+    Artifacts are written even when the block raises — a failed run's
+    partial trace is exactly when you want telemetry.
+    """
+    from qba_tpu.diagnostics import record_decisions
+
+    os.makedirs(directory, exist_ok=True)
+    session = TelemetrySession(directory=directory, spans=SpanRecorder())
+    before = probe_stats_snapshot()
+    try:
+        with record_decisions() as decisions:
+            with session.spans.span(command, cat="command"):
+                yield session
+    finally:
+        manifest = collect_manifest(
+            cfg,
+            command=command,
+            decisions=decisions,
+            probe_stats_before=before,
+            spans=session.spans,
+            extra=session.extra,
+        )
+        write_manifest(session.manifest_path, validate_manifest(manifest))
+        session.spans.write_chrome_trace(session.trace_path)
+        session.spans.write_jsonl(os.path.join(directory, "spans.jsonl"))

@@ -4,50 +4,62 @@ The reference contains no timers at all (SURVEY §5 "Tracing/profiling:
 Absent"); benchmarking it means re-measuring from scratch (SURVEY §6).
 Here every runner can time its phases and report the headline
 "protocol rounds/sec" throughput (BASELINE.json).
+
+Since the telemetry layer landed, ``PhaseTimers`` is a *view* over a
+:class:`~qba_tpu.obs.telemetry.SpanRecorder`: ``time(phase)`` records a
+span named ``phase``, and the totals/counts are per-name aggregates of
+the recorded spans.  Passing a shared recorder (``spans=``) makes every
+timed phase appear in the run's exported trace for free; the default
+constructs a private recorder, preserving the original flat-timer
+behavior exactly.
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
-from collections import defaultdict
 from typing import Callable, Iterator
 
 from qba_tpu.config import QBAConfig
+from qba_tpu.obs.telemetry import Span, SpanRecorder
 
 
 class PhaseTimers:
-    """Accumulating named wall-clock timers.
+    """Accumulating named wall-clock timers over a span recorder.
 
     ``with timers.time("rounds"): ...`` accumulates into ``total("rounds")``;
-    a phase may be entered repeatedly (per chunk / per rep).
+    a phase may be entered repeatedly (per chunk / per rep).  Extra
+    keyword args to ``time`` become span args in the exported trace.
     """
 
-    def __init__(self, clock: Callable[[], float] = time.perf_counter) -> None:
-        self._clock = clock
-        self._totals: defaultdict[str, float] = defaultdict(float)
-        self._counts: defaultdict[str, int] = defaultdict(int)
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.perf_counter,
+        spans: SpanRecorder | None = None,
+    ) -> None:
+        self.spans = spans if spans is not None else SpanRecorder(clock=clock)
 
     @contextlib.contextmanager
-    def time(self, phase: str) -> Iterator[None]:
-        t0 = self._clock()
-        try:
-            yield
-        finally:
-            self._totals[phase] += self._clock() - t0
-            self._counts[phase] += 1
+    def time(self, phase: str, **args) -> Iterator["Span"]:
+        with self.spans.span(phase, **args) as sp:
+            yield sp
 
     def total(self, phase: str) -> float:
-        return self._totals[phase]
+        return sum(
+            sp.dur
+            for sp in self.spans.spans
+            if sp.name == phase and sp.dur is not None
+        )
 
     def count(self, phase: str) -> int:
-        return self._counts[phase]
+        return sum(
+            1
+            for sp in self.spans.spans
+            if sp.name == phase and sp.dur is not None
+        )
 
     def summary(self) -> dict[str, dict[str, float]]:
-        return {
-            phase: {"total_s": self._totals[phase], "count": self._counts[phase]}
-            for phase in self._totals
-        }
+        return self.spans.totals()
 
     def render(self) -> str:
         rows = [

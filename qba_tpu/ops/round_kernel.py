@@ -31,8 +31,6 @@ Layout conventions (per trial; ``vmap`` over trials prepends the grid):
 
 from __future__ import annotations
 
-import warnings
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -47,7 +45,7 @@ from qba_tpu.adversary import (
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
-from qba_tpu.diagnostics import QBAProbeWarning
+from qba_tpu.diagnostics import QBAProbeWarning, warn_and_record
 from qba_tpu.ops.verdict_algebra import (
     VerdictAlgebra,
     _exact_prec,
@@ -742,12 +740,18 @@ def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
             if n_recv is not None
             else "the auto engine will try the packet-tiled kernel, then XLA"
         )
-        warnings.warn(
+        warn_and_record(
             "fused round kernel VMEM pre-filter rejected "
             f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
             f"slots={cfg.slots}) without a compile probe; " + fallback,
             QBAProbeWarning,
+            site="ops.round_kernel.kernel_compiles",
             stacklevel=2,
+            reason="vmem_prefilter",
+            n_parties=cfg.n_parties,
+            size_l=cfg.size_l,
+            slots=cfg.slots,
+            n_recv=n_recv,
         )
         _PROBE_CACHE[key] = False
         return False
@@ -799,13 +803,20 @@ def kernel_compiles(cfg: QBAConfig, n_recv: int | None = None) -> bool:
             # tunnel/infrastructure error both land here, and the
             # fallback costs up to ~26x (docs/PERF.md) — the operator
             # should see why.
-            warnings.warn(
+            warn_and_record(
                 "round kernel compile probe failed for "
                 f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
                 f"slots={cfg.slots}); falling back to the XLA round "
                 f"engine for this config: {e!r:.500}",
                 QBAProbeWarning,
+                site="ops.round_kernel.kernel_compiles",
                 stacklevel=2,
+                reason="compile_probe_failed",
+                n_parties=cfg.n_parties,
+                size_l=cfg.size_l,
+                slots=cfg.slots,
+                n_recv=n_recv,
+                error=repr(e)[:500],
             )
     if ok or not transient:
         # Never cache transient failures — not even in-process: a flaky

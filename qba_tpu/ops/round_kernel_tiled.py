@@ -51,8 +51,6 @@ int32 planes), exact for ``w <= 64`` — covering the 33-party north star
 
 from __future__ import annotations
 
-import warnings
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -67,7 +65,11 @@ from qba_tpu.adversary import (
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
-from qba_tpu.diagnostics import QBADemotionWarning, QBAProbeWarning
+from qba_tpu.diagnostics import (
+    QBADemotionWarning,
+    QBAProbeWarning,
+    warn_and_record,
+)
 from qba_tpu.ops.round_kernel import CompilerParams, _lane_group
 from qba_tpu.ops.verdict_algebra import (
     AllReceiverVerdict,
@@ -2107,13 +2109,20 @@ def _probe_plan(kernel_name, cfg, candidates, compile_one, cache,
             # unknown, so any later candidate's win is provisional.
             transient_abandoned = True
     if chosen is None and last_err is not None:
-        warnings.warn(
+        warn_and_record(
             f"{kernel_name} kernel compile probe failed for every block "
             f"candidate at (n_parties={cfg.n_parties}, "
             f"size_l={cfg.size_l}, slots={cfg.slots}); "
             f"{fallback_desc}: {last_err!r:.500}",
             QBAProbeWarning,
+            site="ops.round_kernel_tiled._probe_plan",
             stacklevel=3,
+            reason="all_block_candidates_failed",
+            kernel=kernel_name,
+            n_parties=cfg.n_parties,
+            size_l=cfg.size_l,
+            slots=cfg.slots,
+            error=repr(last_err)[:500],
         )
     if chosen is not None or not transient_seen:
         # Cache only real verdicts in-process: a failure born from a
@@ -2324,14 +2333,22 @@ def _resolve_group_accept(cfg: QBAConfig,
             # Unknown verdict — do not cache; take the proven serial
             # path for this process only (observable, mirroring the
             # _probe_plan fallback message — ADVICE r5 item 2).
-            warnings.warn(
+            warn_and_record(
                 "tiled-verdict accept-path compile probe hit a "
                 f"transient error at (n_parties={cfg.n_parties}, "
                 f"size_l={cfg.size_l}, slots={cfg.slots}); falling back "
                 "to the serial accept chain ('group-serial') for this "
                 f"process without caching: {e!r:.500}",
                 QBAProbeWarning,
+                site="ops.round_kernel_tiled._resolve_group_accept",
                 stacklevel=3,
+                reason="transient_probe_error",
+                variant_from="group",
+                variant_to="group-serial",
+                n_parties=cfg.n_parties,
+                size_l=cfg.size_l,
+                slots=cfg.slots,
+                error=repr(e)[:500],
             )
             return "group-serial"
         err = e
@@ -2339,13 +2356,21 @@ def _resolve_group_accept(cfg: QBAConfig,
     _VARIANT_CACHE[key] = ok
     _probe_disk_put(dkey, 1 if ok else 0)
     if not ok:
-        warnings.warn(
+        warn_and_record(
             "tiled-verdict parallel accept reduction failed to compile "
             f"at (n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
             f"slots={cfg.slots}, blk={blk_probe}); demoting to the "
             f"serial accept chain ('group-serial'): {err!r:.500}",
             QBADemotionWarning,
+            site="ops.round_kernel_tiled._resolve_group_accept",
             stacklevel=3,
+            variant_from="group",
+            variant_to="group-serial",
+            n_parties=cfg.n_parties,
+            size_l=cfg.size_l,
+            slots=cfg.slots,
+            blk=blk_probe,
+            error=repr(err)[:500],
         )
     return "group" if ok else "group-serial"
 
@@ -2403,14 +2428,22 @@ def _resolve_verdict_variant_impl(cfg: QBAConfig,
             # across processes is observable (ADVICE r5 item 2; mirrors
             # the _probe_plan fallback message), then resolve within
             # the group family for this process.
-            warnings.warn(
+            warn_and_record(
                 "tiled-verdict variant compile probe hit a transient "
                 f"error at (n_parties={cfg.n_parties}, "
                 f"size_l={cfg.size_l}, slots={cfg.slots}); falling back "
                 "to the group variant for this process without caching "
                 f"(the variant may flap across runs): {e!r:.500}",
                 QBAProbeWarning,
+                site="ops.round_kernel_tiled._resolve_verdict_variant",
                 stacklevel=2,
+                reason="transient_probe_error",
+                variant_from="allrecv",
+                variant_to="group",
+                n_parties=cfg.n_parties,
+                size_l=cfg.size_l,
+                slots=cfg.slots,
+                error=repr(e)[:500],
             )
             return _resolve_group_accept(cfg)
         ok = False

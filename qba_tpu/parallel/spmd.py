@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import functools
 import os
-import warnings
 
 import jax
 import jax.numpy as jnp
@@ -31,12 +30,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 from qba_tpu.adversary import sample_attacks_round
 from qba_tpu.backends.jax_backend import MonteCarloResult, aggregate, trial_keys
 from qba_tpu.config import QBAConfig
-from qba_tpu.diagnostics import QBADemotionWarning
+from qba_tpu.diagnostics import QBADemotionWarning, warn_and_record
 from qba_tpu.parallel.mesh import axis_sizes, require_divisible
 from qba_tpu.rounds import Mailbox, TrialResult
 from qba_tpu.rounds.engine import (
+    ProtocolCounters,
+    _vi_bool,
     finish_trial,
     receiver_round,
+    scan_rounds,
     setup_trial,
     step3a_one,
 )
@@ -161,9 +163,7 @@ def _trial_party_sharded(
             return (out[6], tuple(out[:6])), out[7][0, 0] > 0
 
         init = (vi_l.astype(jnp.int32), pack_local(mb_local))
-        (vi_i32, _), overflows = jax.lax.scan(
-            round_body, init, jnp.arange(1, cfg.n_rounds + 1)
-        )
+        (vi_i32, _), overflows, cst = scan_rounds(cfg, round_body, init)
         vi_l = vi_i32 != 0
     elif engine == "pallas_fused":
         # The fused single-launch engine's party-sharded variant: same
@@ -190,13 +190,20 @@ def _trial_party_sharded(
             # Same demotion discipline as the single-device engine
             # (run_rounds_fused): the two-kernel tiled path is the
             # probe-demotion target.
-            warnings.warn(
+            warn_and_record(
                 f"party-sharded fused round kernel unavailable at "
                 f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
                 f"slots={cfg.slots}, n_local={n_local}); demoting to "
                 "the two-kernel tiled path",
                 QBADemotionWarning,
+                site="parallel.spmd._trial_party_sharded",
                 stacklevel=2,
+                engine_from="pallas_fused",
+                engine_to="pallas_tiled",
+                n_parties=cfg.n_parties,
+                size_l=cfg.size_l,
+                slots=cfg.slots,
+                n_local=n_local,
             )
             return _trial_party_sharded(
                 cfg, n_tp, key, "pallas_tiled", vma_axes, tiled_out_vma
@@ -230,9 +237,7 @@ def _trial_party_sharded(
             return (vi_i32, pool_new), ovf
 
         init = (vi_l.astype(jnp.int32), pool_l)
-        (vi_i32, _), overflows = jax.lax.scan(
-            round_body, init, jnp.arange(1, cfg.n_rounds + 1)
-        )
+        (vi_i32, _), overflows, cst = scan_rounds(cfg, round_body, init)
         vi_l = vi_i32 != 0
     elif engine == "pallas_tiled":
         # The packet-tiled engine's party-sharded variant: each device
@@ -329,9 +334,7 @@ def _trial_party_sharded(
 
         # Step 3a's local rows feed the local pool; vi carries int32.
         init = (vi_l.astype(jnp.int32), pool_l)
-        (vi_i32, _), overflows = jax.lax.scan(
-            round_body, init, jnp.arange(1, cfg.n_rounds + 1)
-        )
+        (vi_i32, _), overflows, cst = scan_rounds(cfg, round_body, init)
         vi_l = vi_i32 != 0
     else:
 
@@ -355,8 +358,8 @@ def _trial_party_sharded(
             )(my_draws, my_ids, vi_l, my_li)
             return (vi_l, Mailbox(*out_cells)), jnp.any(ovf)
 
-        (vi_l, _), overflows = jax.lax.scan(
-            round_body, (vi_l, mb_local), jnp.arange(1, cfg.n_rounds + 1)
+        (vi_l, _), overflows, cst = scan_rounds(
+            cfg, round_body, (vi_l, mb_local)
         )
 
     # Recombine the accepted-sets so every device holds the full decision
@@ -372,7 +375,53 @@ def _trial_party_sharded(
     )
     vi = jax.lax.psum(full, "tp") != 0
     overflow = jax.lax.psum(jnp.any(overflows).astype(jnp.int32), "tp") > 0
-    return finish_trial(cfg, vi, v_comm, honest, overflow)
+    counters = (
+        _merge_counters_tp(cfg, n_tp, start, cst, vi, overflows)
+        if cst is not None
+        else None
+    )
+    return finish_trial(cfg, vi, v_comm, honest, overflow, counters)
+
+
+def _merge_counters_tp(
+    cfg: QBAConfig,
+    n_tp: int,
+    start: jax.Array,
+    cst,
+    vi: jax.Array,
+    overflows: jax.Array,
+) -> ProtocolCounters:
+    """Merge the shard-local :class:`ProtocolCounters` state into the
+    replicated full-grid counters.  psum-only (scatter-into-zeros +
+    psum), the same discipline as the vi recombination above: psum
+    provably erases the tp-varying axis, so shard_map's replication
+    checker (check_vma) can verify the counters are replicated over tp
+    — a pmax would be equally correct but unprovable."""
+    (first_l, high_l), accepts_l = cst
+    # first_accept_round uses -1 as "never accepted"; shift by +1 so the
+    # scatter's zero fill is the not-my-receiver value, psum, shift back.
+    shifted = jnp.zeros((cfg.n_lieutenants, cfg.w), jnp.int32)
+    shifted = jax.lax.dynamic_update_slice_in_dim(
+        shifted, first_l + 1, start, axis=0
+    )
+    first_accept = jax.lax.psum(shifted, "tp") - 1
+    # slot_high_water is a scalar per shard: one lane of an [n_tp]
+    # vector, psum replicates the vector, max reduces it.
+    lanes = jnp.zeros((n_tp,), jnp.int32)
+    lanes = jax.lax.dynamic_update_slice(
+        lanes, high_l[None], (jax.lax.axis_index("tp"),)
+    )
+    high_water = jnp.max(jax.lax.psum(lanes, "tp"))
+    per_round = jnp.any(
+        jnp.reshape(_vi_bool(overflows), (cfg.n_rounds, -1)), axis=1
+    )
+    return ProtocolCounters(
+        first_accept_round=first_accept,
+        accept_counts=jnp.sum(vi, axis=-2, dtype=jnp.int32),
+        accepts_per_round=jax.lax.psum(accepts_l, "tp"),
+        slot_high_water=high_water,
+        overflow_rounds=jax.lax.psum(per_round.astype(jnp.int32), "tp") > 0,
+    )
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 3, 4))
@@ -482,12 +531,16 @@ def run_trials_spmd(
         # silently means something weaker, docs/DIVERGENCES.md D1).
         if engine == "xla" or cfg.round_engine != "auto":
             raise
-        warnings.warn(
+        warn_and_record(
             f"party-sharded '{engine}' round engine failed under "
             f"shard_map despite a passing compile probe; falling back "
             f"to the XLA spmd engine: {e!r:.500}",
             QBADemotionWarning,
+            site="parallel.spmd.run_trials_spmd",
             stacklevel=2,
+            engine_from=engine,
+            engine_to="xla",
+            error=repr(e)[:500],
         )
         return aggregate(
             _spmd_batch(cfg, mesh, keys, "xla", _resolve_check_vma("xla"))

@@ -37,7 +37,7 @@ from qba_tpu.adversary import (
 from qba_tpu.config import QBAConfig
 from qba_tpu.core import append_own, consistent, decide_order, success_oracle
 from qba_tpu.core.types import SENTINEL, Evidence, Packet, empty_evidence
-from qba_tpu.diagnostics import QBADemotionWarning
+from qba_tpu.diagnostics import QBADemotionWarning, warn_and_record
 from qba_tpu.qsim import generate_lists_for
 from qba_tpu.rounds.mailbox import Mailbox
 
@@ -84,6 +84,28 @@ class PartitionHints:
 
 
 @struct.dataclass
+class ProtocolCounters:
+    """On-device protocol counters (``cfg.collect_counters``), one set
+    per trial — enough to triage divergence at the 33-party scale
+    without a host-side replay (docs/OBSERVABILITY.md).
+
+    Every field is derived purely from the accepted-set (``vi``) deltas
+    the round scan already carries plus the per-round overflow flags,
+    so collecting them cannot perturb the primary outputs (bit-identity
+    pinned in tests/test_telemetry.py) and adds no dot operations to
+    the traced paths (the KI-3 lint gate).  Round indices follow the
+    protocol's 1-based numbering (``tfg.py:337``): 0 means accepted at
+    step 3a, -1 means never accepted."""
+
+    first_accept_round: jnp.ndarray  # int32[n_lieutenants, w]; -1 = never
+    accept_counts: jnp.ndarray  # int32[w] — receivers that ever accepted v
+    accepts_per_round: jnp.ndarray  # int32[n_rounds] — acceptances per round
+    slot_high_water: jnp.ndarray  # int32 — max rebroadcasts queued by one
+    # receiver in one round (vs the cfg.slots bound)
+    overflow_rounds: jnp.ndarray  # bool[n_rounds] — slot overflow per round
+
+
+@struct.dataclass
 class TrialResult:
     """Everything rank 0 prints at the end of a run (``tfg.py:351-363``),
     plus TPU-design diagnostics."""
@@ -94,6 +116,7 @@ class TrialResult:
     v_comm: jnp.ndarray  # int32 — the commander's privately chosen order
     vi: jnp.ndarray  # bool[n_lieutenants, w] accepted-sets
     overflow: jnp.ndarray  # bool — a rebroadcast exceeded the slot bound
+    counters: ProtocolCounters | None = None  # cfg.collect_counters only
 
 
 def _empty_out_cells(cfg: QBAConfig):
@@ -471,7 +494,9 @@ def setup_trial(cfg: QBAConfig, key: jax.Array, hints: PartitionHints | None = N
     return honest, lists[2:], p_rows, v_sent, v_comm, k_rounds
 
 
-def finish_trial(cfg: QBAConfig, vi, v_comm, honest, overflow) -> TrialResult:
+def finish_trial(
+    cfg: QBAConfig, vi, v_comm, honest, overflow, counters=None
+) -> TrialResult:
     """Decision + verdict (``tfg.py:303-306,351-363``), shared by every
     engine: masked-min decisions, success oracle, result assembly."""
     lieu_decisions = jax.vmap(
@@ -486,7 +511,111 @@ def finish_trial(cfg: QBAConfig, vi, v_comm, honest, overflow) -> TrialResult:
         v_comm=v_comm,
         vi=vi,
         overflow=overflow,
+        counters=counters,
     )
+
+
+# ---------------------------------------------------------------------------
+# Protocol counters (cfg.collect_counters): all state is a pure function
+# of the vi carry the round scans already thread, so wrapping a round
+# body with scan_rounds(collect=True) cannot change what the body
+# computes — the collect=False path is byte-for-byte the original scan.
+
+
+def _vi_bool(vi):
+    """Engines carry vi as bool (XLA) or int32 (kernel paths)."""
+    return vi if vi.dtype == jnp.bool_ else vi != 0
+
+
+def counters_init(cfg: QBAConfig, vi0):
+    """Counter scan state from the step-3a accepted-sets: first-accept
+    rounds (0 = step 3a, -1 = pending) and the slot high-water mark.
+    ``vi0`` is bool[..., n_receivers, w]; leading axes (trial packing)
+    broadcast through."""
+    first_accept = jnp.where(vi0, jnp.int32(0), jnp.int32(-1))
+    high_water = jnp.zeros(vi0.shape[:-2], jnp.int32)
+    return first_accept, high_water
+
+
+def counters_step(cfg: QBAConfig, state, vi_old, vi_new, round_idx):
+    """Fold one round's acceptance delta into the counter state.
+
+    ``newly`` is exactly the set of (receiver, value) pairs accepted
+    this round; while ``round <= n_dishonest`` each acceptance queues a
+    rebroadcast (``tfg.py:298-299``), so the per-receiver newly-accepted
+    count IS the number of outgoing slots the receiver claimed — its
+    max over receivers/rounds is the slot high-water mark the
+    ``cfg.slots`` bound is sized against."""
+    first_accept, high_water = state
+    newly = vi_new & ~vi_old
+    r = jnp.asarray(round_idx, jnp.int32)
+    first_accept = jnp.where(newly, r, first_accept)
+    per_receiver = jnp.sum(newly, axis=-1, dtype=jnp.int32)
+    queued = jnp.where(r <= cfg.n_dishonest, per_receiver, 0)
+    high_water = jnp.maximum(high_water, jnp.max(queued, axis=-1))
+    accepts = jnp.sum(per_receiver, axis=-1)
+    return (first_accept, high_water), accepts
+
+
+def counters_finish(
+    cfg: QBAConfig, state, vi_final, accepts_per_round, overflow_rounds
+) -> ProtocolCounters:
+    first_accept, high_water = state
+    return ProtocolCounters(
+        first_accept_round=first_accept,
+        accept_counts=jnp.sum(vi_final, axis=-2, dtype=jnp.int32),
+        accepts_per_round=accepts_per_round,
+        slot_high_water=high_water,
+        overflow_rounds=overflow_rounds,
+    )
+
+
+def scan_rounds(cfg: QBAConfig, round_body, init):
+    """The shared round loop: ``lax.scan`` of ``round_body`` over
+    voting rounds ``1..n_rounds`` (``tfg.py:337``).
+
+    Every engine's round body carries ``(vi, <engine state>)`` and
+    emits a per-round overflow flag; that shared shape is what lets the
+    counters ride ANY engine without touching its kernels.  With
+    ``cfg.collect_counters`` the body is wrapped to also thread the
+    :class:`ProtocolCounters` state (computed from the vi delta around
+    the body); without it the original scan runs unchanged.
+
+    Returns ``(carry, overflow_stack, counter_state_or_None)``."""
+    rounds = jnp.arange(1, cfg.n_rounds + 1)
+    if not cfg.collect_counters:
+        carry, overflows = jax.lax.scan(round_body, init, rounds)
+        return carry, overflows, None
+
+    state0 = counters_init(cfg, _vi_bool(init[0]))
+
+    def body(carry, round_idx):
+        inner, state = carry
+        vi_old = _vi_bool(inner[0])
+        inner, ovf = round_body(inner, round_idx)
+        state, accepts = counters_step(
+            cfg, state, vi_old, _vi_bool(inner[0]), round_idx
+        )
+        return (inner, state), (ovf, accepts)
+
+    (carry, state), (overflows, accepts) = jax.lax.scan(
+        body, (init, state0), rounds
+    )
+    return carry, overflows, (state, accepts)
+
+
+def _finish_counters(cfg: QBAConfig, counter_state, vi_final, overflows):
+    """Counter state + stacked per-round overflow -> ProtocolCounters
+    (None passthrough when counters are off).  ``overflows`` may be
+    bool[n_rounds] (XLA/pallas) or a per-round int grid (tiled/fused
+    kernels) — normalized to a per-round bool here."""
+    if counter_state is None:
+        return None
+    state, accepts = counter_state
+    per_round = jnp.any(
+        jnp.reshape(_vi_bool(overflows), (cfg.n_rounds, -1)), axis=1
+    )
+    return counters_finish(cfg, state, vi_final, accepts, per_round)
 
 
 def run_rounds_xla(cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds):
@@ -504,10 +633,8 @@ def run_rounds_xla(cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds):
         )(draws, receiver_ids, vi, lieu_lists)
         return (vi, Mailbox(*out_cells)), jnp.any(ovf)
 
-    (vi, _), overflows = jax.lax.scan(
-        round_body, (vi, mb), jnp.arange(1, cfg.n_rounds + 1)
-    )
-    return vi, jnp.any(overflows)
+    (vi, _), overflows, cst = scan_rounds(cfg, round_body, (vi, mb))
+    return vi, jnp.any(overflows), _finish_counters(cfg, cst, vi, overflows)
 
 
 def run_rounds_pallas(
@@ -544,10 +671,9 @@ def run_rounds_pallas(
         return (vi_i32, tuple(new_packed)), ovf[0, 0] > 0
 
     init = (vi.astype(jnp.int32), pack(mb))
-    (vi_i32, _), overflows = jax.lax.scan(
-        round_body, init, jnp.arange(1, cfg.n_rounds + 1)
-    )
-    return vi_i32 != 0, jnp.any(overflows)
+    (vi_i32, _), overflows, cst = scan_rounds(cfg, round_body, init)
+    vi = vi_i32 != 0
+    return vi, jnp.any(overflows), _finish_counters(cfg, cst, vi, overflows)
 
 
 def run_rounds_tiled(
@@ -624,10 +750,9 @@ def run_rounds_tiled(
         return (vi_i32, pool_new), ovf
 
     init = (vi.astype(jnp.int32), pool)
-    (vi_i32, _), overflows = jax.lax.scan(
-        round_body, init, jnp.arange(1, cfg.n_rounds + 1)
-    )
-    return vi_i32 != 0, jnp.any(overflows)
+    (vi_i32, _), overflows, cst = scan_rounds(cfg, round_body, init)
+    vi = vi_i32 != 0
+    return vi, jnp.any(overflows), _finish_counters(cfg, cst, vi, overflows)
 
 
 def run_rounds_fused(
@@ -642,8 +767,6 @@ def run_rounds_fused(
     and the XLA oracle (tests/test_round_kernel_fused.py); demotes to
     :func:`run_rounds_tiled` with a warning where the fused kernel
     doesn't compile."""
-    import warnings
-
     from qba_tpu.ops.round_kernel_tiled import (
         build_fused_round_kernel,
         honest_cells as honest_cells_fn,
@@ -658,12 +781,18 @@ def run_rounds_fused(
     blk_v = resolve_tiled_block(cfg)
     blk_d = resolve_fused_block(cfg)
     if blk_d is None:
-        warnings.warn(
+        warn_and_record(
             "fused round kernel unavailable at (n_parties="
             f"{cfg.n_parties}, size_l={cfg.size_l}, slots={cfg.slots});"
             " demoting to the two-kernel tiled path",
             QBADemotionWarning,
+            site="rounds.engine.run_rounds_fused",
             stacklevel=2,
+            engine_from="pallas_fused",
+            engine_to="pallas_tiled",
+            n_parties=cfg.n_parties,
+            size_l=cfg.size_l,
+            slots=cfg.slots,
         )
         return run_rounds_tiled(
             cfg, vi, out_cells, lieu_lists, honest, k_rounds,
@@ -692,10 +821,9 @@ def run_rounds_fused(
         return (vi_i32, tuple(pool_new)), ovf
 
     init = (vi.astype(jnp.int32), pool)
-    (vi_i32, _), overflows = jax.lax.scan(
-        round_body, init, jnp.arange(1, cfg.n_rounds + 1)
-    )
-    return vi_i32 != 0, jnp.any(overflows)
+    (vi_i32, _), overflows, cst = scan_rounds(cfg, round_body, init)
+    vi = vi_i32 != 0
+    return vi, jnp.any(overflows), _finish_counters(cfg, cst, vi, overflows)
 
 
 def run_trials_fused_packed(cfg: QBAConfig, keys, pack: int):
@@ -785,20 +913,40 @@ def run_trials_fused_packed(cfg: QBAConfig, keys, pack: int):
             return (vi_k, tuple(pool_new)), ovf
 
         init = (vi_k, (vals, lens, p, meta))
-        (vi_k, _), ovfs = jax.lax.scan(
-            round_body, init, jnp.arange(1, cfg.n_rounds + 1)
-        )
-        return vi_k != 0, jnp.any(ovfs, axis=0)  # [k, n_rv, w], [k]
+        (vi_k, _), ovfs, cst = scan_rounds(cfg, round_body, init)
+        vi_b = vi_k != 0  # [k, n_rv, w]
+        counters = None
+        if cst is not None:
+            # Packed layout: counter state carries the leading [k] trial
+            # axis (counters_init/step broadcast through it); the
+            # per-round scan outputs stack rounds FIRST, so move the
+            # trial axis out front before assembling per-trial counters.
+            state, accepts = cst  # accepts [n_rounds, k]
+            per_round = jnp.any(
+                jnp.reshape(
+                    _vi_bool(ovfs), (cfg.n_rounds, pack, -1)
+                ),
+                axis=-1,
+            )  # [n_rounds, k]
+            counters = counters_finish(
+                cfg, state, vi_b,
+                jnp.moveaxis(accepts, 0, 1),
+                jnp.moveaxis(per_round, 0, 1),
+            )
+        return vi_b, jnp.any(ovfs, axis=0), counters  # [k,n_rv,w], [k]
 
-    vi_g, ovf_g = jax.vmap(run_group)(
+    vi_g, ovf_g, cnt_g = jax.vmap(run_group)(
         group(li_t), group(li_arg_t), group(k_rounds_t),
         group(vi_t), group(pool_t), group(hc_t),
     )
     vi_flat = vi_g.reshape((keys.shape[0],) + vi_g.shape[2:])
     ovf_flat = ovf_g.reshape((keys.shape[0],))
+    cnt_flat = jax.tree_util.tree_map(
+        lambda a: a.reshape((keys.shape[0],) + a.shape[2:]), cnt_g
+    )
     return jax.vmap(
-        lambda vi, vc, h, o: finish_trial(cfg, vi, vc, h, o)
-    )(vi_flat, v_comm_t, honest_t, ovf_flat)
+        lambda vi, vc, h, o, c: finish_trial(cfg, vi, vc, h, o, c)
+    )(vi_flat, v_comm_t, honest_t, ovf_flat, cnt_flat)
 
 
 def resolve_round_engine(cfg: QBAConfig) -> str:
@@ -859,20 +1007,22 @@ def run_trial(
     # Step 3b (tfg.py:337-348): synchronous rounds 1..n_dishonest+1.
     engine = resolve_round_engine(cfg)
     if engine == "pallas":
-        vi, overflow = run_rounds_pallas(
+        vi, overflow, counters = run_rounds_pallas(
             cfg, vi, mb, lieu_lists, honest, k_rounds,
             interpret=jax.default_backend() != "tpu",
         )
     elif engine == "pallas_tiled":
-        vi, overflow = run_rounds_tiled(
+        vi, overflow, counters = run_rounds_tiled(
             cfg, vi, out_cells, lieu_lists, honest, k_rounds,
             interpret=jax.default_backend() != "tpu",
         )
     elif engine == "pallas_fused":
-        vi, overflow = run_rounds_fused(
+        vi, overflow, counters = run_rounds_fused(
             cfg, vi, out_cells, lieu_lists, honest, k_rounds,
             interpret=jax.default_backend() != "tpu",
         )
     else:
-        vi, overflow = run_rounds_xla(cfg, vi, mb, lieu_lists, honest, k_rounds)
-    return finish_trial(cfg, vi, v_comm, honest, overflow)
+        vi, overflow, counters = run_rounds_xla(
+            cfg, vi, mb, lieu_lists, honest, k_rounds
+        )
+    return finish_trial(cfg, vi, v_comm, honest, overflow, counters)

@@ -30,6 +30,13 @@ class ChunkResult:
     trials: int
     successes: int
     overflow: bool
+    # Per-chunk phase timings (seconds), recorded when the sweep ran with
+    # timers; None in checkpoints written before telemetry landed.
+    # compare=False: timings are measurement metadata — a resumed sweep's
+    # chunks must compare equal to an uninterrupted run's
+    # (tests/test_cli_sweep.py pins chunk equality across resume).
+    dispatch_s: float | None = dataclasses.field(default=None, compare=False)
+    readback_s: float | None = dataclasses.field(default=None, compare=False)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -196,18 +203,24 @@ def run_sweep(
     # distinct phases ("dispatch"/"readback") so each phase's count equals
     # the number of chunks and per-chunk means stay honest; a finished
     # chunk is drained-and-checkpointed even if the next dispatch raises.
-    in_flight: list[tuple[int, Any]] = []
+    in_flight: list[tuple[int, Any, float]] = []
 
     def drain_one() -> None:
-        chunk, res = in_flight.pop(0)
-        with timers.time("readback"):
+        chunk, res, dispatch_s = in_flight.pop(0)
+        t0 = timers.total("readback")
+        with timers.time("readback", chunk=chunk) as sp:
             successes = int(np.sum(np.asarray(res.success)))
             overflow = bool(np.any(np.asarray(res.overflow)))
+            # The np.asarray reads above ARE the host readback barrier
+            # for this chunk's results (docs/PERF.md) — label the span.
+            sp.fenced = True
         cr = ChunkResult(
             chunk=chunk,
             trials=chunk_trials,
             successes=successes,
             overflow=overflow,
+            dispatch_s=dispatch_s,
+            readback_s=timers.total("readback") - t0,
         )
         chunks.append(cr)
         if checkpoint:
@@ -228,9 +241,10 @@ def run_sweep(
                 # backend.
                 runner = _default_runner(chunk_trials, log)
             keys = chunk_keys(cfg, chunk, chunk_trials)
-            with timers.time("dispatch"):
+            t0 = timers.total("dispatch")
+            with timers.time("dispatch", chunk=chunk):
                 res = runner(cfg, keys)
-            in_flight.append((chunk, res))
+            in_flight.append((chunk, res, timers.total("dispatch") - t0))
             if len(in_flight) >= 2:
                 drain_one()
     finally:

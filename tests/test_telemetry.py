@@ -1,0 +1,322 @@
+"""Run-telemetry tests: spans, the run manifest, protocol counters.
+
+Three contracts (docs/OBSERVABILITY.md):
+
+* Spans nest via the recorder's context stack, export as valid Chrome
+  trace JSON (``ph: "X"`` complete events with proper time containment),
+  and carry the ``fenced`` device-time attribution flag.
+* The run manifest validates against its schema, round-trips through
+  JSON, and names the actual engine + demotion chain for the resolved
+  plan — including the paper's (11,64,3) headline and (33,64,10)
+  north-star configs.
+* ``collect_counters=True`` adds a :class:`ProtocolCounters` auxiliary
+  output WITHOUT perturbing the primary outputs — bit-identical
+  decisions/success/vi/overflow on every jit engine.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from qba_tpu.backends.jax_backend import run_trials
+from qba_tpu.config import QBAConfig
+from qba_tpu.diagnostics import (
+    QBADemotionWarning,
+    QBAProbeWarning,
+    record_decisions,
+    warn_and_record,
+)
+from qba_tpu.obs.manifest import (
+    MANIFEST_SCHEMA,
+    collect_manifest,
+    demotion_chain,
+    load_manifest,
+    telemetry_session,
+    validate_manifest,
+    write_manifest,
+)
+from qba_tpu.obs.telemetry import SpanRecorder
+from qba_tpu.obs.timers import PhaseTimers
+
+JIT_ENGINES = ("xla", "pallas_tiled", "pallas_fused")
+
+
+class TestSpans:
+    def test_nesting_and_parents(self):
+        t = {"now": 0.0}
+        rec = SpanRecorder(clock=lambda: t["now"])
+        with rec.span("outer", cat="command"):
+            t["now"] += 1.0
+            with rec.span("inner", chunk=3):
+                t["now"] += 2.0
+            with rec.span("inner"):
+                t["now"] += 0.5
+        outer, in1, in2 = rec.spans
+        assert (outer.parent, outer.depth) == (None, 0)
+        assert in1.parent == outer.index and in1.depth == 1
+        assert in2.parent == outer.index
+        assert outer.dur == 3.5 and in1.dur == 2.0 and in2.dur == 0.5
+        assert in1.args == {"chunk": 3}
+        assert rec.totals()["inner"] == {"total_s": 2.5, "count": 2}
+
+    def test_exception_still_closes_span(self):
+        rec = SpanRecorder()
+        with pytest.raises(RuntimeError):
+            with rec.span("doomed"):
+                raise RuntimeError("boom")
+        assert rec.spans[0].dur is not None
+        assert rec._stack == []
+
+    def test_fence_marks_innermost_open_span(self):
+        cfg = QBAConfig(n_parties=3, size_l=4, trials=2)
+        rec = SpanRecorder()
+        with rec.span("trials"):
+            res = rec.fence(run_trials(cfg))
+        assert rec.spans[0].fenced
+        # fence returned the result unchanged (host-readable).
+        assert int(np.asarray(res.trials.decisions).shape[0]) == 2
+
+    def test_jsonl_export(self, tmp_path):
+        rec = SpanRecorder()
+        with rec.span("a", note="x"):
+            pass
+        path = rec.write_jsonl(str(tmp_path / "spans.jsonl"))
+        recs = [json.loads(line) for line in open(path)]
+        assert recs[0]["name"] == "a" and recs[0]["args"] == {"note": "x"}
+        assert recs[0]["dur_s"] is not None
+
+
+class TestChromeTrace:
+    def test_valid_json_complete_events_containment(self, tmp_path):
+        t = {"now": 10.0}
+        rec = SpanRecorder(clock=lambda: t["now"])
+        with rec.span("run", cat="command"):
+            t["now"] += 1.0
+            with rec.span("trials"):
+                t["now"] += 2.0
+                rec.fence(jax.numpy.zeros(()))
+            t["now"] += 0.25
+        path = rec.write_chrome_trace(str(tmp_path / "trace.json"))
+        trace = json.loads(open(path).read())
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert [e["name"] for e in xs] == ["run", "trials"]
+        run, trials = xs
+        for e in xs:  # complete events: ts + dur present, one pid/tid
+            assert e["dur"] > 0 and (e["pid"], e["tid"]) == (run["pid"], 0)
+        # Time containment is what makes Perfetto nest them.
+        assert run["ts"] <= trials["ts"]
+        assert trials["ts"] + trials["dur"] <= run["ts"] + run["dur"]
+        assert trials["args"]["fenced"] is True
+        assert "fenced" in trials["cat"]
+        assert run["args"]["fenced"] is False
+
+    def test_open_span_exported_with_duration_to_now(self):
+        t = {"now": 0.0}
+        rec = SpanRecorder(clock=lambda: t["now"])
+        cm = rec.span("crashy")
+        cm.__enter__()
+        t["now"] += 4.0
+        trace = rec.chrome_trace()
+        (ev,) = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert ev["dur"] == pytest.approx(4.0 * 1e6)
+        cm.__exit__(None, None, None)
+
+
+class TestPhaseTimersView:
+    def test_shared_recorder_spans_appear_in_trace(self):
+        rec = SpanRecorder()
+        timers = PhaseTimers(spans=rec)
+        with timers.time("dispatch", chunk=0):
+            pass
+        assert [sp.name for sp in rec.spans] == ["dispatch"]
+        assert timers.count("dispatch") == 1
+        assert rec.spans[0].args == {"chunk": 0}
+
+    def test_time_yields_span(self):
+        timers = PhaseTimers()
+        with timers.time("readback") as sp:
+            sp.fenced = True
+        assert timers.spans.spans[0].fenced
+
+
+class TestWarnAndRecord:
+    def test_hook_capture_and_warning(self):
+        with record_decisions() as decisions:
+            with pytest.warns(QBADemotionWarning, match="demoting"):
+                warn_and_record(
+                    "demoting to x",
+                    QBADemotionWarning,
+                    site="tests.here",
+                    engine_from="a",
+                    engine_to="b",
+                )
+        (rec,) = decisions
+        assert rec["kind"] == "demotion"
+        assert rec["category"] == "QBADemotionWarning"
+        assert rec["site"] == "tests.here"
+        assert (rec["engine_from"], rec["engine_to"]) == ("a", "b")
+        # Hooks are removed at context exit.
+        with pytest.warns(QBAProbeWarning):
+            warn_and_record("probe failed", QBAProbeWarning, site="t")
+        assert len(decisions) == 1
+
+    def test_broken_hook_never_blocks_the_warning(self):
+        from qba_tpu.diagnostics import add_decision_hook, remove_decision_hook
+
+        hook = add_decision_hook(lambda rec: 1 / 0)
+        try:
+            with pytest.warns(QBAProbeWarning):
+                warn_and_record("still warns", QBAProbeWarning, site="t")
+        finally:
+            remove_decision_hook(hook)
+
+
+class TestManifest:
+    @pytest.mark.parametrize(
+        "shape", [(11, 64, 3), (33, 64, 10)], ids=["headline", "northstar"]
+    )
+    def test_schema_roundtrip(self, tmp_path, shape):
+        p, l, d = shape
+        cfg = QBAConfig(n_parties=p, size_l=l, n_dishonest=d)
+        manifest = collect_manifest(cfg, command="test")
+        validate_manifest(manifest)
+        path = write_manifest(str(tmp_path / "m.json"), manifest)
+        loaded = load_manifest(path)  # load_manifest re-validates
+        assert loaded["schema"] == MANIFEST_SCHEMA
+        assert loaded["plan"]["engine"] == manifest["plan"]["engine"]
+        assert loaded["config"]["n_parties"] == p
+        assert loaded["config"]["derived"]["n_rounds"] == d + 1
+        # The chain starts at the requested engine and ends at what ran.
+        assert loaded["demotion_chain"][0] == cfg.round_engine
+        assert loaded["demotion_chain"][-1] in (
+            "xla", "pallas", "pallas_tiled", "pallas_fused",
+        )
+        for key in ("before", "after", "delta"):
+            assert isinstance(loaded["probe_stats"][key], dict)
+
+    def test_validate_rejects_and_collects_all_problems(self):
+        with pytest.raises(ValueError) as ei:
+            validate_manifest({"schema": "wrong", "plan": []})
+        msg = str(ei.value)
+        assert "schema" in msg and "missing key" in msg and "plan" in msg
+
+    def test_demotion_chain_fused_without_block(self):
+        cfg = QBAConfig(n_parties=5, size_l=8, round_engine="pallas_fused")
+        plan = {"engine": "pallas_fused", "fused_block": None}
+        assert demotion_chain(cfg, plan) == ["pallas_fused", "pallas_tiled"]
+
+    def test_counters_enabled_recorded(self):
+        cfg = QBAConfig(n_parties=5, size_l=8, collect_counters=True)
+        assert collect_manifest(cfg, command="t")["counters_enabled"] is True
+
+    def test_telemetry_session_writes_artifacts(self, tmp_path):
+        cfg = QBAConfig(n_parties=5, size_l=8, n_dishonest=1, trials=2)
+        directory = str(tmp_path / "telemetry")
+        with telemetry_session(directory, cfg, "run") as session:
+            timers = PhaseTimers(spans=session.spans)
+            with timers.time("trials") as sp:
+                res = run_trials(cfg)
+                sp.fenced = True
+            session.extra["note"] = "smoke"
+        manifest = load_manifest(session.manifest_path)
+        assert manifest["command"] == "run" and manifest["note"] == "smoke"
+        assert "trials" in manifest["phase_totals"]
+        trace = json.loads(open(session.trace_path).read())
+        names = [e["name"] for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert names == ["run", "trials"]
+        assert (tmp_path / "telemetry" / "spans.jsonl").exists()
+        assert int(np.asarray(res.trials.decisions).shape[0]) == 2
+
+    def test_telemetry_session_writes_on_failure(self, tmp_path):
+        cfg = QBAConfig(n_parties=5, size_l=8)
+        directory = str(tmp_path / "t")
+        with pytest.raises(RuntimeError):
+            with telemetry_session(directory, cfg, "run") as session:
+                raise RuntimeError("mid-run crash")
+        load_manifest(session.manifest_path)  # still written + valid
+
+
+class TestProtocolCounters:
+    @pytest.mark.parametrize("engine", JIT_ENGINES)
+    def test_primary_outputs_bit_identical(self, engine):
+        cfg_off = QBAConfig(
+            n_parties=7, size_l=16, n_dishonest=2, trials=8, seed=11,
+            round_engine=engine,
+        )
+        cfg_on = dataclasses.replace(cfg_off, collect_counters=True)
+        off, on = run_trials(cfg_off), run_trials(cfg_on)
+        for field in ("decisions", "success", "vi", "overflow"):
+            a = np.asarray(getattr(off.trials, field))
+            b = np.asarray(getattr(on.trials, field))
+            assert np.array_equal(a, b), (engine, field)
+        assert off.trials.counters is None
+        assert on.trials.counters is not None
+
+    @pytest.mark.parametrize("engine", JIT_ENGINES)
+    def test_counters_consistent_with_vi(self, engine):
+        cfg = QBAConfig(
+            n_parties=7, size_l=16, n_dishonest=2, trials=8, seed=5,
+            round_engine=engine, collect_counters=True,
+        )
+        res = run_trials(cfg)
+        c = res.trials.counters
+        vi = np.asarray(res.trials.vi)
+        first = np.asarray(c.first_accept_round)
+        # A (receiver, value) was accepted iff it has a first-accept
+        # round; rounds are 0 (step 3a) .. n_rounds.
+        assert np.array_equal(first >= 0, vi)
+        assert first.max() <= cfg.n_rounds
+        assert np.array_equal(
+            np.asarray(c.accept_counts), vi.sum(axis=-2)
+        )
+        # Per-round accepts total the post-step-3a acceptances.
+        step3a = int((first == 0).sum())
+        assert int(np.asarray(c.accepts_per_round).sum()) == int(
+            vi.sum() - step3a
+        )
+        assert np.asarray(c.slot_high_water).min() >= 0
+        assert np.asarray(c.overflow_rounds).shape == (
+            cfg.trials, cfg.n_rounds,
+        )
+        # Any per-round overflow must surface in the trial overflow flag.
+        assert np.array_equal(
+            np.asarray(c.overflow_rounds).any(axis=-1)
+            | ~np.asarray(res.trials.overflow),
+            np.ones(cfg.trials, bool),
+        ) or not np.asarray(res.trials.overflow).any()
+
+    def test_counters_identical_across_engines(self):
+        results = {}
+        for engine in JIT_ENGINES:
+            cfg = QBAConfig(
+                n_parties=7, size_l=16, n_dishonest=2, trials=8, seed=3,
+                round_engine=engine, collect_counters=True,
+            )
+            results[engine] = run_trials(cfg).trials.counters
+        ref = results["xla"]
+        for engine in JIT_ENGINES[1:]:
+            for field in dataclasses.fields(ref):
+                assert np.array_equal(
+                    np.asarray(getattr(ref, field.name)),
+                    np.asarray(getattr(results[engine], field.name)),
+                ), (engine, field.name)
+
+    def test_packed_fused_counters_match_unpacked(self):
+        base = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=1, trials=8, seed=7,
+            round_engine="pallas_fused", collect_counters=True,
+        )
+        packed = run_trials(dataclasses.replace(base, trial_pack=2))
+        plain = run_trials(dataclasses.replace(base, trial_pack=1))
+        for field in dataclasses.fields(plain.trials.counters):
+            assert np.array_equal(
+                np.asarray(getattr(packed.trials.counters, field.name)),
+                np.asarray(getattr(plain.trials.counters, field.name)),
+            ), field.name
+        assert np.array_equal(
+            np.asarray(packed.trials.decisions),
+            np.asarray(plain.trials.decisions),
+        )
