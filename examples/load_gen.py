@@ -1,0 +1,184 @@
+"""Load generator for the evaluation service (docs/SERVING.md).
+
+Replays a mixed-shape request stream (three (n, sizeL, d) buckets,
+interleaved, varied seeds and trial counts) against a `qba-tpu serve`
+process over the file-queue transport, then reports:
+
+* sustained throughput (requests/min, end to end across the stream),
+* p50/p99 latency computed from the returned span data — each result's
+  ``latency_s`` is the duration of that request's ``request`` span, so
+  the summary here reproduces the server's own span-derived numbers,
+* manifest validation (every result must carry a schema-clean run
+  manifest), and
+* a bit-identity spot check: one request per bucket re-run directly
+  through the engine must match the served result trial for trial.
+
+Usage:
+    python examples/load_gen.py                     # subprocess server
+    python examples/load_gen.py --in-process        # same, no subprocess
+    python examples/load_gen.py --requests 60 --chunk-trials 16
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+import time
+import types
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# Three shape buckets: small-cheap, wider party count, longer sizeL.
+BUCKETS = (
+    dict(n_parties=4, size_l=8, n_dishonest=1),
+    dict(n_parties=5, size_l=8, n_dishonest=1),
+    dict(n_parties=4, size_l=16, n_dishonest=2),
+)
+
+
+def make_stream(n_requests: int, trials: int):
+    from qba_tpu.serve import EvalRequest
+
+    return [
+        EvalRequest(
+            request_id=f"lg{i:04d}",
+            trials=trials + (i % 3),  # varied sizes exercise chunk packing
+            seed=17 * i + 1,
+            **BUCKETS[i % len(BUCKETS)],
+        )
+        for i in range(n_requests)
+    ]
+
+
+def run_in_process(args, stream):
+    from qba_tpu.serve import QBAServer, serve_batch
+
+    server = QBAServer(
+        chunk_trials=args.chunk_trials,
+        telemetry_dir=args.telemetry,
+        cache_dir=args.cache_dir,
+    )
+    t0 = time.perf_counter()
+    results = [r.to_json() for r in serve_batch(server, stream)]
+    return results, time.perf_counter() - t0
+
+
+def run_subprocess(args, stream):
+    queue_dir = args.queue_dir or tempfile.mkdtemp(prefix="qba_serve_")
+    inbox = os.path.join(queue_dir, "inbox")
+    outbox = os.path.join(queue_dir, "outbox")
+    os.makedirs(inbox, exist_ok=True)
+    cmd = [
+        sys.executable, "-m", "qba_tpu", "serve",
+        "--transport", "file-queue", "--queue-dir", queue_dir,
+        "--chunk-trials", str(args.chunk_trials),
+    ]
+    if args.telemetry:
+        cmd += ["--telemetry", args.telemetry]
+    if args.cache_dir:
+        cmd += ["--cache-dir", args.cache_dir]
+    proc = subprocess.Popen(cmd)
+    try:
+        t0 = time.perf_counter()
+        for req in stream:
+            # Temp-file + rename so the server never reads partial JSON.
+            tmp = os.path.join(inbox, f".{req.request_id}.tmp")
+            with open(tmp, "w") as f:
+                json.dump(req.to_json(), f)
+            os.replace(tmp, os.path.join(inbox, req.request_id + ".json"))
+        deadline = time.time() + args.timeout_s
+        while time.time() < deadline:
+            done = os.listdir(outbox) if os.path.isdir(outbox) else []
+            if len(done) >= len(stream):
+                break
+            if proc.poll() is not None:
+                raise RuntimeError(f"server exited early (rc={proc.returncode})")
+            time.sleep(0.05)
+        else:
+            raise RuntimeError(
+                f"timed out: {len(os.listdir(outbox))}/{len(stream)} results"
+            )
+        elapsed = time.perf_counter() - t0
+    finally:
+        open(os.path.join(queue_dir, "stop"), "w").close()
+        proc.wait(timeout=120)
+    results = []
+    for name in sorted(os.listdir(outbox)):
+        with open(os.path.join(outbox, name)) as f:
+            results.append(json.load(f))
+    return results, elapsed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=21)
+    ap.add_argument("--trials", type=int, default=6, help="trials per request (base)")
+    ap.add_argument("--chunk-trials", type=int, default=8)
+    ap.add_argument("--in-process", action="store_true",
+                    help="drive QBAServer directly instead of a subprocess")
+    ap.add_argument("--queue-dir", default=None)
+    ap.add_argument("--telemetry", default=None,
+                    help="per-request manifest/trace directory")
+    ap.add_argument("--cache-dir", default=None,
+                    help="warm-start artifact directory")
+    ap.add_argument("--timeout-s", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    stream = make_stream(args.requests, args.trials)
+    if args.in_process:
+        results, elapsed = run_in_process(args, stream)
+    else:
+        results, elapsed = run_subprocess(args, stream)
+
+    errors = [r for r in results if r.get("error")]
+    if errors:
+        raise SystemExit(f"{len(errors)} requests failed: {errors[:3]}")
+    if len(results) != len(stream):
+        raise SystemExit(f"got {len(results)} results for {len(stream)} requests")
+
+    # Every result must carry a schema-clean manifest.
+    from qba_tpu.obs.manifest import validate_manifest
+
+    for r in results:
+        validate_manifest(r["manifest"])
+
+    # Bit-identity spot check: first request of each bucket vs a direct
+    # engine run of the identical config.
+    from qba_tpu.backends.jax_backend import run_trials, trial_keys
+
+    by_id = {r["request_id"]: r for r in results}
+    for req in stream[: len(BUCKETS)]:
+        direct = run_trials(req.config(), trial_keys(req.config()))
+        import numpy as np
+
+        want = [bool(x) for x in np.asarray(direct.trials.success)]
+        got = by_id[req.request_id]["success"]
+        if got != want:
+            raise SystemExit(f"bit-identity violation on {req.request_id}")
+
+    # p50/p99 from the returned span data: latency_s IS each request's
+    # span duration, so feed them back through the span summarizer.
+    from qba_tpu.obs.telemetry import span_latency_summary
+
+    spans = [
+        types.SimpleNamespace(name="request", dur=r["latency_s"])
+        for r in results
+    ]
+    lat = span_latency_summary(spans, "request")
+    rpm = len(results) / elapsed * 60.0
+    print(f"requests:        {len(results)} across {len(BUCKETS)} buckets")
+    print(f"wall time:       {elapsed:.2f} s")
+    print(f"sustained rate:  {rpm:.1f} requests/min")
+    print(f"latency p50:     {lat['p50_s'] * 1e3:.1f} ms")
+    print(f"latency p99:     {lat['p99_s'] * 1e3:.1f} ms")
+    print(f"latency mean:    {lat['mean_s'] * 1e3:.1f} ms  "
+          f"(min {lat['min_s'] * 1e3:.1f}, max {lat['max_s'] * 1e3:.1f})")
+    print("manifests:       all valid; bit-identity spot check passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

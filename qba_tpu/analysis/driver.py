@@ -45,6 +45,25 @@ def lint_configs() -> list[tuple[str, QBAConfig]]:
     return [(label, QBAConfig(**kw)) for label, kw in LINT_MATRIX]
 
 
+def saved_plan_configs(path: str) -> list[tuple[str, QBAConfig]]:
+    """Lint matrix points for every shape recorded in a serve
+    warm-start artifact (``plans.json``, :mod:`qba_tpu.serve.persist`).
+
+    Plans restored from disk skip the live probe path entirely, so
+    without this hook a server could dispatch on engine builds the KI
+    gates never saw; ``qba-tpu lint --saved-plans`` closes that gap by
+    re-tracing exactly the dispatched shapes."""
+    from qba_tpu.serve.persist import saved_configs
+
+    return [
+        (
+            f"plan:{cfg.n_parties}p-L{cfg.size_l}-d{cfg.n_dishonest}",
+            cfg,
+        )
+        for cfg in saved_configs(path)
+    ]
+
+
 def _lint_config(
     label: str, cfg: QBAConfig, engines, sitewide: bool,
 ) -> Report:

@@ -16,6 +16,10 @@ config is explicit and validated (:class:`qba_tpu.config.QBAConfig`):
 * ``lint``  — static KI-1/KI-2/KI-3 invariant check over every traced
   kernel build path (:mod:`qba_tpu.analysis`, docs/ANALYSIS.md); the
   CI gate.  Exit 1 when findings exist, 0 on a clean tree.
+* ``serve`` — persistent evaluation service: answers request streams
+  (stdin-JSONL or file-queue) with shape-bucketed, double-buffered
+  dispatch and per-request run manifests (:mod:`qba_tpu.serve`,
+  docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -229,9 +233,70 @@ def _parser() -> argparse.ArgumentParser:
         "the built-in matrix (repeatable)",
     )
     lint.add_argument(
+        "--saved-plans", metavar="PLANS_JSON", default=None,
+        help="also lint every shape recorded in a serve warm-start "
+        "artifact (<cache-dir>/plans.json) so plans restored from disk "
+        "pass the same KI gates as freshly probed ones "
+        "(docs/SERVING.md)",
+    )
+    lint.add_argument(
         "-v", "--verbose", action="store_true",
         help="print notes (plan predictions, HBM ceilings) even when "
         "there are findings",
+    )
+
+    serve = sub.add_parser(
+        "serve",
+        help="persistent evaluation service: answer EvalRequest streams "
+        "with bucketed, double-buffered dispatch (docs/SERVING.md)",
+    )
+    serve.add_argument(
+        "--transport", choices=("jsonl", "file-queue"), default="jsonl",
+        help="jsonl = one request per stdin line, one result per stdout "
+        "line; file-queue = poll <queue-dir>/inbox for request files, "
+        "write results to <queue-dir>/outbox (stop via a 'stop' file)",
+    )
+    serve.add_argument(
+        "--queue-dir", metavar="DIR", default=None,
+        help="queue directory (required for --transport file-queue)",
+    )
+    serve.add_argument(
+        "--chunk-trials", type=int, default=64,
+        help="trials per device chunk; same-bucket requests are packed "
+        "into chunks of this size (partial chunks are padded at flush)",
+    )
+    serve.add_argument(
+        "--depth", type=int, default=2,
+        help="double-buffer depth: chunks in flight before the host "
+        "reads back the trailing one (1 disables the overlap)",
+    )
+    serve.add_argument(
+        "--telemetry", metavar="DIR", default=None,
+        help="write one run_manifest.json + spans.jsonl + trace.json "
+        "per request under DIR/<request_id>/",
+    )
+    serve.add_argument(
+        "--cache-dir", metavar="DIR", default=None,
+        help="warm-start artifact directory: <DIR>/xla holds the "
+        "persistent XLA compilation cache, <DIR>/plans.json the saved "
+        "resolver plans (loaded at boot, saved at every flush)",
+    )
+    serve.add_argument(
+        "--no-warm-start", action="store_true",
+        help="do not restore plans.json at boot (still saved at flush)",
+    )
+    serve.add_argument(
+        "--max-requests", type=int, default=None,
+        help="exit after consuming this many requests (CI smoke)",
+    )
+    serve.add_argument(
+        "--poll-s", type=float, default=0.05,
+        help="file-queue inbox poll interval in seconds",
+    )
+    serve.add_argument(
+        "--cache-stats", action="store_true",
+        help="print the resolver-cache/probe counters (size, cap, "
+        "evictions) plus the cache-dir artifact status and exit",
     )
 
     study = sub.add_parser(
@@ -616,7 +681,11 @@ def _cmd_study(args: argparse.Namespace, out) -> int:
 
 
 def _cmd_lint(args: argparse.Namespace, out) -> int:
-    from qba_tpu.analysis.driver import lint_configs, run_lint
+    from qba_tpu.analysis.driver import (
+        lint_configs,
+        run_lint,
+        saved_plan_configs,
+    )
 
     engines = (
         [e.strip() for e in args.engines.split(",") if e.strip()]
@@ -634,9 +703,76 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
             configs.append((f"({p},{l},{d})", QBAConfig(p, l, d)))
     else:
         configs = lint_configs()
+    if args.saved_plans:
+        # Shapes a server has actually dispatched (warm-start artifact)
+        # get the same gates as the built-in matrix, deduplicated
+        # against points already covered.
+        covered = {
+            (c.n_parties, c.size_l, c.n_dishonest) for _, c in configs
+        }
+        for label, cfg in saved_plan_configs(args.saved_plans):
+            if (cfg.n_parties, cfg.size_l, cfg.n_dishonest) not in covered:
+                configs.append((label, cfg))
     report = run_lint(configs=configs, engines=engines)
     print(report.render(verbose=args.verbose), file=out)
     return 0 if report.ok else 1
+
+
+def _cmd_serve(args: argparse.Namespace, out) -> int:
+    import json
+
+    if args.cache_stats:
+        import os
+
+        from qba_tpu.compile_cache import plans_path, xla_cache_dir
+        from qba_tpu.ops.round_kernel_tiled import resolve_cache_info
+        from qba_tpu.serve.persist import saved_configs
+
+        info: dict = {"resolver": resolve_cache_info()}
+        if args.cache_dir:
+            plans = plans_path(args.cache_dir)
+            artifact: dict = {
+                "xla_cache_dir": xla_cache_dir(args.cache_dir),
+                "plans_path": plans,
+                "plans_exists": os.path.exists(plans),
+            }
+            if artifact["plans_exists"]:
+                try:
+                    artifact["saved_shapes"] = len(saved_configs(plans))
+                except ValueError as e:
+                    artifact["plans_error"] = str(e)
+            info["cache_dir"] = artifact
+        print(json.dumps(info, indent=1, default=str), file=out)
+        return 0
+
+    from qba_tpu.serve import QBAServer, serve_file_queue, serve_jsonl
+
+    server = QBAServer(
+        chunk_trials=args.chunk_trials,
+        depth=args.depth,
+        telemetry_dir=args.telemetry,
+        cache_dir=args.cache_dir,
+        warm_start=not args.no_warm_start,
+    )
+    if args.transport == "file-queue":
+        if not args.queue_dir:
+            raise ValueError(
+                "serve: --queue-dir is required with --transport file-queue"
+            )
+        stats = serve_file_queue(
+            server,
+            args.queue_dir,
+            poll_s=args.poll_s,
+            max_requests=args.max_requests,
+        )
+    else:
+        stats = serve_jsonl(
+            server, sys.stdin, out, max_requests=args.max_requests
+        )
+    # Results went to stdout/outbox; the operator summary goes to
+    # stderr so jsonl result streams stay machine-parseable.
+    print(json.dumps({"serve_summary": stats}, default=str), file=sys.stderr)
+    return 0
 
 
 def main(argv: Sequence[str] | None = None, out=None) -> int:
@@ -656,6 +792,8 @@ def main(argv: Sequence[str] | None = None, out=None) -> int:
             return _cmd_study(args, out)
         if args.command == "lint":
             return _cmd_lint(args, out)
+        if args.command == "serve":
+            return _cmd_serve(args, out)
     except ValueError as e:  # config validation -> clean CLI failure
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -1,10 +1,19 @@
-"""Persistent XLA compilation cache for the command-line tools.
+"""Persistent warm-start artifacts: XLA executables + resolver plans.
 
 The protocol programs take tens of seconds to compile (remote TPU
 compiles especially); caching compiled executables on disk makes
 repeated CLI/bench invocations of the same config start in seconds.
 Library imports never enable this — only the tool entry points call it —
 so embedding applications keep full control of JAX global config.
+
+The serving subsystem (:mod:`qba_tpu.serve`) promotes this module from
+the CLI's opt-in convenience to a first-class artifact layout: a cache
+directory holds the XLA compilation cache (``xla/``) next to the saved
+resolver-plan file (``plans.json`` — every memoized block/variant/pack
+verdict, :func:`qba_tpu.ops.round_kernel_tiled.export_resolver_state`),
+so a server boot restores BOTH halves of warm start: compiled
+executables from the XLA cache, dispatch decisions from the plan file —
+zero compile probes on the second boot (tests/test_serve.py).
 """
 
 from __future__ import annotations
@@ -12,16 +21,42 @@ from __future__ import annotations
 import os
 
 
-def enable_compile_cache() -> None:
-    """Point JAX's persistent compilation cache at a per-user directory
-    (override with ``QBA_COMPILE_CACHE``; set it empty to disable).
-    Harmless if the directory is unwritable (jax warns and continues)."""
+def default_cache_root() -> str:
+    """The per-user artifact root (override with ``QBA_CACHE_ROOT``)."""
+    return os.environ.get(
+        "QBA_CACHE_ROOT",
+        os.path.join(os.path.expanduser("~"), ".cache", "qba_tpu"),
+    )
+
+
+def xla_cache_dir(cache_dir: str | None = None) -> str:
+    """The XLA compilation-cache directory inside ``cache_dir`` (default:
+    the per-user root).  The legacy env override ``QBA_COMPILE_CACHE``
+    keeps working when no explicit directory is given."""
+    if cache_dir is not None:
+        return os.path.join(cache_dir, "xla")
+    return os.environ.get(
+        "QBA_COMPILE_CACHE", os.path.join(default_cache_root(), "jax")
+    )
+
+
+def plans_path(cache_dir: str | None = None) -> str:
+    """The saved resolver-plan artifact inside ``cache_dir`` (default:
+    the per-user root) — see :mod:`qba_tpu.serve.persist`."""
+    return os.path.join(cache_dir or default_cache_root(), "plans.json")
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``path`` (default:
+    :func:`xla_cache_dir`, whose ``QBA_COMPILE_CACHE`` env override can
+    be set empty to disable).  Harmless if the directory is unwritable
+    (jax warns and continues).  Returns the directory actually set, or
+    None when disabled."""
     import jax
 
-    path = os.environ.get(
-        "QBA_COMPILE_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "qba_tpu", "jax"),
-    )
+    path = xla_cache_dir() if path is None else path
     if path:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        return path
+    return None

@@ -31,7 +31,7 @@ import dataclasses
 import json
 import os
 import time
-from typing import Any, Callable, Iterator
+from typing import Any, Callable, Iterable, Iterator
 
 
 def _jsonable(v: Any) -> Any:
@@ -187,3 +187,43 @@ class SpanRecorder:
         with open(path, "w") as f:
             json.dump(self.chrome_trace(), f, indent=1)
         return path
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Linear-interpolation percentile over pre-sorted values (numpy's
+    default method, reimplemented so latency summaries stay jax/numpy
+    free like the rest of this module)."""
+    if not sorted_vals:
+        raise ValueError("percentile of empty sequence")
+    pos = (len(sorted_vals) - 1) * (q / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def span_latency_summary(
+    spans: Iterable[Span],
+    name: str,
+    percentiles: tuple[float, ...] = (50.0, 99.0),
+) -> dict[str, Any]:
+    """Latency distribution of every closed span named ``name``.
+
+    This is the serving subsystem's p50/p99 instrument: the span tree
+    already records one ``request`` span per served request, so the
+    latency report is *derived from* the telemetry rather than a second
+    bookkeeping path (Dapper's leave-it-on design point — see
+    docs/SERVING.md).  Keys: ``count``, ``mean_s``, ``min_s``,
+    ``max_s``, and one ``p<q>_s`` per requested percentile."""
+    durs = sorted(
+        sp.dur for sp in spans if sp.name == name and sp.dur is not None
+    )
+    summary: dict[str, Any] = {"name": name, "count": len(durs)}
+    if not durs:
+        return summary
+    summary["mean_s"] = sum(durs) / len(durs)
+    summary["min_s"] = durs[0]
+    summary["max_s"] = durs[-1]
+    for q in percentiles:
+        summary[f"p{q:g}_s"] = _percentile(durs, q)
+    return summary

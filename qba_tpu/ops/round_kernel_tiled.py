@@ -51,6 +51,8 @@ int32 planes), exact for ``w <= 64`` — covering the 33-party north star
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -2022,14 +2024,59 @@ _FUSED_PROBE_CACHE: dict[tuple, int | None] = {}
 # candidate enumeration + cache plumbing on EVERY measure_batch call —
 # and, off-TPU, re-ran the estimate arithmetic per call.  PROBE_STATS
 # makes the caching observable (tests assert same-shape re-resolution
-# adds hits, not misses or probes).
+# adds hits, not misses or probes, and that evictions are counted).
 PROBE_STATS: dict[str, int] = {
     "compile_probes": 0,
     "resolve_hits": 0,
     "resolve_misses": 0,
+    "resolve_evictions": 0,
 }
 
-_RESOLVE_CACHE: dict[tuple, object] = {}
+# LRU-bounded: one-shot CLI runs never approach the cap, but a
+# long-lived serving process (qba_tpu/serve) sees unbounded mixed-shape
+# traffic, and an unbounded memo is a slow leak.  The cap is generous —
+# an entry is a small tuple -> scalar pair, so thousands cost ~nothing;
+# the bound exists so the worst case is recomputation (a re-probe at
+# most), never growth.  Hits refresh recency; evictions land in
+# PROBE_STATS["resolve_evictions"] and the `qba-tpu serve --cache-stats`
+# readout.
+from collections import OrderedDict as _OrderedDict  # noqa: E402
+
+_RESOLVE_CACHE: "_OrderedDict[tuple, object]" = _OrderedDict()
+_RESOLVE_CACHE_CAP = int(os.environ.get("QBA_RESOLVE_CACHE_CAP", "4096"))
+
+
+def set_resolve_cache_cap(cap: int) -> int:
+    """Set the resolver-memo LRU capacity (entries); returns the old
+    cap.  ``cap < 1`` is rejected — a zero-capacity memo would turn
+    every resolution into a miss and, on TPU, a fresh compile probe."""
+    global _RESOLVE_CACHE_CAP
+    if cap < 1:
+        raise ValueError(f"resolve cache cap must be >= 1; got {cap}")
+    old, _RESOLVE_CACHE_CAP = _RESOLVE_CACHE_CAP, cap
+    while len(_RESOLVE_CACHE) > _RESOLVE_CACHE_CAP:
+        _RESOLVE_CACHE.popitem(last=False)
+        PROBE_STATS["resolve_evictions"] += 1
+    return old
+
+
+def resolve_cache_info() -> dict:
+    """Observable state of the resolver memo + probe caches (the
+    ``qba-tpu serve --cache-stats`` readout)."""
+    return {
+        "resolve_cache": {
+            "size": len(_RESOLVE_CACHE),
+            "cap": _RESOLVE_CACHE_CAP,
+            "evictions": PROBE_STATS["resolve_evictions"],
+        },
+        "probe_caches": {
+            "tiled": len(_TILED_PROBE_CACHE),
+            "rebuild": len(_REBUILD_PROBE_CACHE),
+            "fused": len(_FUSED_PROBE_CACHE),
+            "variant": len(_VARIANT_CACHE),
+        },
+        "probe_stats": dict(PROBE_STATS),
+    }
 
 
 def clear_resolve_caches() -> None:
@@ -2044,11 +2091,90 @@ def clear_resolve_caches() -> None:
 def _memo(key: tuple, compute):
     if key in _RESOLVE_CACHE:
         PROBE_STATS["resolve_hits"] += 1
+        _RESOLVE_CACHE.move_to_end(key)
         return _RESOLVE_CACHE[key]
     PROBE_STATS["resolve_misses"] += 1
     val = compute()
+    # compute() may itself memoize (resolve_fused_block resolves the
+    # verdict block first), so insert after it returns and re-check the
+    # bound against the final size.
     _RESOLVE_CACHE[key] = val
+    _RESOLVE_CACHE.move_to_end(key)
+    while len(_RESOLVE_CACHE) > _RESOLVE_CACHE_CAP:
+        _RESOLVE_CACHE.popitem(last=False)
+        PROBE_STATS["resolve_evictions"] += 1
     return val
+
+
+# ---------------------------------------------------------------------------
+# Warm-start seam (qba_tpu/serve): the resolver memo and the in-process
+# probe/variant caches, exported as one JSON-able artifact and restored
+# into a fresh process.  A server boot that imports a saved state
+# resolves every covered shape with ZERO new probes or misses
+# (tests/test_serve.py pins this via PROBE_STATS).  Keys are tuples of
+# primitives (one nested shape tuple); JSON round-trips them as nested
+# lists, restored tuple-for-tuple below.
+
+RESOLVER_STATE_SCHEMA = "qba-tpu/resolver-state/v1"
+
+
+def _key_from_json(k):
+    return tuple(_key_from_json(x) if isinstance(x, list) else x for x in k)
+
+
+def export_resolver_state() -> dict:
+    """JSON-able snapshot of every in-process resolution verdict: the
+    resolver memo plus the compile-probe and variant caches.  Values
+    are scalars (block sizes, pack factors, variant names, booleans,
+    None); the import side rejects a state recorded by a different jax
+    version or backend — a probe verdict is only valid where it was
+    probed (same discipline as the disk probe cache key)."""
+    return {
+        "schema": RESOLVER_STATE_SCHEMA,
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "resolve": [[list(k), v] for k, v in _RESOLVE_CACHE.items()],
+        "variant": [[list(k), v] for k, v in _VARIANT_CACHE.items()],
+        "probe": {
+            "tiled": [[list(k), v] for k, v in _TILED_PROBE_CACHE.items()],
+            "rebuild": [
+                [list(k), v] for k, v in _REBUILD_PROBE_CACHE.items()
+            ],
+            "fused": [[list(k), v] for k, v in _FUSED_PROBE_CACHE.items()],
+        },
+    }
+
+
+def import_resolver_state(state: dict) -> int:
+    """Restore a :func:`export_resolver_state` snapshot; returns the
+    number of entries restored (0 for a stale/mismatched state).
+    Restoring does NOT touch PROBE_STATS — imported verdicts are not
+    hits, misses, or probes; they are the reason none of those happen.
+    Entries merge under the LRU discipline (the cap still holds)."""
+    if (
+        state.get("schema") != RESOLVER_STATE_SCHEMA
+        or state.get("jax_version") != jax.__version__
+        or state.get("backend") != jax.default_backend()
+    ):
+        return 0
+    n = 0
+    for k, v in state.get("resolve", []):
+        _RESOLVE_CACHE[_key_from_json(k)] = v
+        _RESOLVE_CACHE.move_to_end(_key_from_json(k))
+        n += 1
+    while len(_RESOLVE_CACHE) > _RESOLVE_CACHE_CAP:
+        _RESOLVE_CACHE.popitem(last=False)
+        PROBE_STATS["resolve_evictions"] += 1
+    for cache, entries in (
+        (_VARIANT_CACHE, state.get("variant", [])),
+        (_TILED_PROBE_CACHE, state.get("probe", {}).get("tiled", [])),
+        (_REBUILD_PROBE_CACHE, state.get("probe", {}).get("rebuild", [])),
+        (_FUSED_PROBE_CACHE, state.get("probe", {}).get("fused", [])),
+    ):
+        for k, v in entries:
+            cache[_key_from_json(k)] = v
+            n += 1
+    return n
 
 
 def _resolve_key(kind: str, cfg: QBAConfig, n_recv=None,

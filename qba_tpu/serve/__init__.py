@@ -1,0 +1,38 @@
+"""qba_tpu.serve — persistent QBA evaluation service.
+
+The serving subsystem (ROADMAP item 3): a long-lived engine process
+that answers :class:`EvalRequest` s ("n parties, d traitors — failure
+probability at sizeL=L?") by bucketing mixed-shape traffic onto the
+memoized kernel plans, double-buffering device chunks against host
+readback, and emitting one validated run manifest + span tree per
+request.  See docs/SERVING.md.
+
+Module map:
+
+* :mod:`~qba_tpu.serve.request` — wire model (EvalRequest/EvalResult).
+* :mod:`~qba_tpu.serve.scheduler` — shape buckets, chunk packing.
+* :mod:`~qba_tpu.serve.engine` — :class:`QBAServer`, the dispatch loop.
+* :mod:`~qba_tpu.serve.transport` — stdin-JSONL and file-queue drivers.
+* :mod:`~qba_tpu.serve.persist` — the ``plans.json`` warm-start artifact.
+"""
+
+from qba_tpu.serve.engine import QBAServer, serve_batch
+from qba_tpu.serve.persist import load_plans, save_plans, saved_configs
+from qba_tpu.serve.request import EvalRequest, EvalResult
+from qba_tpu.serve.scheduler import BucketScheduler, bucket_config, bucket_label
+from qba_tpu.serve.transport import serve_file_queue, serve_jsonl
+
+__all__ = [
+    "QBAServer",
+    "serve_batch",
+    "EvalRequest",
+    "EvalResult",
+    "BucketScheduler",
+    "bucket_config",
+    "bucket_label",
+    "serve_jsonl",
+    "serve_file_queue",
+    "load_plans",
+    "save_plans",
+    "saved_configs",
+]

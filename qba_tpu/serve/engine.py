@@ -1,0 +1,345 @@
+"""The long-lived evaluation engine: double-buffered chunk dispatch.
+
+Life of a request (docs/SERVING.md has the diagram):
+
+1. **submit** — the request's config is validated, its trial keys are
+   derived exactly as a direct run would
+   (:func:`~qba_tpu.backends.jax_backend.trial_keys` recipe: split of
+   ``jax.random.key(seed)``), and the key material is queued under the
+   request's shape bucket.  A per-request :class:`SpanRecorder` opens
+   the ``request`` root span here — the latency clock starts at
+   arrival, not at dispatch.
+2. **dispatch** — full chunks go to the device via
+   :func:`~qba_tpu.backends.jax_backend.run_trials` on the bucket
+   config.  Dispatch is asynchronous (the span around it measures
+   enqueue only, and is deliberately NOT fenced).
+3. **readback** — with ``depth`` chunks in flight, the host reads back
+   the *trailing* chunk while the device computes the newer ones — the
+   sweep.py overlap pattern promoted to the serving loop.  The readback
+   span is fenced (device-attributable, docs/PERF.md).
+4. **finish** — when a request's last trial lands, its root span
+   closes: that duration IS the reported latency, and the server's
+   p50/p99 summary (:func:`~qba_tpu.obs.telemetry.span_latency_summary`)
+   aggregates exactly those spans.  Each request also gets a full
+   validated run manifest.
+
+Warm start: given a ``cache_dir`` the server points JAX's persistent
+compilation cache at ``<cache_dir>/xla`` and restores the resolver
+plans from ``<cache_dir>/plans.json`` at boot, saving them back on
+every flush — a second boot dispatches known shapes with zero compile
+probes and zero resolve misses (tests/test_serve.py pins this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+import numpy as np
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.obs.manifest import (
+    collect_manifest,
+    probe_stats_snapshot,
+    validate_manifest,
+    write_manifest,
+)
+from qba_tpu.obs.telemetry import Span, SpanRecorder, span_latency_summary
+from qba_tpu.serve import persist
+from qba_tpu.serve.request import EvalRequest, EvalResult
+from qba_tpu.serve.scheduler import BucketScheduler, Chunk, bucket_label
+
+# The per-request root span name; the latency summary keys on it.
+REQUEST_SPAN = "request"
+
+
+@dataclasses.dataclass
+class _Active:
+    """Server-side state of one in-progress request."""
+
+    req: EvalRequest
+    cfg: QBAConfig
+    bucket: QBAConfig
+    recorder: SpanRecorder
+    root_ctx: Any  # open context manager of the root span
+    root_span: Span
+    probe_before: dict[str, int]
+    success: np.ndarray
+    overflow: np.ndarray
+    decisions: np.ndarray | None = None  # allocated at first readback
+    filled: int = 0
+    chunks: int = 0
+
+
+class QBAServer:
+    """Persistent evaluation engine.  Single-threaded by design: one
+    recorder per request keeps span nesting well-formed, and the
+    overlap comes from JAX's async dispatch, not host threads."""
+
+    def __init__(
+        self,
+        *,
+        chunk_trials: int = 64,
+        depth: int = 2,
+        telemetry_dir: str | None = None,
+        cache_dir: str | None = None,
+        warm_start: bool = True,
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.scheduler = BucketScheduler(chunk_trials)
+        self.depth = depth
+        self.telemetry_dir = telemetry_dir
+        self.cache_dir = cache_dir
+        self.recorder = SpanRecorder()  # server-level chunk spans
+        self.restored_plans = 0
+        self._active: dict[str, _Active] = {}
+        self._in_flight: list[tuple[Chunk, Any]] = []
+        self._bucket_decisions: dict[QBAConfig, list[dict]] = {}
+        self._served_buckets: list[QBAConfig] = []
+        self._request_spans: list[Span] = []
+        self._completed = 0
+        if cache_dir is not None:
+            from qba_tpu.compile_cache import enable_compile_cache, xla_cache_dir
+
+            enable_compile_cache(xla_cache_dir(cache_dir))
+            if warm_start:
+                self.restored_plans = persist.load_plans(cache_dir)
+
+    # ---- intake ------------------------------------------------------
+    def submit(self, req: EvalRequest) -> None:
+        """Validate and queue one request (the latency clock starts
+        here).  Raises ``ValueError`` on a bad config or duplicate id —
+        transports turn that into an error result."""
+        if req.request_id in self._active:
+            raise ValueError(f"request id already in flight: {req.request_id!r}")
+        cfg = req.config()
+        import jax
+
+        key_data = np.asarray(
+            jax.random.key_data(jax.random.split(jax.random.key(cfg.seed), cfg.trials)),
+            dtype=np.uint32,
+        )
+        recorder = SpanRecorder()
+        probe_before = probe_stats_snapshot()
+        bucket = self.scheduler.bucket_for(cfg)
+        root_ctx = recorder.span(
+            REQUEST_SPAN,
+            cat="serve",
+            request_id=req.request_id,
+            bucket=bucket_label(bucket),
+            trials=cfg.trials,
+        )
+        root_span = root_ctx.__enter__()
+        self.scheduler.enqueue(req.request_id, cfg, key_data)
+        if bucket not in self._served_buckets:
+            self._served_buckets.append(bucket)
+        self._active[req.request_id] = _Active(
+            req=req,
+            cfg=cfg,
+            bucket=bucket,
+            recorder=recorder,
+            root_ctx=root_ctx,
+            root_span=root_span,
+            probe_before=probe_before,
+            success=np.zeros(cfg.trials, dtype=bool),
+            overflow=np.zeros(cfg.trials, dtype=bool),
+        )
+
+    # ---- dispatch / drain --------------------------------------------
+    def pump(self) -> list[EvalResult]:
+        """Dispatch every *full* chunk, draining as the double buffer
+        fills; returns requests completed along the way.  Partial
+        chunks wait for more same-bucket traffic until :meth:`flush`."""
+        done: list[EvalResult] = []
+        while self.scheduler.has_full_chunk():
+            chunk = self.scheduler.next_chunk()
+            assert chunk is not None
+            done.extend(self._dispatch(chunk))
+        return done
+
+    def flush(self) -> list[EvalResult]:
+        """Dispatch all pending trials (padding partial chunks), drain
+        every in-flight chunk, and persist the resolver plans."""
+        done: list[EvalResult] = []
+        while True:
+            chunk = self.scheduler.next_chunk()
+            if chunk is None:
+                break
+            done.extend(self._dispatch(chunk))
+        while self._in_flight:
+            done.extend(self._drain_one())
+        if self.cache_dir is not None:
+            persist.save_plans(self.cache_dir, self._served_buckets)
+        return done
+
+    def close(self) -> list[EvalResult]:
+        return self.flush()
+
+    @property
+    def busy(self) -> bool:
+        """True while any trial is queued or any chunk is in flight."""
+        return bool(self._in_flight) or self.scheduler.pending_trials() > 0
+
+    def _dispatch(self, chunk: Chunk) -> list[EvalResult]:
+        import jax
+        import jax.numpy as jnp
+
+        from qba_tpu.backends.jax_backend import run_trials
+        from qba_tpu.diagnostics import record_decisions
+
+        keys = jax.random.wrap_key_data(jnp.asarray(chunk.key_data))
+        label = bucket_label(chunk.bucket)
+        span_args = dict(
+            bucket=label, chunk=chunk.index, trials=chunk.used,
+            padded=self.scheduler.chunk_trials - chunk.used,
+        )
+        if chunk.bucket not in self._bucket_decisions:
+            # First dispatch of this bucket: capture the live resolver
+            # decisions so every request served from it can carry them
+            # in its manifest (later dispatches hit the memo silently).
+            with record_decisions() as decisions:
+                with self.recorder.span("serve.dispatch", cat="serve", **span_args):
+                    mc = run_trials(chunk.bucket, keys)
+            self._bucket_decisions[chunk.bucket] = list(decisions)
+        else:
+            with self.recorder.span("serve.dispatch", cat="serve", **span_args):
+                mc = run_trials(chunk.bucket, keys)
+        self._in_flight.append((chunk, mc))
+        done: list[EvalResult] = []
+        # Double buffer: keep up to depth-1 newer chunks computing on
+        # the device while the oldest one is read back on the host.
+        while len(self._in_flight) > self.depth - 1:
+            done.extend(self._drain_one())
+        return done
+
+    def _drain_one(self) -> list[EvalResult]:
+        chunk, mc = self._in_flight.pop(0)
+        label = bucket_label(chunk.bucket)
+        with self.recorder.span(
+            "serve.readback", cat="serve", bucket=label, chunk=chunk.index
+        ) as sp:
+            success = np.asarray(mc.trials.success)
+            decisions = np.asarray(mc.trials.decisions)
+            overflow = np.asarray(mc.trials.overflow)
+            # np.asarray IS a host readback — this span measured device
+            # completion of everything enqueued up to this chunk.
+            sp.fenced = True
+        done: list[EvalResult] = []
+        for seg in chunk.segments:
+            ar = self._active[seg.request_id]
+            with ar.recorder.span(
+                "serve.chunk", cat="serve",
+                chunk=chunk.index, trials=seg.length, bucket=label,
+            ):
+                if ar.decisions is None:
+                    ar.decisions = np.zeros(
+                        (ar.cfg.trials,) + decisions.shape[1:], decisions.dtype
+                    )
+                dst = slice(seg.req_start, seg.req_start + seg.length)
+                src = slice(seg.chunk_start, seg.chunk_start + seg.length)
+                ar.success[dst] = success[src]
+                ar.decisions[dst] = decisions[src]
+                ar.overflow[dst] = overflow[src]
+            ar.filled += seg.length
+            ar.chunks += 1
+            if ar.filled == ar.cfg.trials:
+                done.append(self._finish(ar))
+        return done
+
+    def _finish(self, ar: _Active) -> EvalResult:
+        from qba_tpu.benchmark import engine_description
+
+        del self._active[ar.req.request_id]
+        ar.root_ctx.__exit__(None, None, None)
+        self._request_spans.append(ar.root_span)
+        self._completed += 1
+        latency = float(ar.root_span.dur or 0.0)
+        label = bucket_label(ar.bucket)
+        manifest = validate_manifest(
+            collect_manifest(
+                ar.cfg,
+                command="serve",
+                decisions=self._bucket_decisions.get(ar.bucket, []),
+                probe_stats_before=ar.probe_before,
+                spans=ar.recorder,
+                extra={
+                    "request_id": ar.req.request_id,
+                    "bucket": label,
+                    "latency_s": latency,
+                    "chunks": ar.chunks,
+                    "restored_plans": self.restored_plans,
+                },
+            )
+        )
+        if self.telemetry_dir is not None:
+            self._write_telemetry(ar, manifest)
+        assert ar.decisions is not None
+        return EvalResult(
+            request_id=ar.req.request_id,
+            n_trials=ar.cfg.trials,
+            successes=int(ar.success.sum()),
+            success_rate=float(ar.success.mean()),
+            any_overflow=bool(ar.overflow.any()),
+            latency_s=latency,
+            engine=engine_description(ar.cfg),
+            bucket=label,
+            chunks=ar.chunks,
+            success=[bool(x) for x in ar.success],
+            decisions=(
+                ar.decisions.tolist() if ar.req.return_decisions else None
+            ),
+            manifest=manifest,
+        )
+
+    def _write_telemetry(self, ar: _Active, manifest: dict) -> None:
+        slug = "".join(
+            c if c.isalnum() or c in "-_." else "_" for c in ar.req.request_id
+        ) or "request"
+        directory = os.path.join(self.telemetry_dir or ".", slug)
+        os.makedirs(directory, exist_ok=True)
+        write_manifest(os.path.join(directory, "run_manifest.json"), manifest)
+        ar.recorder.write_jsonl(os.path.join(directory, "spans.jsonl"))
+        ar.recorder.write_chrome_trace(os.path.join(directory, "trace.json"))
+
+    # ---- reporting ---------------------------------------------------
+    def latency_summary(
+        self, percentiles: tuple[float, ...] = (50.0, 99.0)
+    ) -> dict[str, Any]:
+        """p50/p99 (etc.) over completed requests, computed from the
+        closed ``request`` spans themselves."""
+        return span_latency_summary(
+            self._request_spans, REQUEST_SPAN, percentiles
+        )
+
+    def stats(self) -> dict[str, Any]:
+        from qba_tpu.ops.round_kernel_tiled import resolve_cache_info
+
+        return {
+            "completed": self._completed,
+            "in_flight_chunks": len(self._in_flight),
+            "pending_trials": self.scheduler.pending_trials(),
+            "buckets": [bucket_label(b) for b in self._served_buckets],
+            "restored_plans": self.restored_plans,
+            "latency": self.latency_summary(),
+            "resolver": resolve_cache_info(),
+        }
+
+
+def serve_batch(server: QBAServer, requests: list[EvalRequest]) -> list[EvalResult]:
+    """Convenience in-process driver: submit everything, pump as full
+    chunks form, flush at the end.  Bad requests become error results;
+    result order is completion order (error results appear at the point
+    of rejection)."""
+    results: list[EvalResult] = []
+    for req in requests:
+        try:
+            server.submit(req)
+        except (ValueError, TypeError) as e:
+            rid = getattr(req, "request_id", "<unknown>")
+            results.append(EvalResult.failure(str(rid), str(e)))
+            continue
+        results.extend(server.pump())
+    results.extend(server.flush())
+    return results

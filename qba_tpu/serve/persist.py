@@ -1,0 +1,117 @@
+"""Warm-start persistence: the saved-plan artifact.
+
+A serve cache directory (``--cache-dir``) is two artifacts side by side:
+
+* ``xla/`` — JAX's persistent compilation cache
+  (:func:`qba_tpu.compile_cache.enable_compile_cache`), which makes the
+  *executables* survive restarts;
+* ``plans.json`` — every memoized resolver verdict
+  (:func:`qba_tpu.ops.round_kernel_tiled.export_resolver_state`), which
+  makes the *dispatch decisions* survive restarts, so the second boot
+  performs zero compile probes (pinned by tests/test_serve.py via
+  ``PROBE_STATS``).
+
+``plans.json`` additionally records the explicit config kwargs of every
+shape the server has dispatched, so ``qba-tpu lint --saved-plans`` can
+re-trace those exact engine builds through the KI-1/KI-2/KI-3 gates —
+plans loaded from disk get the same machine-checked guarantees as
+freshly probed ones (:func:`qba_tpu.analysis.driver.saved_plan_configs`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+from qba_tpu.compile_cache import plans_path
+from qba_tpu.config import QBAConfig
+
+PLANS_SCHEMA = "qba-tpu/saved-plans/v1"
+
+# Config fields that do not affect kernel plans; normalized out so the
+# saved config list stays one entry per *shape* (matches the resolver
+# keys, which hash on shape/engine knobs only).
+_NON_PLAN_FIELDS = ("seed", "trials", "collect_counters")
+
+
+def plan_config_entry(cfg: QBAConfig) -> dict[str, Any]:
+    """The explicit (non-derived) kwargs that rebuild ``cfg``'s shape,
+    normalized for plan identity."""
+    entry = {
+        f.name: getattr(cfg, f.name) for f in dataclasses.fields(QBAConfig)
+    }
+    for name in _NON_PLAN_FIELDS:
+        entry.pop(name, None)
+    entry["trials"] = 1
+    return entry
+
+
+def save_plans(
+    cache_dir: str | None, configs: list[QBAConfig] | None = None
+) -> str:
+    """Write ``plans.json`` under ``cache_dir`` from the live resolver
+    caches.  Returns the path written."""
+    from qba_tpu.ops.round_kernel_tiled import export_resolver_state
+
+    path = plans_path(cache_dir)
+    seen: list[dict[str, Any]] = []
+    for cfg in configs or []:
+        entry = plan_config_entry(cfg)
+        if entry not in seen:
+            seen.append(entry)
+    payload = {
+        "schema": PLANS_SCHEMA,
+        "resolver_state": export_resolver_state(),
+        "configs": seen,
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_plans(cache_dir: str | None) -> int:
+    """Restore resolver caches from ``cache_dir``'s ``plans.json``.
+    Returns the number of resolver entries restored (0 when the file is
+    absent, unreadable, or from an incompatible build — warm start is
+    best-effort, a cold boot is always correct)."""
+    from qba_tpu.ops.round_kernel_tiled import import_resolver_state
+
+    path = plans_path(cache_dir)
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return 0
+    if not isinstance(payload, dict) or payload.get("schema") != PLANS_SCHEMA:
+        return 0
+    state = payload.get("resolver_state")
+    if not isinstance(state, dict):
+        return 0
+    return import_resolver_state(state)
+
+
+def saved_configs(path: str) -> list[QBAConfig]:
+    """The dispatched-shape configs recorded in a ``plans.json`` —
+    raises ``ValueError`` on a missing/malformed file (lint wants loud
+    failures, unlike :func:`load_plans`)."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except OSError as e:
+        raise ValueError(f"cannot read saved plans {path!r}: {e}") from None
+    except json.JSONDecodeError as e:
+        raise ValueError(f"malformed saved plans {path!r}: {e}") from None
+    if not isinstance(payload, dict) or payload.get("schema") != PLANS_SCHEMA:
+        raise ValueError(
+            f"{path!r} is not a {PLANS_SCHEMA} artifact "
+            f"(schema={payload.get('schema') if isinstance(payload, dict) else None!r})"
+        )
+    configs = []
+    for entry in payload.get("configs", []):
+        configs.append(QBAConfig(**entry))
+    return configs

@@ -1,0 +1,162 @@
+"""Network-free transports for the evaluation service.
+
+Tier-1 runs in hermetic CI containers, so the server speaks two
+filesystem/pipe protocols instead of sockets:
+
+* **jsonl** — one :class:`EvalRequest` JSON object per stdin line, one
+  :class:`EvalResult` JSON object per stdout line (completion order),
+  run summary on stderr at EOF.  Composes with shell pipes:
+  ``cat requests.jsonl | qba-tpu serve --transport jsonl > results.jsonl``.
+* **file-queue** — a queue directory with ``inbox/`` (drop
+  ``*.json`` request files; the server claims them atomically by
+  rename into ``claimed/``), ``outbox/`` (one result file per request,
+  written via temp-file + rename so readers never see partial JSON),
+  and a ``stop`` sentinel file that triggers drain + ``summary.json``
+  + clean exit.  This is the transport the CI smoke step and
+  examples/load_gen.py drive.
+
+Both transports keep the stream flowing on bad input: a malformed or
+invalid request becomes an error :class:`EvalResult`, never a server
+crash.  Batching policy: requests are pumped as they arrive (full
+chunks dispatch immediately); a partial chunk is flushed when the
+input goes quiet (EOF on jsonl, an empty poll on file-queue), so tail
+requests never wait on traffic that isn't coming.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import IO, Any, Iterable
+
+from qba_tpu.serve.engine import QBAServer
+from qba_tpu.serve.request import EvalResult, decode_request_line
+
+
+def _emit_jsonl(out: IO[str], results: Iterable[EvalResult]) -> int:
+    n = 0
+    for res in results:
+        out.write(json.dumps(res.to_json()) + "\n")
+        n += 1
+    if n:
+        out.flush()
+    return n
+
+
+def serve_jsonl(
+    server: QBAServer,
+    in_stream: IO[str],
+    out_stream: IO[str],
+    *,
+    max_requests: int | None = None,
+) -> dict[str, Any]:
+    """Drive ``server`` from a JSONL stream until EOF (or
+    ``max_requests``); returns the final :meth:`QBAServer.stats`."""
+    seen = 0
+    for line in in_stream:
+        line = line.strip()
+        if not line:
+            continue
+        seen += 1
+        try:
+            req = decode_request_line(line)
+            server.submit(req)
+        except ValueError as e:
+            rid = "<undecoded>"
+            try:
+                rid = str(json.loads(line).get("request_id", rid))
+            except (json.JSONDecodeError, AttributeError):
+                pass
+            _emit_jsonl(out_stream, [EvalResult.failure(rid, str(e))])
+        else:
+            _emit_jsonl(out_stream, server.pump())
+        if max_requests is not None and seen >= max_requests:
+            break
+    _emit_jsonl(out_stream, server.flush())
+    return server.stats()
+
+
+def queue_paths(queue_dir: str) -> dict[str, str]:
+    return {
+        "inbox": os.path.join(queue_dir, "inbox"),
+        "claimed": os.path.join(queue_dir, "claimed"),
+        "outbox": os.path.join(queue_dir, "outbox"),
+        "stop": os.path.join(queue_dir, "stop"),
+        "summary": os.path.join(queue_dir, "summary.json"),
+    }
+
+
+def _write_json(path: str, payload: dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, default=str)
+    os.replace(tmp, path)
+
+
+def _result_path(outbox: str, request_id: str) -> str:
+    slug = "".join(
+        c if c.isalnum() or c in "-_." else "_" for c in request_id
+    ) or "request"
+    return os.path.join(outbox, slug + ".json")
+
+
+def serve_file_queue(
+    server: QBAServer,
+    queue_dir: str,
+    *,
+    poll_s: float = 0.05,
+    max_requests: int | None = None,
+) -> dict[str, Any]:
+    """Drive ``server`` from ``queue_dir`` until the ``stop`` sentinel
+    appears (or ``max_requests`` requests have been consumed); returns
+    the final stats (also written to ``summary.json``)."""
+    paths = queue_paths(queue_dir)
+    for key in ("inbox", "claimed", "outbox"):
+        os.makedirs(paths[key], exist_ok=True)
+
+    def emit(results: Iterable[EvalResult]) -> None:
+        for res in results:
+            _write_json(_result_path(paths["outbox"], res.request_id), res.to_json())
+
+    seen = 0
+    try:
+        while True:
+            names = sorted(
+                n for n in os.listdir(paths["inbox"]) if n.endswith(".json")
+            )
+            for name in names:
+                claimed = os.path.join(paths["claimed"], name)
+                try:
+                    os.replace(os.path.join(paths["inbox"], name), claimed)
+                except OSError:
+                    continue  # another consumer claimed it
+                seen += 1
+                try:
+                    with open(claimed) as f:
+                        req = decode_request_line(f.read())
+                    server.submit(req)
+                except ValueError as e:
+                    emit([EvalResult.failure(os.path.splitext(name)[0], str(e))])
+                else:
+                    emit(server.pump())
+                if max_requests is not None and seen >= max_requests:
+                    emit(server.flush())
+                    return _finish(server, paths)
+            if os.path.exists(paths["stop"]):
+                emit(server.flush())
+                return _finish(server, paths)
+            if not names:
+                # Quiet inbox: flush stragglers in partial chunks so a
+                # lone request is never stuck behind an unfilled chunk.
+                if server.busy:
+                    emit(server.flush())
+                time.sleep(poll_s)
+    finally:
+        emit(server.flush())
+
+
+def _finish(server: QBAServer, paths: dict[str, str]) -> dict[str, Any]:
+    stats = server.stats()
+    _write_json(paths["summary"], stats)
+    return stats

@@ -176,12 +176,13 @@ def run_sweep(
     # executable turns a tens-of-seconds recompile into a file read.
     # Strictly env-gated here — run_sweep is a library entry point, and
     # library code must not silently flip global JAX config (the CLI
-    # tool surfaces enable it unconditionally; see
-    # :mod:`qba_tpu.compile_cache`).
+    # tool surfaces enable it unconditionally, and the serving
+    # subsystem promotes the whole thing to a first-class cache-dir
+    # artifact; see :mod:`qba_tpu.compile_cache` and docs/SERVING.md).
     if os.environ.get("QBA_COMPILE_CACHE"):
-        from qba_tpu.compile_cache import enable_compile_cache
+        from qba_tpu.compile_cache import enable_compile_cache, xla_cache_dir
 
-        enable_compile_cache()
+        enable_compile_cache(xla_cache_dir())
 
     loaded = load_checkpoint(checkpoint, cfg, chunk_trials) if checkpoint else []
     # A checkpoint may hold more chunks than this invocation asks for;
