@@ -15,6 +15,10 @@ to cover the planner's phase space:
   range, so the one-hot structure proofs carry real weight;
 * ``f32-gdt``     — (11, 1000, 3): the reference paper's 11-party
   scale; size_l pushes the verdict kernel into its f32 gather dtype.
+* ``stabilizer``  — (11, 16, 3) on ``qsim_path="stabilizer"``: the
+  batched GF(2) resource path; its parity dots (``qba_tpu/gf2``) must
+  prove KI-3-clean with zero allowlist markers, and the packed-tableau
+  KI-2 entry fires.
 
 One aggregated :class:`~qba_tpu.analysis.findings.Report` comes back:
 empty findings means the tree upholds KI-1/KI-2/KI-3 by construction.
@@ -35,9 +39,14 @@ LINT_MATRIX = (
     ("cheap", dict(n_parties=17, size_l=16, n_dishonest=4)),
     ("north-star", dict(n_parties=33, size_l=64, n_dishonest=10)),
     ("f32-gdt", dict(n_parties=11, size_l=1000, n_dishonest=3)),
+    ("stabilizer", dict(
+        n_parties=11, size_l=16, n_dishonest=3, qsim_path="stabilizer",
+    )),
 )
 
-ENGINE_CHOICES = ("xla", "pallas", "pallas_tiled", "pallas_fused", "spmd")
+ENGINE_CHOICES = (
+    "xla", "pallas", "pallas_tiled", "pallas_fused", "spmd", "gf2",
+)
 
 
 def lint_configs() -> list[tuple[str, QBAConfig]]:
@@ -69,7 +78,7 @@ def _lint_config(
 ) -> Report:
     from qba_tpu.analysis.dots import check_dots
     from qba_tpu.analysis.intervals import IntervalInterpreter
-    from qba_tpu.analysis.memory import check_memory
+    from qba_tpu.analysis.memory import check_gf2_memory, check_memory
     from qba_tpu.analysis.traces import trace_paths
     from qba_tpu.analysis.vma import check_vma
 
@@ -95,6 +104,8 @@ def _lint_config(
         report.extend(check_vma(cfg, sitewide=sitewide))
     if engine_set & {"pallas_tiled", "pallas_fused"}:
         report.extend(check_memory(cfg))
+    if "gf2" in engine_set:
+        report.extend(check_gf2_memory(cfg))
     return report
 
 

@@ -175,6 +175,65 @@ def _audit_plans(cfg: QBAConfig, n_recv: int | None, report: Report) -> None:
     )
 
 
+def gf2_tableau_bytes(cfg: QBAConfig) -> dict:
+    """Packed-tableau working set of the batched GF(2) sampler, per
+    shot (one list position): x + z packed word planes ``[2n, W]``
+    uint32, the phase vector, the coin vector, and the output bits.
+    The 32x packing is the KI-2 story for this engine — at 129 parties
+    (n = 1040 qubits) the packed planes are ~541 KiB/shot where int32
+    flag planes would be ~16.5 MiB."""
+    from qba_tpu.gf2 import n_words
+
+    n = cfg.total_qubits
+    w = n_words(n)
+    planes = 2 * (2 * n) * w * 4       # x + z, uint32 words
+    vectors = (2 * n + 2 * n) * 4      # phase r + the two where-branches
+    per_shot = planes + vectors + 2 * n * 4
+    return {
+        "n_qubits": n,
+        "words_per_row": w,
+        "per_shot_bytes": per_shot,
+        "per_position_unpacked_bytes": 2 * (2 * n) * n * 4,
+    }
+
+
+def gf2_shot_ceiling(cfg: QBAConfig, hbm_bytes: int = HBM_BYTES) -> int:
+    """Predicted max concurrent shots (trials x size_l list positions)
+    of the batched GF(2) sampler before the packed tableau batch
+    exhausts HBM — same planning model as :func:`trial_ceiling`."""
+    per_shot = gf2_tableau_bytes(cfg)["per_shot_bytes"]
+    return int((hbm_bytes - HBM_RESERVE) // (POOL_OCCUPANCY * per_shot))
+
+
+def check_gf2_memory(cfg: QBAConfig) -> Report:
+    """KI-2 entry for the packed-tableau shapes of the gf2 engine."""
+    report = Report()
+    tb = gf2_tableau_bytes(cfg)
+    shots = gf2_shot_ceiling(cfg)
+    trials = shots // max(cfg.size_l, 1)
+    report.notes.append(
+        f"gf2-tableau: {tb['n_qubits']} qubits packed to "
+        f"{tb['words_per_row']} words/row, "
+        f"{tb['per_shot_bytes']} B/shot "
+        f"({tb['per_position_unpacked_bytes']} B unpacked) -> "
+        f"~{shots} concurrent shots, ~{trials} trials at "
+        f"size_l={cfg.size_l} on v5e"
+    )
+    if trials < 1:
+        report.findings.append(Finding(
+            ki="KI-2", check="gf2-tableau", path="gf2/sampler",
+            message=(
+                f"packed tableau batch for one trial "
+                f"({cfg.size_l} positions x {tb['per_shot_bytes']} "
+                f"B/shot) cannot fit under the v5e model "
+                f"({HBM_BYTES} B HBM, {HBM_RESERVE} B reserve, "
+                f"occupancy {POOL_OCCUPANCY}) — shard list positions "
+                "before dispatching this shape"
+            ),
+        ))
+    return report
+
+
 def check_memory(cfg: QBAConfig) -> Report:
     """Run the KI-2 audit for one config (global + 2-way sharded)."""
     from qba_tpu.ops.round_kernel_tiled import (

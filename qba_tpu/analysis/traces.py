@@ -341,12 +341,56 @@ def trace_fused(cfg: QBAConfig, n_recv: int | None = None, out_vma=None):
     return [_trace(f"{prefix}pallas_fused/round", fused, args, seeds)], []
 
 
+def trace_gf2(cfg: QBAConfig) -> list[TracedPath]:
+    """The batched GF(2) symplectic sampler paths — resource generation
+    on ``qsim_path="stabilizer"`` (:mod:`qba_tpu.gf2.symplectic`).
+
+    The traced callables are the pure sampler cores: they take the
+    pre-drawn measurement coins (``rnds``) and the permutation-bit
+    params as *inputs* (the PRNG draw lives outside the core), so both
+    seed as 0/1 and every parity dot's operands are interval-proven
+    bf16-exact from the seeds alone — the KI-3 acceptance for this
+    subsystem is zero ``exact-ok`` allowlist markers.  The third path
+    pins the standalone K-tiled parity matmul at a contraction length
+    (``2 * total_qubits``) that forces multi-tile accumulation at
+    reference scale.
+    """
+    from qba_tpu.gf2 import build_gf2_sample_core, gf2_matmul
+    from qba_tpu.qsim.protocol_circuits import (
+        gen_nq_corr_circuit,
+        gen_q_corr_circuit,
+    )
+
+    n, nq = cfg.n_parties, cfg.n_qubits
+    total = (n + 1) * nq
+    b = cfg.size_l
+    circ_q = gen_q_corr_circuit(n, nq)
+    circ_nq = gen_nq_corr_circuit(n, nq)
+    core_q = build_gf2_sample_core(total, tuple(circ_q.ops), circ_q.n_params)
+    core_nq = build_gf2_sample_core(total, tuple(circ_nq.ops), 0)
+    rnds = jnp.zeros((b, total), jnp.int32)
+    params = jnp.zeros((b, max(circ_q.n_params, 1)), jnp.int32)
+    return [
+        _trace("gf2/sampler/qcorr", core_q, (rnds, params), (BOOL, BOOL)),
+        _trace("gf2/sampler/nqcorr", lambda r: core_nq(r), (rnds,), (BOOL,)),
+        _trace(
+            "gf2/matmul",
+            gf2_matmul,
+            (
+                jnp.zeros((b, 2 * total), jnp.int32),
+                jnp.zeros((2 * total, total), jnp.int32),
+            ),
+            (BOOL, BOOL),
+        ),
+    ]
+
+
 def trace_paths(cfg: QBAConfig, engines=None):
     """Trace every requested build path.  ``engines`` is an iterable of
-    {"xla", "pallas", "pallas_tiled", "pallas_fused", "spmd"}; None
-    traces everything.  Returns ``(paths, notes)``."""
+    {"xla", "pallas", "pallas_tiled", "pallas_fused", "spmd", "gf2"};
+    None traces everything.  Returns ``(paths, notes)``."""
     engines = set(engines) if engines is not None else {
-        "xla", "pallas", "pallas_tiled", "pallas_fused", "spmd",
+        "xla", "pallas", "pallas_tiled", "pallas_fused", "spmd", "gf2",
     }
     paths: list[TracedPath] = []
     notes: list[str] = []
@@ -362,6 +406,8 @@ def trace_paths(cfg: QBAConfig, engines=None):
         p, n = trace_fused(cfg)
         paths += p
         notes += n
+    if "gf2" in engines:
+        paths += trace_gf2(cfg)
     if "spmd" in engines:
         n_lieu = cfg.n_lieutenants
         if n_lieu % 2 == 0:

@@ -112,6 +112,72 @@ def engine_description(cfg: QBAConfig) -> str:
     return engine
 
 
+def qsim_description(cfg: QBAConfig) -> str:
+    """Resource-generation attribution string, the qsim counterpart of
+    :func:`engine_description`: which sampler family a ``resource_gen``
+    measurement actually ran (e.g. ``"stabilizer/gf2-batched"``,
+    ``"factorized/closed-form"``)."""
+    if cfg.qsim_path == "stabilizer":
+        return "stabilizer/gf2-batched"
+    if cfg.qsim_path == "factorized":
+        return "factorized/closed-form"
+    if cfg.qsim_path == "dense_pallas":
+        if cfg.total_qubits > _dense_cap():
+            # generate_lists_dense(impl="auto") hands off past the cap.
+            return "stabilizer/gf2-batched(auto)"
+        return "dense/pallas"
+    return "dense/xla"
+
+
+def _dense_cap() -> int:
+    from qba_tpu.config import DENSE_QUBIT_CAP
+
+    return DENSE_QUBIT_CAP
+
+
+def measure_resource_gen(
+    cfg: QBAConfig,
+    reps: int,
+    *,
+    warmup: bool = True,
+):
+    """Time ``reps`` full resource-generation batches: ``cfg.trials``
+    independent list generations of ``cfg.size_l`` positions each,
+    through the same :func:`~qba_tpu.qsim.generate_lists_for` dispatch
+    the protocol engine calls (so the measurement attributes to the
+    sampler the trial loop would actually run).
+
+    Same recipe discipline as :func:`measure_batch`: fresh keys per rep
+    (a result-caching backend cannot serve a 0-second rep), key
+    generation fenced off the clock, one fence after the batch.
+
+    Returns ``(rep_seconds, shots_per_rep)`` where a *shot* is one list
+    position (``trials x size_l``) — the unit of the ``shots_per_sec``
+    headline.
+    """
+    import jax
+
+    from qba_tpu.backends.jax_backend import fence
+    from qba_tpu.qsim import generate_lists_for
+
+    if reps < 1:
+        raise ValueError("reps must be >= 1")
+    gen = jax.jit(jax.vmap(lambda k: generate_lists_for(cfg, k)))
+    if warmup:
+        fence(gen(jax.random.split(jax.random.key(cfg.seed), cfg.trials)))
+    times = []
+    for rep in range(reps):
+        keys = jax.random.split(
+            jax.random.key(cfg.seed + 1 + rep), cfg.trials
+        )
+        fence(keys)  # key generation off the clock
+        t0 = time.perf_counter()
+        out = gen(keys)
+        fence(out)
+        times.append(time.perf_counter() - t0)
+    return times, cfg.trials * cfg.size_l
+
+
 def measure_batch(
     cfg: QBAConfig,
     reps: int,

@@ -180,6 +180,14 @@ def _parser() -> argparse.ArgumentParser:
     bench = sub.add_parser("bench", help="time the jitted Monte-Carlo batch")
     _add_config_args(bench, trials_default=256)
     bench.add_argument("--reps", type=int, default=3)
+    bench.add_argument(
+        "--scenario", choices=("rounds", "resource_gen"), default="rounds",
+        help="rounds = full protocol Monte-Carlo (rounds/s headline); "
+        "resource_gen = list generation only through the qsim dispatch "
+        "(shots/s over trials x size_l, with sampler attribution — "
+        "combine with --qsim-path stabilizer for the batched GF(2) "
+        "engine)",
+    )
     bench.add_argument("--profile-dir", default=None)
     bench.add_argument(
         "--preset", choices=("northstar",), default=None,
@@ -224,7 +232,7 @@ def _parser() -> argparse.ArgumentParser:
     lint.add_argument(
         "--engines", default=None, metavar="E1,E2,...",
         help="restrict to these build paths "
-        "(xla,pallas,pallas_tiled,pallas_fused,spmd; default: all)",
+        "(xla,pallas,pallas_tiled,pallas_fused,spmd,gf2; default: all)",
     )
     lint.add_argument(
         "--config", action="append", default=None, metavar="P,L,D",
@@ -486,6 +494,8 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
         cfg = dataclasses.replace(cfg, **NORTHSTAR)
         chunk_trials = chunk_trials or NORTHSTAR_CHUNK
     with _telemetry(args, cfg, "bench") as session:
+        if args.scenario == "resource_gen":
+            return _bench_resource_gen(args, cfg, session, out)
         return _bench_impl(args, cfg, chunk_trials, session, out)
 
 
@@ -577,6 +587,60 @@ def _bench_impl(
                 # The full dispatch-decision record (engine, demotion
                 # chain, block plan, probe-stats delta) next to the
                 # metric — docs/OBSERVABILITY.md.
+                "manifest": manifest,
+            },
+            default=str,
+        ),
+        file=out,
+    )
+    return 0
+
+
+def _bench_resource_gen(
+    args: argparse.Namespace, cfg: QBAConfig, session, out,
+) -> int:
+    import json
+    import statistics
+
+    from qba_tpu.benchmark import measure_resource_gen, qsim_description
+    from qba_tpu.diagnostics import record_decisions
+    from qba_tpu.obs import PhaseTimers
+    from qba_tpu.obs.manifest import collect_manifest, probe_stats_snapshot
+
+    timers = PhaseTimers(spans=session.spans if session else None)
+    stats_before = probe_stats_snapshot()
+    with record_decisions() as decisions:
+        with timers.time("measure", reps=args.reps) as sp:
+            rep_seconds, shots = measure_resource_gen(cfg, args.reps)
+            sp.fenced = True  # measure_resource_gen fences every rep
+    best = min(rep_seconds)
+    manifest = collect_manifest(
+        cfg,
+        command="bench",
+        decisions=decisions,
+        probe_stats_before=stats_before,
+        spans=timers.spans,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "resource_shots_per_sec",
+                "value": round(shots / best, 2),
+                "unit": "shots/s",
+                "shots_per_rep": shots,
+                "best_s": round(best, 4),
+                "median_s": round(statistics.median(rep_seconds), 4),
+                "rep_seconds": [round(t, 4) for t in rep_seconds],
+                "qsim": qsim_description(cfg),
+                "config": {
+                    "n_parties": cfg.n_parties,
+                    "size_l": cfg.size_l,
+                    "n_dishonest": cfg.n_dishonest,
+                    "trials": cfg.trials,
+                    "total_qubits": cfg.total_qubits,
+                    "w": cfg.w,
+                    "qsim_path": cfg.qsim_path,
+                },
                 "manifest": manifest,
             },
             default=str,

@@ -1,0 +1,59 @@
+"""qba_tpu.gf2 — batched bit-packed GF(2) linear algebra + the
+aggregate-symplectic stabilizer sampler.
+
+This package is the scale engine for resource generation (ROADMAP
+item 5): parity matmuls as KI-3-provable integer dots
+(:mod:`~qba_tpu.gf2.linalg`), packed-word bit kernels
+(:mod:`~qba_tpu.gf2.bitops`), and the compiled batched tableau sampler
+(:mod:`~qba_tpu.gf2.symplectic`) that replaces per-op, per-shot column
+edits with a handful of batched GF(2) matmuls plus a masked
+measurement sweep over the whole ``(trials x size_l)`` shot batch.
+"""
+
+from qba_tpu.gf2.bitops import (
+    WORD,
+    get_bit,
+    mask_words,
+    n_words,
+    pack_bits,
+    parity_words,
+    prefix_xor_exclusive,
+    unit_words,
+    unpack_bits,
+)
+from qba_tpu.gf2.linalg import (
+    GF2_TILE_K,
+    gf2_matmul,
+    gf2_matvec,
+    rank1_update_packed,
+    triangular_parity,
+)
+from qba_tpu.gf2.symplectic import (
+    SymplecticProgram,
+    build_gf2_sample_core,
+    build_gf2_tableau_run_batch,
+    build_gf2_tableau_run_shots,
+    compile_symplectic,
+)
+
+__all__ = [
+    "WORD",
+    "GF2_TILE_K",
+    "SymplecticProgram",
+    "build_gf2_sample_core",
+    "build_gf2_tableau_run_batch",
+    "build_gf2_tableau_run_shots",
+    "compile_symplectic",
+    "get_bit",
+    "gf2_matmul",
+    "gf2_matvec",
+    "mask_words",
+    "n_words",
+    "pack_bits",
+    "parity_words",
+    "prefix_xor_exclusive",
+    "rank1_update_packed",
+    "triangular_parity",
+    "unit_words",
+    "unpack_bits",
+]

@@ -1,0 +1,117 @@
+"""GF(2) linear algebra as KI-3-provable integer matmuls.
+
+A parity matmul ``c = a @ b (mod 2)`` over 0/1 matrices is an ordinary
+integer matmul followed by a mod-2 reduce — exactly the kernel class
+the KI-3 lint (:mod:`qba_tpu.analysis.dots`) proves exact: the MXU
+feeds default-precision ``dot_general`` through bf16 passes, and bf16
+represents integers exactly up to 256.  Two facts keep every dot here
+inside that envelope *by construction*:
+
+* the operands are 0/1 (magnitude bound 1 — trivially bf16-exact), and
+* the contraction is **K-tiled at** :data:`GF2_TILE_K` ``= 256``, so
+  each tile's accumulated sum is at most 256 — bf16-exact even if a
+  backend accumulated partials at operand precision — and each tile is
+  reduced mod 2 before tiles are XOR-combined (the cross-tile combine
+  is integer XOR on {0,1}, never a wide float sum).
+
+No ``Precision.HIGHEST`` escape hatch and no ``qba-lint: exact-ok``
+allowlist marker appears in this module: ``qba-tpu lint --engines gf2``
+must prove every dot clean from the interval seeds alone (pinned by
+tests/test_analysis.py).
+
+The batched rank-1 update and the triangular-parity reduction operate
+on the *packed* representation (:mod:`qba_tpu.gf2.bitops`) — they are
+memory-bound XOR/popcount sweeps where a dense dot would inflate the
+working set 32x (the measurement sweep calls them once per qubit).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from qba_tpu.gf2.bitops import (
+    mask_words,
+    parity_words,
+    prefix_xor_exclusive,
+)
+
+#: Max contraction length per dot tile: per-tile accumulations of 0/1
+#: products stay <= 256, bf16's exact-integer ceiling (KI-3).
+GF2_TILE_K = 256
+
+
+def gf2_matmul(a: jnp.ndarray, b: jnp.ndarray, *, tile_k: int = GF2_TILE_K):
+    """Parity matmul ``c[..., i, j] = XOR_k a[..., i, k] & b[..., k, j]``.
+
+    ``a``/``b`` are 0/1 integer (or bool) arrays; leading batch axes
+    broadcast as in ``jnp.matmul``.  Returns int32 in {0, 1}.
+
+    Each K-tile is one default-precision f32 ``dot_general`` (MXU-
+    shaped) whose accumulation is bounded by ``tile_k``; tiles reduce
+    mod 2 independently and XOR-combine, so no intermediate ever
+    leaves the bf16-exact integer range.
+    """
+    if a.shape[-1] != b.shape[-2]:
+        raise ValueError(
+            f"gf2_matmul: contraction mismatch {a.shape} @ {b.shape}"
+        )
+    if tile_k < 1 or tile_k > GF2_TILE_K:
+        raise ValueError(
+            f"tile_k={tile_k} must be in [1, {GF2_TILE_K}]: larger tiles "
+            "let a per-tile accumulation exceed bf16's exact range"
+        )
+    k = a.shape[-1]
+    af = (a.astype(jnp.int32) & 1).astype(jnp.float32)
+    bf = (b.astype(jnp.int32) & 1).astype(jnp.float32)
+    acc = None
+    for k0 in range(0, k, tile_k):
+        k1 = min(k0 + tile_k, k)
+        part = jnp.matmul(
+            af[..., :, k0:k1], bf[..., k0:k1, :],
+            preferred_element_type=jnp.float32,
+        )
+        tile = part.astype(jnp.int32) & 1
+        acc = tile if acc is None else acc ^ tile
+    if acc is None:  # k == 0: empty contraction is the zero matrix
+        shape = (*jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2]),
+                 a.shape[-2], b.shape[-1])
+        return jnp.zeros(shape, jnp.int32)
+    return acc
+
+
+def gf2_matvec(m: jnp.ndarray, v: jnp.ndarray, *, tile_k: int = GF2_TILE_K):
+    """Parity mat-vec ``[..., m, k] @ [..., k] -> [..., m]``."""
+    return gf2_matmul(m, v[..., None], tile_k=tile_k)[..., 0]
+
+
+def rank1_update_packed(
+    m_words: jnp.ndarray, mask: jnp.ndarray, row_words: jnp.ndarray,
+) -> jnp.ndarray:
+    """Masked GF(2) rank-1 update on packed rows:
+    ``m ^= outer(mask, row)``.
+
+    ``m_words``: ``[..., R, W]`` uint32, ``mask``: ``[..., R]`` 0/1,
+    ``row_words``: ``[..., W]`` uint32.  This is the tableau-collapse
+    primitive: every row flagged by ``mask`` absorbs ``row`` in one
+    vectorized XOR — the batched replacement for the per-shot
+    ``lax.cond`` random-measurement branch.
+    """
+    mw = mask_words(mask)[..., None]
+    return m_words ^ (mw & row_words[..., None, :])
+
+
+def triangular_parity(
+    z_words: jnp.ndarray, x_words: jnp.ndarray,
+) -> jnp.ndarray:
+    """Parity of the strict-upper-triangle cross sum
+    ``sum_{a<b} z_a . x_b`` over rows (axis -2) of packed operands.
+
+    Both inputs are ``[..., R, W]`` with non-selected rows already
+    zero-masked.  Because parity distributes over addition, the
+    ``[R, R]`` cross matrix of the unpacked formulation collapses to an
+    exclusive prefix-XOR over rows followed by one AND + popcount
+    parity — O(R * W) instead of O(R^2) — which is what makes the
+    deterministic measurement branch batchable at n = 1040+ qubits.
+    """
+    prefix = prefix_xor_exclusive(z_words, axis=-2)
+    return parity_words(prefix & x_words, axis=(-2, -1))

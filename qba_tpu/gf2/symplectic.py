@@ -1,0 +1,246 @@
+"""Aggregate symplectic compilation + the batched stabilizer sampler.
+
+The per-shot tableau engine (:mod:`qba_tpu.qsim.stabilizer`) walks the
+op list column by column for every shot.  But conjugation by a Clifford
+circuit is *linear* on Pauli (x|z) vectors over GF(2): the whole static
+op list collapses, once at build time, into
+
+* a ``2n x 2n`` symplectic matrix ``M`` (row ``i`` of the evolved
+  tableau = row ``i`` of ``M``, because the initial tableau IS the
+  identity),
+* a phase vector ``r0[2n]`` (the quadratic phase form evaluated on the
+  identity rows), and
+* a param-linear phase matrix ``L[2n, P]`` — each ``X**b`` op
+  contributes ``r ^= b & z_a(current)``, and the *current* ``z_a`` is a
+  known linear functional of the initial row at compile time.
+
+Circuit application for a whole ``(trials x size_l)`` shot batch is
+then a handful of batched GF(2) matmuls: the per-position phases are
+``r = r0 ^ (params @ L^T mod 2)`` — one K-tiled MXU dot over the entire
+batch (:func:`qba_tpu.gf2.linalg.gf2_matmul`) — and the packed rows of
+``M`` are broadcast as the shared initial state.  Per-op ``.at[:, a]``
+column edits never execute at runtime.
+
+The measurement sweep stays a per-qubit ``fori_loop`` (measurement
+collapse is inherently sequential in the qubit index) but runs the
+whole shot batch per step with *masked* GF(2) updates — the per-shot
+``lax.cond`` divergence of the reference engine becomes one
+``has_stab`` select per step:
+
+* random branch: pivot by batched argmax, cross parity by packed
+  popcount, collapse by one batched rank-1 XOR update
+  (:func:`~qba_tpu.gf2.linalg.rank1_update_packed`);
+* deterministic branch: sign by the triangular-parity reduction
+  (:func:`~qba_tpu.gf2.linalg.triangular_parity`) — O(n * W) per shot
+  instead of the per-shot engine's ``[n, n]`` cross matmul.
+
+Bit-identity with the per-shot engine under identical keys is a hard
+contract (tests/test_gf2.py): the key tree (``split(key, shots)``), the
+coin draws (``random.bits(key, (n,), uint32) & 1``), the pivot choice
+(first anticommuting stabilizer), and the mod-2 algebra all match the
+reference engine exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from qba_tpu.gf2.bitops import (
+    get_bit,
+    mask_words,
+    pack_bits,
+    parity_words,
+    unit_words,
+)
+from qba_tpu.gf2.linalg import gf2_matmul, rank1_update_packed, triangular_parity
+
+
+@dataclasses.dataclass(frozen=True)
+class SymplecticProgram:
+    """One static Clifford op list, compiled (host-side, exact GF(2)
+    arithmetic in numpy) to its aggregate action on the standard
+    initial tableau."""
+
+    n: int
+    x: np.ndarray   # [2n, n] 0/1 — evolved X bits (rows of M, X half)
+    z: np.ndarray   # [2n, n] 0/1 — evolved Z bits (rows of M, Z half)
+    r: np.ndarray   # [2n] 0/1  — phases at params = 0
+    l: np.ndarray   # [2n, P]  — phase coefficient of each runtime param
+
+
+def compile_symplectic(n: int, ops, n_params: int) -> SymplecticProgram:
+    """Fold the static op list into one symplectic transform + phase
+    data by pushing the identity tableau through the gate rules of
+    :mod:`qba_tpu.qsim.stabilizer` (same XZ-normal-form derivations) —
+    with the XPOW phase contribution kept *symbolic* in the params:
+    at the moment ``X**b(a)`` executes, ``r ^= b & z_a`` reads the
+    current ``z`` column, which is a compile-time-known GF(2) vector,
+    so the whole contribution is the linear form ``L @ params``."""
+    from qba_tpu.qsim.stabilizer import _validate_ops
+
+    ops = tuple(ops)
+    _validate_ops(ops)
+    x = np.concatenate(
+        [np.eye(n, dtype=np.int32), np.zeros((n, n), np.int32)], axis=0
+    )
+    z = np.concatenate(
+        [np.zeros((n, n), np.int32), np.eye(n, dtype=np.int32)], axis=0
+    )
+    r = np.zeros((2 * n,), np.int32)
+    l = np.zeros((2 * n, max(n_params, 1)), np.int32)
+    for op in ops:
+        a = op.target
+        if op.kind == "XPOW":
+            l[:, op.param] ^= z[:, a]
+        elif op.controls:
+            (c,) = op.controls
+            if op.kind == "X":  # CNOT c -> a
+                x[:, a] ^= x[:, c]
+                z[:, c] ^= z[:, a]
+            else:  # CZ
+                r ^= x[:, c] & x[:, a]
+                zc = z[:, c] ^ x[:, a]
+                z[:, a] ^= x[:, c]
+                z[:, c] = zc
+        elif op.kind == "H":
+            r ^= x[:, a] & z[:, a]
+            x[:, a], z[:, a] = z[:, a].copy(), x[:, a].copy()
+        elif op.kind == "X":
+            r ^= z[:, a]
+        elif op.kind == "Y":
+            r ^= x[:, a] ^ z[:, a]
+        else:  # "Z"
+            r ^= x[:, a]
+    return SymplecticProgram(n=n, x=x, z=z, r=r, l=l)
+
+
+def build_gf2_sample_core(n: int, ops, n_params: int):
+    """Build the pure batched sampler core:
+    ``sample(rnds[B, n], params[B, P] | None) -> int32 bits[B, n]``.
+
+    ``rnds`` are the pre-drawn measurement coins (one per qubit per
+    shot, only consumed where the outcome is random — the same contract
+    as the per-shot engine).  No PRNG inside: this is the callable the
+    ``qba-tpu lint`` gf2 path traces (:mod:`qba_tpu.analysis.traces`),
+    so every GF(2) dot it contains is interval-checked from BOOL seeds.
+    """
+    prog = compile_symplectic(n, ops, n_params)
+    x0w = jnp.asarray(pack_bits(jnp.asarray(prog.x)))   # [2n, W]
+    z0w = jnp.asarray(pack_bits(jnp.asarray(prog.z)))
+    r0 = jnp.asarray(prog.r, jnp.int32)                 # [2n]
+    lt = jnp.asarray(prog.l.T, jnp.int32)               # [P, 2n]
+    rows2n = jnp.arange(2 * n, dtype=jnp.int32)
+
+    def sample(rnds: jnp.ndarray, params: jnp.ndarray | None = None):
+        b = rnds.shape[0]
+        rnds = rnds.astype(jnp.int32) & 1
+        if params is not None and n_params > 0:
+            # Circuit application, whole batch at once: phases are
+            # r0 ^ (params @ L^T) — the batched K-tiled GF(2) matmul.
+            phase = gf2_matmul(params.astype(jnp.int32) & 1, lt)  # [B, 2n]
+            r = r0[None, :] ^ phase
+        else:
+            r = jnp.broadcast_to(r0[None, :], (b, 2 * n))
+        xw = jnp.broadcast_to(x0w[None], (b, 2 * n, x0w.shape[-1]))
+        zw = jnp.broadcast_to(z0w[None], (b, 2 * n, z0w.shape[-1]))
+
+        def measure_one(a, carry):
+            xw, zw, r, out = carry
+            xa = get_bit(xw, a)                      # [B, 2n]
+            stab_xa = xa[:, n:]
+            has_stab = jnp.any(stab_xa == 1, axis=1)  # [B]
+            # -- random branch (masked; discarded where deterministic) --
+            p = n + jnp.argmax(stab_xa, axis=1)       # first pivot [B]
+            xp = jnp.take_along_axis(xw, p[:, None, None], axis=1)[:, 0]
+            zp = jnp.take_along_axis(zw, p[:, None, None], axis=1)[:, 0]
+            rp = jnp.take_along_axis(r, p[:, None], axis=1)[:, 0]
+            # Cross parity z_h . x_p per row — packed popcount, no dot.
+            cross = parity_words(zw & xp[:, None, :], axis=-1)  # [B, 2n]
+            mask_o = xa * (rows2n[None, :] != p[:, None])       # [B, 2n]
+            r_rand = r ^ (mask_o & (rp[:, None] ^ cross))
+            x_rand = rank1_update_packed(xw, mask_o, xp)
+            z_rand = rank1_update_packed(zw, mask_o, zp)
+            # Row surgery: pivot retires to the destabilizer bank; the
+            # new stabilizer is (+/-) Z_a signed by the coin.
+            rnd = jnp.take(rnds, a, axis=1)                     # [B]
+            e_a = unit_words(n, a)                              # [W]
+            is_dst = rows2n[None, :] == (p - n)[:, None]        # [B, 2n]
+            is_p = rows2n[None, :] == p[:, None]
+            x_rand = jnp.where(is_dst[..., None], xp[:, None, :], x_rand)
+            x_rand = jnp.where(
+                is_p[..., None], jnp.asarray(0, jnp.uint32), x_rand
+            )
+            z_rand = jnp.where(is_dst[..., None], zp[:, None, :], z_rand)
+            z_rand = jnp.where(is_p[..., None], e_a[None, None, :], z_rand)
+            r_rand = jnp.where(is_dst, rp[:, None], r_rand)
+            r_rand = jnp.where(is_p, rnd[:, None], r_rand)
+            # -- deterministic branch (reads state, never writes) --
+            s = xa[:, :n]                                       # [B, n]
+            phase_par = jnp.sum(s * r[:, n:], axis=1) & 1
+            sm = mask_words(s)[..., None]                       # [B, n, 1]
+            tri = triangular_parity(sm & zw[:, n:, :], sm & xw[:, n:, :])
+            det_out = phase_par ^ tri
+            # -- merge: one select per step replaces per-shot cond --
+            xw = jnp.where(has_stab[:, None, None], x_rand, xw)
+            zw = jnp.where(has_stab[:, None, None], z_rand, zw)
+            r = jnp.where(has_stab[:, None], r_rand, r)
+            bit = jnp.where(has_stab, rnd, det_out)
+            out = out.at[:, a].set(bit)
+            return xw, zw, r, out
+
+        out0 = jnp.zeros((b, n), dtype=jnp.int32)
+        _, _, _, out = jax.lax.fori_loop(
+            0, n, measure_one, (xw, zw, r, out0)
+        )
+        return out
+
+    return sample
+
+
+def _draw_coins(keys: jax.Array, n: int) -> jnp.ndarray:
+    """Per-shot coins, bit-identical to the per-shot engine's draw:
+    ``(random.bits(key, (n,), uint32) & 1)`` vmapped over the keys."""
+    bits = jax.vmap(lambda k: jax.random.bits(k, (n,), jnp.uint32))(keys)
+    return (bits & 1).astype(jnp.int32)
+
+
+def build_gf2_tableau_run_batch(n: int, ops, n_params: int):
+    """``run_batch(keys[B], params=None) -> int32 bits[B, n]``.
+
+    ``keys`` is a batch of PRNG keys (one per shot/list position);
+    ``params`` is ``None``, a shared ``[P]`` vector, or a per-shot
+    ``[B, P]`` matrix.  This is the entry ``generate_lists_stabilizer``
+    feeds per-position meas keys and per-position permutation bits.
+    """
+    core = build_gf2_sample_core(n, ops, n_params)
+
+    def run_batch(keys: jax.Array, params: jnp.ndarray | None = None):
+        rnds = _draw_coins(keys, n)
+        if params is not None and params.ndim == 1:
+            params = jnp.broadcast_to(
+                params[None, :], (rnds.shape[0], params.shape[0])
+            )
+        return core(rnds, params)
+
+    return run_batch
+
+
+def build_gf2_tableau_run_shots(n: int, ops, n_params: int):
+    """``run(key, shots, params=None) -> int32 bits[shots, n]`` — the
+    :meth:`Circuit.compile_shots` contract on the batched GF(2) engine,
+    key-tree-identical to the per-shot reference
+    (:func:`qba_tpu.qsim.stabilizer.build_tableau_run_shots`): the key
+    splits into ``shots`` subkeys and each shot's coins come from its
+    own subkey."""
+    run_batch = build_gf2_tableau_run_batch(n, ops, n_params)
+
+    def run(
+        key: jax.Array, shots: int, params: jnp.ndarray | None = None
+    ) -> jnp.ndarray:
+        return run_batch(jax.random.split(key, shots), params)
+
+    return run

@@ -27,6 +27,7 @@ from qba_tpu.qsim.circuit import Circuit, Gate
 from qba_tpu.qsim.sampler import generate_lists
 from qba_tpu.qsim.protocol_circuits import (
     generate_lists_dense,
+    generate_lists_stabilizer,
     not_q_correlated,
     q_correlated,
 )
@@ -35,12 +36,18 @@ from qba_tpu.qsim.protocol_circuits import (
 def generate_lists_for(cfg, key):
     """Dispatch list generation on ``cfg.qsim_path`` — the single chooser
     shared by all three protocol backends (jax / local / native), so the
-    key tree stays identical across them."""
+    key tree stays identical across them.
+
+    ``"stabilizer"`` takes the batched GF(2) symplectic path
+    (:func:`~qba_tpu.qsim.protocol_circuits.generate_lists_stabilizer`)
+    — bit-identical to the per-position tableau reference under the
+    same key, and the only path that reaches 65/129/257-party scale.
+    """
     if cfg.qsim_path == "factorized":
         return generate_lists(cfg, key)
     if cfg.qsim_path == "stabilizer":
-        impl = "stabilizer"
-    elif cfg.qsim_path == "dense_pallas":
+        return generate_lists_stabilizer(cfg, key)
+    if cfg.qsim_path == "dense_pallas":
         impl = "auto"
     else:
         impl = "xla"
@@ -58,6 +65,7 @@ __all__ = [
     "generate_lists",
     "generate_lists_dense",
     "generate_lists_for",
+    "generate_lists_stabilizer",
     "not_q_correlated",
     "q_correlated",
 ]

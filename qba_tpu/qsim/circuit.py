@@ -104,6 +104,46 @@ class Circuit:
     def n_params(self) -> int:
         return max((op.param + 1 for op in self.ops if op.param is not None), default=0)
 
+    def resolve_auto_impl(self) -> str:
+        """Resolve ``impl="auto"`` to a concrete executor.
+
+        At or under :data:`~qba_tpu.config.DENSE_QUBIT_CAP` qubits the
+        dense fused kernel wins (Pallas on TPU, interpreter elsewhere).
+        Past the cap a statevector cannot exist — 2**n amplitudes — so
+        a Clifford op list hands off to the stabilizer tableau engine
+        instead of building a guaranteed-OOM dense program; the handoff
+        is recorded (``warn_and_record``) so run manifests capture the
+        engine decision.  Non-Clifford past the cap is infeasible on
+        every engine and raises.
+        """
+        from qba_tpu.config import DENSE_QUBIT_CAP
+
+        if self.n_qubits <= DENSE_QUBIT_CAP:
+            return "pallas" if jax.default_backend() == "tpu" else "pallas_interpret"
+        from qba_tpu.qsim.stabilizer import is_clifford_ops
+
+        if is_clifford_ops(self.ops):
+            from qba_tpu.diagnostics import QBADemotionWarning, warn_and_record
+
+            warn_and_record(
+                f"{self.n_qubits}-qubit circuit exceeds the dense cap "
+                f"({DENSE_QUBIT_CAP}); op list is Clifford — routing "
+                "impl='auto' to the stabilizer tableau engine",
+                QBADemotionWarning,
+                site="qsim.circuit.resolve_auto_impl",
+                engine_from="pallas",
+                engine_to="stabilizer",
+                reason="dense_qubit_cap",
+                n_qubits=self.n_qubits,
+                dense_qubit_cap=DENSE_QUBIT_CAP,
+            )
+            return "stabilizer"
+        raise ValueError(
+            f"{self.n_qubits}-qubit circuit exceeds the dense cap "
+            f"({DENSE_QUBIT_CAP} qubits) and is outside the stabilizer "
+            "engine's Clifford gate set — no executor can run it"
+        )
+
     def compile_state(self, impl: str = "xla"):
         """Build ``state(params=None) -> final flat statevector [2**n]``.
 
@@ -167,8 +207,13 @@ class Circuit:
         engine (:mod:`qba_tpu.qsim.stabilizer`) — identical contract,
         no qubit-count cap (the reference's 48-qubit 11-party joint
         circuit, ``tfg.py:76-80``, runs through here).
+        ``impl="auto"`` picks per :meth:`resolve_auto_impl` — past the
+        dense cap, Clifford circuits hand off to the stabilizer engine
+        rather than OOM.
         """
         n = self.n_qubits
+        if impl == "auto":
+            impl = self.resolve_auto_impl()
         if impl == "stabilizer":
             from qba_tpu.qsim.stabilizer import build_tableau_run
 
@@ -186,15 +231,22 @@ class Circuit:
 
         Multi-shot batching: the statevector is prepared ONCE and only
         the Born sampling batches over shots (``shots`` must be static
-        under jit).  On ``impl="stabilizer"`` each shot is an
-        independent vmapped tableau run (measurement collapses a
-        tableau; prep is O(n^2), the cheap part).
+        under jit).  On ``impl="stabilizer"`` the whole shot batch runs
+        on the batched GF(2) engine (:mod:`qba_tpu.gf2.symplectic`):
+        the static op list is compiled once into an aggregate
+        symplectic transform and all shots advance together through a
+        masked measurement sweep — bit-identical to the per-shot
+        tableau (:func:`~qba_tpu.qsim.stabilizer.build_tableau_run_shots`,
+        the differential reference) under identical keys.
+        ``impl="auto"`` resolves per :meth:`resolve_auto_impl`.
         """
         n = self.n_qubits
+        if impl == "auto":
+            impl = self.resolve_auto_impl()
         if impl == "stabilizer":
-            from qba_tpu.qsim.stabilizer import build_tableau_run_shots
+            from qba_tpu.gf2 import build_gf2_tableau_run_shots
 
-            return build_tableau_run_shots(
+            return build_gf2_tableau_run_shots(
                 n, tuple(self.ops), self.n_params
             )
         state_fn = self.compile_state(impl)

@@ -39,9 +39,9 @@ CHEAP = QBAConfig(17, 16, 4)
 def test_clean_tree_zero_findings():
     report = run_lint(configs=[("cheap", CHEAP)])
     assert report.ok, report.render(verbose=True)
-    # All 9 build paths of the cheap config must actually have traced —
+    # All 12 build paths of the cheap config must actually have traced —
     # a lint that silently skips paths would also report zero findings.
-    assert report.stats["paths_traced"] == 9
+    assert report.stats["paths_traced"] == 12
     assert report.stats["dots_checked"] > 0
     assert not report.stats["unhandled_primitives"]
     assert report.stats["vma_builds_checked"] == 3
@@ -50,12 +50,42 @@ def test_clean_tree_zero_findings():
 
 def test_lint_matrix_covers_planner_phases():
     labels = [label for label, _ in lint_configs()]
-    assert labels == ["cheap", "north-star", "f32-gdt"]
+    assert labels == ["cheap", "north-star", "f32-gdt", "stabilizer"]
+    # The stabilizer point pins the batched GF(2) resource path.
+    assert any(
+        c.qsim_path == "stabilizer" for _, c in lint_configs()
+    )
     # The north-star point is the calibration anchor; losing it from
     # the matrix silently drops the HBM-band check.
     assert (33, 64, 10) in [
         (c.n_parties, c.size_l, c.n_dishonest) for _, c in lint_configs()
     ]
+
+
+def test_gf2_engine_lint_clean():
+    # Acceptance criterion (ISSUE 7): every GF(2) parity dot on the
+    # batched stabilizer path proves KI-3-clean from the interval seeds
+    # alone — no Precision.HIGHEST, zero allowlist markers.
+    stab = QBAConfig(11, 16, 3, qsim_path="stabilizer")
+    report = run_lint(configs=[("stabilizer", stab)], engines=["gf2"])
+    assert report.ok, report.render(verbose=True)
+    assert report.stats["paths_traced"] == 3
+    assert report.stats["dots_checked"] > 0
+    assert report.stats["dots_skipped_nonintegral"] == 0
+    assert not report.stats["unhandled_primitives"]
+    assert not any("allowlisted" in n for n in report.notes)
+    # The packed-tableau KI-2 entry must have fired as a note.
+    assert any("gf2-tableau" in n for n in report.notes)
+    # And the source itself carries no exact-ok escape hatches (the
+    # marker is only live in a comment; linalg.py's docstring names it
+    # in prose to state this very contract).
+    gf2_dir = os.path.join(
+        os.path.dirname(__file__), os.pardir, "qba_tpu", "gf2"
+    )
+    for fname in os.listdir(gf2_dir):
+        if fname.endswith(".py"):
+            with open(os.path.join(gf2_dir, fname)) as fh:
+                assert "# qba-lint: exact-ok" not in fh.read(), fname
 
 
 def test_cli_lint_clean(capsys):

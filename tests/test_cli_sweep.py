@@ -144,6 +144,22 @@ class TestCLI:
         assert rec["metric"] == "protocol_rounds_per_sec"
         assert rec["value"] > 0
 
+    def test_bench_resource_gen_json(self):
+        out = io.StringIO()
+        rc = main(
+            ["bench", "--scenario", "resource_gen", "--n-parties", "5",
+             "--size-l", "8", "--trials", "4", "--reps", "1",
+             "--qsim-path", "stabilizer"],
+            out=out,
+        )
+        assert rc == 0
+        rec = json.loads(out.getvalue())
+        assert rec["metric"] == "resource_shots_per_sec"
+        assert rec["value"] > 0
+        assert rec["qsim"] == "stabilizer/gf2-batched"
+        assert rec["shots_per_rep"] == 4 * 8
+        assert rec["config"]["qsim_path"] == "stabilizer"
+
     def test_sweep_with_checkpoint(self, tmp_path):
         ckpt = str(tmp_path / "c.json")
         args = ["sweep", "--n-parties", "3", "--size-l", "4", "--trials", "4",
