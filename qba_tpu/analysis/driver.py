@@ -2,11 +2,14 @@
 
 :func:`run_lint` traces every engine/kernel build path
 (:mod:`qba_tpu.analysis.traces`), interval-interprets each jaxpr
-(:mod:`qba_tpu.analysis.intervals`), and runs the three invariant
-passes — KI-3 exact-dot (:mod:`qba_tpu.analysis.dots`), KI-1
-vma-threading (:mod:`qba_tpu.analysis.vma`), KI-2 plan audit
-(:mod:`qba_tpu.analysis.memory`) — over a small config matrix chosen
-to cover the planner's phase space:
+(:mod:`qba_tpu.analysis.intervals`), and runs the invariant passes —
+KI-3 exact-dot (:mod:`qba_tpu.analysis.dots`), KI-1 vma-threading
+(:mod:`qba_tpu.analysis.vma`), KI-2 plan audit incl. sharded
+per-device budgets (:mod:`qba_tpu.analysis.memory`), and, with
+``effects=True`` (CLI ``--effects``), KI-5 donation/aliasing
+(:mod:`qba_tpu.analysis.effects`) and KI-6 host-sync discipline
+(:mod:`qba_tpu.analysis.transfers`) — over a small config matrix
+chosen to cover the planner's phase space:
 
 * ``cheap``       — (17, 16, 4): every engine live, fused plan resolves,
   even lieutenant count so the 2-way sharded variants trace;
@@ -75,6 +78,7 @@ def saved_plan_configs(path: str) -> list[tuple[str, QBAConfig]]:
 
 def _lint_config(
     label: str, cfg: QBAConfig, engines, sitewide: bool,
+    effects: bool = False,
 ) -> Report:
     from qba_tpu.analysis.dots import check_dots
     from qba_tpu.analysis.intervals import IntervalInterpreter
@@ -106,15 +110,25 @@ def _lint_config(
         report.extend(check_memory(cfg))
     if "gf2" in engine_set:
         report.extend(check_gf2_memory(cfg))
+    if effects:
+        from qba_tpu.analysis.effects import check_effects
+        from qba_tpu.analysis.transfers import check_jaxpr_transfers
+
+        report.extend(check_effects(cfg, paths, engine_set))
+        report.extend(check_jaxpr_transfers(paths))
     return report
 
 
 def run_lint(
     configs: Sequence[tuple[str, QBAConfig]] | None = None,
     engines: Iterable[str] | None = None,
+    effects: bool = False,
 ) -> Report:
     """Run every lint pass over ``configs`` (default: the built-in
     matrix) restricted to ``engines`` (default: all build paths).
+    ``effects=True`` adds the KI-5 donation/aliasing audit and the
+    KI-6 host-sync discipline gate (per-config jaxpr passes plus the
+    sitewide AST sweep, serve dispatch proof, and jit-donation audit).
     Returns one aggregated report; ``report.ok`` is the CI gate."""
     if engines is not None:
         bad = set(engines) - set(ENGINE_CHOICES)
@@ -126,6 +140,14 @@ def run_lint(
     report = Report()
     sitewide = True
     for label, cfg in configs if configs is not None else lint_configs():
-        report.extend(_lint_config(label, cfg, engines, sitewide))
+        report.extend(
+            _lint_config(label, cfg, engines, sitewide, effects=effects)
+        )
         sitewide = False
+    if effects:
+        from qba_tpu.analysis.effects import check_jit_donation
+        from qba_tpu.analysis.transfers import check_transfers
+
+        report.extend(check_transfers())
+        report.extend(check_jit_donation())
     return report

@@ -1,0 +1,608 @@
+"""KI-5 donation/aliasing audit.
+
+The round engines' throughput story rests on buffer donation: every
+round-scan carry (``vi`` and the mailbox pool) must flow through a
+kernel whose ``input_output_aliases`` hands the carried HBM buffer
+back to the next iteration, otherwise each round allocates a fresh
+pool generation and the KI-2 trial ceiling silently halves.  Until
+this pass, that discipline lived in comments next to the alias dicts
+(``ops/round_kernel_tiled.py``, ``ops/round_kernel.py``) — nothing
+machine-checked that a claimed donation *actually aliases*, or that a
+carry does not round-trip through a fresh allocation.  This pass
+re-derives it from the jaxprs:
+
+* **Alias consistency** — every ``(i, o)`` pair in a ``pallas_call``'s
+  ``input_output_aliases`` must name an in-range input/output with
+  identical shape *and* dtype (XLA rejects some of these at compile
+  time, but only on TPU — CPU interpret tests would never see it).
+* **Donation coverage** — a ``pallas_call`` claiming *no* aliases
+  while some output exactly matches an input's shape+dtype is a missed
+  donation candidate and is flagged; a deliberate miss is annotated
+  ``# qba-lint: donate-ok (reason)`` at the call site (the party-
+  sharded builders legitimately alias only ``vi`` — gathered global
+  pool in, local pool out — and their alias dicts say so).
+* **Scan-carry donation** — for each round engine, the full
+  ``run_trial`` jaxpr is traced and every ``lax.scan`` whose body
+  launches a kernel is audited: each carry output is chased backwards
+  (through shape/dtype-preserving ops and ``pjit`` bodies) to its
+  producer; a carry produced by a kernel output *without* an alias
+  onto it round-trips through a fresh HBM allocation — finding.  The
+  alias's source input must itself chase back to the scan carry state.
+  Carries produced by plain XLA ops (the ``xla`` engine, counter
+  state) are XLA's buffer-reuse problem and are counted, not flagged.
+* **Top-level jit donation** — the dispatch jits
+  (:mod:`qba_tpu.backends.jax_backend`, :mod:`qba_tpu.parallel.spmd`)
+  are audited by AST: any ``donate_argnums`` claim must not overlap
+  ``static_argnums`` (a donated static is dead machinery), and the
+  deliberate zero-donation policy (keys are reused across repeat
+  dispatches by bench/serve; state donation lives in the kernel
+  aliases above) is recorded as a note so a future claim is a
+  conscious change.
+
+Findings are tagged ``KI-5`` (docs/KNOWN_ISSUES.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import warnings
+
+from qba_tpu.analysis.findings import Finding, Report
+from qba_tpu.analysis.intervals import source_location
+from qba_tpu.config import QBAConfig
+
+#: Call-site marker that demotes a donation-coverage finding to a note
+#: carrying the justification (same grammar as ``qba-lint: exact-ok``
+#: and ``qba-lint: sync-ok`` — docs/ANALYSIS.md).
+DONATE_ALLOW_MARKER = "qba-lint: donate-ok"
+
+#: Engines whose ``run_trial`` round scans the carry audit traces.
+SCAN_ENGINES = ("xla", "pallas", "pallas_tiled", "pallas_fused")
+
+#: Shape/dtype-preserving primitives the carry chase looks through —
+#: they forward the same buffer-sized value, so donation "survives"
+#: them (XLA fuses them into the consumer or aliases the copy).
+_TRANSPARENT_PRIMS = frozenset({
+    "convert_element_type", "copy", "copy_p", "reshape", "transpose",
+    "squeeze", "expand_dims", "rev", "reduce_precision",
+    "stop_gradient", "device_put", "optimization_barrier",
+    "sharding_constraint",
+})
+
+_CALL_PRIMS = frozenset({
+    "pjit", "closed_call", "core_call", "xla_call", "named_call",
+    "custom_jvp_call", "custom_vjp_call", "remat", "checkpoint",
+})
+
+
+def annotation_at(where: str, marker: str) -> str | None:
+    """Return the justification text if the source at ``where``
+    ("file:line") carries ``# <marker> ...`` within one line of the
+    location (wrapped calls), else None.  Shared reader for the
+    ``qba-lint:`` annotation family."""
+    path, _, lineno = where.rpartition(":")
+    if not path:
+        return None
+    try:
+        num = int(lineno)
+        with open(path) as fh:
+            lines = fh.readlines()
+    except (ValueError, OSError):
+        return None
+    for i in range(max(0, num - 2), min(len(lines), num + 2)):
+        if marker in lines[i]:
+            return lines[i].split(marker, 1)[1].strip() or "annotated"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Jaxpr plumbing.
+
+
+def _as_jaxprs(v):
+    if hasattr(v, "eqns"):  # Jaxpr
+        yield v
+    elif hasattr(v, "jaxpr"):  # ClosedJaxpr
+        yield v.jaxpr
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _as_jaxprs(x)
+
+
+def iter_eqns(jaxpr):
+    """All equations of ``jaxpr``, descending into call/scan/cond
+    sub-jaxprs (but not into Pallas kernel bodies — a kernel body
+    cannot launch another kernel)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        if eqn.primitive.name == "pallas_call":
+            continue
+        for p in eqn.params.values():
+            for sub in _as_jaxprs(p):
+                yield from iter_eqns(sub)
+
+
+def _aval_sig(var):
+    aval = getattr(var, "aval", None)
+    return (
+        tuple(getattr(aval, "shape", ()) or ()),
+        str(getattr(aval, "dtype", "")),
+    )
+
+
+def _producers(jaxpr):
+    prods = {}
+    for eqn in jaxpr.eqns:
+        for j, v in enumerate(eqn.outvars):
+            if type(v).__name__ != "DropVar":
+                prods[v] = (eqn, j)
+    return prods
+
+
+@dataclasses.dataclass
+class _Frame:
+    """One level of the backward chase: a jaxpr, its producer map, and
+    the call equation that entered it (None at the top)."""
+
+    jaxpr: object
+    prods: dict
+    call_eqn: object
+
+
+def _chase_back(var, frames):
+    """Chase ``var`` backwards through shape-preserving ops and call
+    bodies to its producing allocation.  Returns
+    ``(kind, payload, out_idx, frames)`` with kind one of ``"invar"``
+    (payload = top-frame input index), ``"pallas"`` (payload = the
+    kernel eqn, out_idx = which kernel output), ``"literal"``,
+    ``"const"`` or ``"opaque"`` (payload = the producing eqn)."""
+    frames = list(frames)
+    for _ in range(10_000):  # structural walk; cycles are impossible
+        if type(var).__name__ == "Literal":
+            return ("literal", None, None, frames)
+        frame = frames[-1]
+        invars = frame.jaxpr.invars
+        for idx, iv in enumerate(invars):
+            if iv is var:
+                if len(frames) == 1:
+                    return ("invar", idx, None, frames)
+                call_eqn = frame.call_eqn
+                off = len(call_eqn.invars) - len(invars)
+                var = call_eqn.invars[off + idx]
+                frames = frames[:-1]
+                break
+        else:
+            ent = frame.prods.get(var)
+            if ent is None:
+                return ("const", None, None, frames)
+            eqn, j = ent
+            name = eqn.primitive.name
+            if name in _TRANSPARENT_PRIMS:
+                var = eqn.invars[0]
+                continue
+            if name == "pallas_call":
+                return ("pallas", eqn, j, frames)
+            sub = eqn.params.get("call_jaxpr") or (
+                eqn.params.get("jaxpr")
+                if name in _CALL_PRIMS else None
+            )
+            if sub is not None:
+                inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                frames = frames + [_Frame(inner, _producers(inner), eqn)]
+                var = inner.outvars[j]
+                continue
+            return ("opaque", eqn, j, frames)
+        continue
+    return ("opaque", None, None, frames)
+
+
+# ---------------------------------------------------------------------------
+# Pallas-call alias audit (per traced build path).
+
+
+def _audit_pallas_eqn(eqn, path, report, stats) -> None:
+    aliases = dict(eqn.params.get("input_output_aliases") or ())
+    where = source_location(eqn)
+    in_sigs = [_aval_sig(v) for v in eqn.invars]
+    out_sigs = [_aval_sig(v) for v in eqn.outvars]
+    stats["pallas_calls_audited"] += 1
+    for i, o in aliases.items():
+        stats["alias_pairs_checked"] += 1
+        if not (0 <= i < len(in_sigs) and 0 <= o < len(out_sigs)):
+            report.findings.append(Finding(
+                ki="KI-5", check="alias-consistency", path=path,
+                where=where,
+                message=(
+                    f"input_output_aliases {{{i}: {o}}} is out of range "
+                    f"({len(in_sigs)} inputs, {len(out_sigs)} outputs)"
+                ),
+            ))
+            continue
+        if in_sigs[i] != out_sigs[o]:
+            report.findings.append(Finding(
+                ki="KI-5", check="alias-consistency", path=path,
+                where=where,
+                message=(
+                    f"claimed donation {{{i}: {o}}} does not alias: "
+                    f"input {in_sigs[i][0]}/{in_sigs[i][1]} vs output "
+                    f"{out_sigs[o][0]}/{out_sigs[o][1]} — a donation "
+                    "that changes shape or dtype is a fresh allocation "
+                    "plus a copy, not a reuse"
+                ),
+            ))
+    if not aliases:
+        # A kernel that donates nothing while an output exactly matches
+        # an un-aliased input is a missed in-place update: the output
+        # is a fresh HBM buffer the input's could have carried.
+        matches = [
+            (i, o)
+            for o, osig in enumerate(out_sigs)
+            for i, isig in enumerate(in_sigs)
+            if osig == isig and osig[0]
+        ]
+        if matches:
+            justification = annotation_at(where, DONATE_ALLOW_MARKER)
+            if justification is not None:
+                report.notes.append(
+                    f"{path}: allowlisted donation miss at {where}: "
+                    f"{justification}"
+                )
+            else:
+                i, o = matches[0]
+                report.findings.append(Finding(
+                    ki="KI-5", check="donation-miss", path=path,
+                    where=where,
+                    message=(
+                        f"pallas_call declares no input_output_aliases "
+                        f"but output {o} matches input {i} "
+                        f"({out_sigs[o][0]}/{out_sigs[o][1]}) — donate "
+                        "it, or annotate the call site with "
+                        f"'# {DONATE_ALLOW_MARKER} (reason)'"
+                    ),
+                ))
+
+
+def audit_pallas_calls(closed_jaxpr, path: str = "fixture") -> Report:
+    """Alias-consistency + donation-coverage over every kernel launch
+    in one jaxpr — the per-path half of :func:`check_effects`, exposed
+    for fixture tests."""
+    report = Report()
+    stats = {"pallas_calls_audited": 0, "alias_pairs_checked": 0}
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name == "pallas_call":
+            _audit_pallas_eqn(eqn, path, report, stats)
+    report.stats.update(stats)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Scan-carry donation audit.
+
+
+def _contains_pallas(jaxpr) -> bool:
+    return any(
+        e.primitive.name == "pallas_call" for e in iter_eqns(jaxpr)
+    )
+
+
+def _find_scans(jaxpr):
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            yield eqn
+            continue
+        if eqn.primitive.name == "pallas_call":
+            continue
+        for p in eqn.params.values():
+            for sub in _as_jaxprs(p):
+                yield from _find_scans(sub)
+
+
+def audit_scan_carries(closed_jaxpr, path, report, stats) -> None:
+    """Audit every kernel-launching ``scan`` in ``closed_jaxpr``: each
+    carry must either pass through untouched, chase back to an aliased
+    kernel output whose alias source is the carry state, or be a plain
+    XLA value (counted as ``xla_carries`` — XLA owns that reuse)."""
+    jaxpr = closed_jaxpr.jaxpr
+    for scan_eqn in _find_scans(jaxpr):
+        body = scan_eqn.params["jaxpr"]
+        bj = body.jaxpr if hasattr(body, "jaxpr") else body
+        if not _contains_pallas(bj):
+            stats["scans_without_kernels"] += 1
+            continue
+        stats["kernel_scans_audited"] += 1
+        nc = scan_eqn.params.get("num_consts", 0)
+        nk = scan_eqn.params.get("num_carry", 0)
+        frames0 = [_Frame(bj, _producers(bj), None)]
+        for c in range(nk):
+            stats["scan_carries_audited"] += 1
+            kind, payload, j, frames = _chase_back(
+                bj.outvars[c], frames0
+            )
+            if kind == "pallas":
+                eqn = payload
+                where = source_location(eqn)
+                aliases = dict(
+                    eqn.params.get("input_output_aliases") or ()
+                )
+                srcs = [i for i, o in aliases.items() if o == j]
+                if not srcs:
+                    report.findings.append(Finding(
+                        ki="KI-5", check="scan-carry", path=path,
+                        where=where,
+                        message=(
+                            f"scan carry {c} is kernel output {j} with "
+                            "no alias onto it: every round allocates a "
+                            "fresh HBM generation of this carry "
+                            "(input_output_aliases must hand the "
+                            "carried buffer back)"
+                        ),
+                    ))
+                    continue
+                k2, idx2, _, _ = _chase_back(
+                    eqn.invars[srcs[0]], frames
+                )
+                if k2 == "invar" and nc <= idx2 < nc + nk:
+                    stats["donated_carries"] += 1
+                else:
+                    report.findings.append(Finding(
+                        ki="KI-5", check="scan-carry", path=path,
+                        where=where,
+                        message=(
+                            f"scan carry {c} aliases kernel input "
+                            f"{srcs[0]}, but that input does not "
+                            "originate from the scan carry state "
+                            f"(chased to {k2}) — the donated buffer is "
+                            "not the carried one"
+                        ),
+                    ))
+            elif kind == "invar" and payload is not None and (
+                nc <= payload < nc + nk
+            ):
+                stats["passthrough_carries"] += 1
+            else:
+                stats["xla_carries"] += 1
+
+
+def audit_scans(closed_jaxpr, path: str = "fixture") -> Report:
+    """Scan-carry donation audit over one jaxpr — exposed for fixture
+    tests; :func:`check_effects` drives the engine sweep."""
+    report = Report()
+    stats = {
+        "kernel_scans_audited": 0,
+        "scan_carries_audited": 0,
+        "donated_carries": 0,
+        "passthrough_carries": 0,
+        "xla_carries": 0,
+        "scans_without_kernels": 0,
+    }
+    audit_scan_carries(closed_jaxpr, path, report, stats)
+    report.stats.update(stats)
+    return report
+
+
+def trace_trial_scan(cfg: QBAConfig, engine: str):
+    """``jax.make_jaxpr`` of one full ``run_trial`` with the round
+    engine forced, so the audit sees the scan exactly as dispatch
+    builds it (plan resolution, demotions and all)."""
+    import jax
+
+    from qba_tpu.rounds.engine import run_trial
+
+    ecfg = dataclasses.replace(cfg, round_engine=engine)
+    key = jax.random.key(0)
+    return jax.make_jaxpr(lambda k: run_trial(ecfg, k))(key)
+
+
+def _audit_engine_scans(cfg, engines, report, stats) -> None:
+    import jax
+
+    for engine in SCAN_ENGINES:
+        if engine not in engines:
+            continue
+        before = dict(stats)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                closed = trace_trial_scan(cfg, engine)
+        except Exception as exc:  # demoted/unbuildable path -> note
+            report.notes.append(
+                f"effects/{engine}: scan audit skipped "
+                f"({type(exc).__name__}: {exc})"
+            )
+            continue
+        audit_scan_carries(closed, f"{engine}/run_trial", report, stats)
+        donated = stats["donated_carries"] - before.get(
+            "donated_carries", 0
+        )
+        audited = stats["scan_carries_audited"] - before.get(
+            "scan_carries_audited", 0
+        )
+        if audited:
+            report.notes.append(
+                f"effects/{engine}: {donated}/{audited} round-scan "
+                "carries kernel-donated"
+            )
+        else:
+            report.notes.append(
+                f"effects/{engine}: round scan is XLA-managed "
+                "(no kernel launch in the body; donation is XLA "
+                "buffer reuse)"
+            )
+    # The packed fused runner folds trials into the kernel grid; its
+    # scan carries the packed pool and must donate it the same way.
+    if "pallas_fused" in engines:
+        try:
+            from qba_tpu.rounds.engine import run_trials_fused_packed
+
+            keys = jax.random.split(jax.random.key(0), 2)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                closed = jax.make_jaxpr(
+                    lambda k: run_trials_fused_packed(cfg, k, 2)
+                )(keys)
+        except Exception as exc:
+            report.notes.append(
+                f"effects/fused_packed: scan audit skipped "
+                f"({type(exc).__name__}: {exc})"
+            )
+        else:
+            audit_scan_carries(
+                closed, "fused_packed/run_trials", report, stats
+            )
+
+
+# ---------------------------------------------------------------------------
+# Top-level jit donation audit (AST).
+
+
+def _jit_calls(tree):
+    """Yield every ``jax.jit`` application in ``tree`` — direct
+    decorator, ``jax.jit(...)`` call, or ``functools.partial(jax.jit,
+    ...)`` — with the keyword dict that configures it."""
+    def is_jax_jit(node):
+        return (
+            isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+        )
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if is_jax_jit(fn):
+            yield node, {kw.arg: kw.value for kw in node.keywords}
+        elif (
+            (isinstance(fn, ast.Name) and fn.id == "partial")
+            or (isinstance(fn, ast.Attribute) and fn.attr == "partial")
+        ) and node.args and is_jax_jit(node.args[0]):
+            yield node, {kw.arg: kw.value for kw in node.keywords}
+
+
+def _int_set(node) -> set[int] | None:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def check_jit_donation(source_paths=None) -> Report:
+    """KI-5 over the top-level dispatch jits: ``donate_argnums``
+    claims must be sound (no overlap with ``static_argnums``), and the
+    zero-donation policy is recorded.  Zero jits found is itself a
+    finding — the audit no longer matches the module layout."""
+    report = Report()
+    if source_paths is None:
+        import qba_tpu.backends.jax_backend as jb
+        import qba_tpu.parallel.spmd as spmd_mod
+
+        source_paths = [jb.__file__, spmd_mod.__file__]
+    jits = 0
+    claims = 0
+    for path in source_paths:
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        rel = os.path.basename(path)
+        for call, kws in _jit_calls(tree):
+            jits += 1
+            where = f"{path}:{call.lineno}"
+            donate = kws.get("donate_argnums") or kws.get(
+                "donate_argnames"
+            )
+            if donate is None:
+                continue
+            claims += 1
+            dset = _int_set(kws.get("donate_argnums"))
+            sset = _int_set(kws.get("static_argnums"))
+            if dset is None or sset is None:
+                report.notes.append(
+                    f"effects/jit: non-literal donate/static argnums "
+                    f"at {where} — donation soundness not statically "
+                    "checkable"
+                )
+                continue
+            overlap = dset & sset
+            if overlap:
+                report.findings.append(Finding(
+                    ki="KI-5", check="jit-donation", path=f"jit:{rel}",
+                    where=where,
+                    message=(
+                        f"donate_argnums {sorted(overlap)} are also "
+                        "static_argnums: a static argument has no "
+                        "buffer to donate — the claim is dead "
+                        "machinery"
+                    ),
+                ))
+            else:
+                report.notes.append(
+                    f"effects/jit: donation claim {sorted(dset)} at "
+                    f"{where}"
+                )
+    if jits == 0:
+        report.findings.append(Finding(
+            ki="KI-5", check="jit-donation", path="jit:*",
+            message=(
+                "found zero jax.jit applications in the dispatch "
+                "modules — the donation audit no longer matches the "
+                "module layout"
+            ),
+        ))
+    elif claims == 0:
+        report.notes.append(
+            f"effects/jit: {jits} dispatch jits, zero donate_argnums "
+            "claims (policy: trial keys are reused across repeat "
+            "dispatches by bench/serve; carry donation lives in the "
+            "kernel input_output_aliases)"
+        )
+    report.stats["jits_audited"] = jits
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Entry point.
+
+
+def check_effects(cfg: QBAConfig, paths, engines) -> Report:
+    """Run the KI-5 audit for one lint config: alias consistency and
+    donation coverage over every already-traced build path, plus the
+    scan-carry audit over each engine's full ``run_trial`` jaxpr.
+    ``paths`` is the :func:`qba_tpu.analysis.traces.trace_paths`
+    output (re-used, not re-traced)."""
+    report = Report()
+    stats = {
+        "pallas_calls_audited": 0,
+        "alias_pairs_checked": 0,
+        "kernel_scans_audited": 0,
+        "scan_carries_audited": 0,
+        "donated_carries": 0,
+        "passthrough_carries": 0,
+        "xla_carries": 0,
+        "scans_without_kernels": 0,
+    }
+    kernel_free_paths = []
+    for p in paths:
+        before = stats["pallas_calls_audited"]
+        for eqn in iter_eqns(p.closed_jaxpr.jaxpr):
+            if eqn.primitive.name == "pallas_call":
+                _audit_pallas_eqn(eqn, p.name, report, stats)
+        if stats["pallas_calls_audited"] == before:
+            kernel_free_paths.append(p.name)
+    if kernel_free_paths:
+        report.notes.append(
+            "effects: kernel-free build paths (donation is XLA buffer "
+            f"reuse): {', '.join(sorted(kernel_free_paths))}"
+        )
+    _audit_engine_scans(cfg, set(engines), report, stats)
+    report.stats.update(stats)
+    return report

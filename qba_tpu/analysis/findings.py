@@ -10,6 +10,16 @@ mechanizes (``docs/KNOWN_ISSUES.md``):
 * ``KI-3`` — a default-precision float dot whose integer operand bound
   exceeds bf16's exact range of 256
   (:mod:`qba_tpu.analysis.dots`).
+* ``KI-5`` — a donation/aliasing claim that does not hold: a scan
+  carry that round-trips through a fresh HBM allocation, a
+  ``pallas_call`` whose ``input_output_aliases`` are inconsistent or
+  missing on a state-shaped operand, or a top-level jit whose
+  ``donate_argnums`` claim is unsound
+  (:mod:`qba_tpu.analysis.effects`).
+* ``KI-6`` — an implicit device→host transfer on a hot module outside
+  a ``fenced`` telemetry span and without a ``qba-lint: sync-ok``
+  annotation, or a violation of serve's double-buffer dispatch
+  ordering (:mod:`qba_tpu.analysis.transfers`).
 
 A *note* is an informational line the report carries alongside the
 findings (plan predictions, probe-counter reality checks) — notes
@@ -21,14 +31,14 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterable
 
-KI_TAGS = ("KI-1", "KI-2", "KI-3")
+KI_TAGS = ("KI-1", "KI-2", "KI-3", "KI-5", "KI-6")
 
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One violated invariant."""
 
-    ki: str  # "KI-1" | "KI-2" | "KI-3"
+    ki: str  # one of KI_TAGS
     check: str  # pass name, e.g. "exact-dot", "vma-threading"
     path: str  # traced build path, e.g. "pallas_tiled/rebuild"
     message: str  # human-readable statement of the violation

@@ -50,6 +50,12 @@ POOL_OCCUPANCY = 1.5
 NORTH_STAR = (33, 64, 10)
 NORTH_STAR_CEILING_BAND = (1088, 1151)
 
+#: Mesh shapes (dp, tp) the lint predicts per-device budgets for by
+#: default — the gate ROADMAP item 1's remote-DMA sharding lands
+#: behind.  dp replicates trials across data-parallel devices; tp
+#: shards the receiver axis of the mailbox pool.
+DEFAULT_MESH_SHAPES = ((2, 4),)
+
 
 def trial_ceiling(cfg: QBAConfig, hbm_bytes: int = HBM_BYTES) -> int:
     """Predicted max concurrent trials before the pool exhausts HBM."""
@@ -59,7 +65,50 @@ def trial_ceiling(cfg: QBAConfig, hbm_bytes: int = HBM_BYTES) -> int:
     return int((hbm_bytes - HBM_RESERVE) // (POOL_OCCUPANCY * per_trial))
 
 
-def _audit_plans(cfg: QBAConfig, n_recv: int | None, report: Report) -> None:
+def sharded_pool_bytes(cfg: QBAConfig, tp: int) -> dict:
+    """Per-device resident pool under tp-way party sharding: each
+    device carries ``n_lieutenants // tp`` receivers' mailbox rows, so
+    the padding model applies to the *shard's* cap, not the global one
+    (narrow shards pay proportionally more padding — the pad_ratio in
+    the result is the honest per-device number)."""
+    from qba_tpu.ops.round_kernel_tiled import pool_bytes
+
+    if tp < 1 or cfg.n_lieutenants % tp != 0:
+        raise ValueError(
+            f"tp={tp} does not divide n_lieutenants={cfg.n_lieutenants}"
+        )
+    return pool_bytes(cfg, n_recv=cfg.n_lieutenants // tp)
+
+
+def sharded_trial_ceiling(
+    cfg: QBAConfig, dp: int = 1, tp: int = 1,
+    hbm_bytes: int = HBM_BYTES,
+) -> dict:
+    """Per-device and whole-mesh trial ceilings for a (dp, tp) mesh.
+
+    tp shards the receiver axis (each device holds a
+    ``n_lieutenants // tp`` slice of the pool), dp replicates the
+    tp-group over independent trials — so the per-device ceiling is
+    set by the *sharded* pool bytes against one device's HBM, and the
+    mesh ceiling is ``dp`` times that (trials never share state across
+    dp replicas).  (dp=1, tp=1) reduces exactly to
+    :func:`trial_ceiling`."""
+    per_device_pool = sharded_pool_bytes(cfg, tp)["padded_bytes"]
+    per_device = int(
+        (hbm_bytes - HBM_RESERVE) // (POOL_OCCUPANCY * per_device_pool)
+    )
+    return {
+        "dp": dp,
+        "tp": tp,
+        "n_recv": cfg.n_lieutenants // tp,
+        "per_device_pool_bytes": per_device_pool,
+        "per_device_trials": per_device,
+        "mesh_trials": dp * per_device,
+    }
+
+
+def _audit_plans(cfg: QBAConfig, n_recv: int | None, report: Report,
+                 prefix: str | None = None) -> None:
     from qba_tpu.ops.round_kernel_tiled import (
         _FUSED_BUDGET,
         _REBUILD_BUDGET,
@@ -77,7 +126,8 @@ def _audit_plans(cfg: QBAConfig, n_recv: int | None, report: Report) -> None:
         resolve_verdict_variant,
     )
 
-    prefix = "spmd/" if n_recv is not None else ""
+    if prefix is None:
+        prefix = "spmd/" if n_recv is not None else ""
     n_rv = n_recv if n_recv is not None else cfg.n_lieutenants
     n_pool = cfg.n_lieutenants * cfg.slots
     n_out = n_rv * cfg.slots
@@ -288,6 +338,43 @@ def check_memory(cfg: QBAConfig) -> Report:
         f"roofline: {rf['per_round_per_trial_bytes']} B/round/trial "
         f"upper bound, pool share {rf['pool_share']}"
     )
+
+    # Sharded per-device budgets (ROADMAP item 1): for each default
+    # mesh shape, re-run the plan audit at the per-device receiver
+    # shard and predict the per-device / mesh trial ceilings.
+    meshes_checked = 0
+    for dp, tp in DEFAULT_MESH_SHAPES:
+        if cfg.n_lieutenants % tp != 0:
+            report.notes.append(
+                f"sharded-hbm: mesh (dp={dp}, tp={tp}) skipped — tp "
+                f"does not divide n_lieutenants={cfg.n_lieutenants}"
+            )
+            continue
+        meshes_checked += 1
+        if cfg.n_lieutenants // tp != cfg.n_lieutenants // 2:
+            _audit_plans(cfg, cfg.n_lieutenants // tp, report,
+                         prefix=f"spmd[tp={tp}]/")
+        sc = sharded_trial_ceiling(cfg, dp=dp, tp=tp)
+        report.notes.append(
+            f"sharded-hbm[dp={dp},tp={tp}]: per-device pool "
+            f"{sc['per_device_pool_bytes']} B/trial "
+            f"(n_recv={sc['n_recv']}) -> ~{sc['per_device_trials']} "
+            f"trials/device, ~{sc['mesh_trials']} mesh trials on v5e"
+        )
+        if sc["per_device_trials"] < 1:
+            report.findings.append(Finding(
+                ki="KI-2", check="sharded-hbm",
+                path=f"spmd[dp={dp},tp={tp}]",
+                message=(
+                    f"per-device pool {sc['per_device_pool_bytes']} "
+                    f"B/trial at n_recv={sc['n_recv']} cannot fit a "
+                    f"single trial per device under the v5e model "
+                    f"({HBM_BYTES} B HBM, {HBM_RESERVE} B reserve, "
+                    f"occupancy {POOL_OCCUPANCY}) — this mesh shape "
+                    "is oversharded for the shape's mailbox pool"
+                ),
+            ))
+    report.stats["sharded_meshes_checked"] = meshes_checked
 
     probes_fired = PROBE_STATS["compile_probes"] - probes_before
     if jax.default_backend() != "tpu" and probes_fired > 0:

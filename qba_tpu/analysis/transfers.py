@@ -1,0 +1,449 @@
+"""KI-6 host-sync discipline audit.
+
+On an async-dispatch backend, every implicit device→host transfer —
+``np.asarray`` on a device value, ``.item()``, ``bool()``/``float()``
+of a traced result, a bare ``block_until_ready`` — is a silent
+pipeline stall: it blocks the host until the device drains, and if it
+happens *between* a chunk's dispatch and the next chunk's enqueue it
+serializes the double buffer the serving and sweep paths are built
+around (docs/PERF.md readback-barrier methodology, docs/SERVING.md).
+The discipline the tree lives by is: a host sync is legal only
+
+* inside a telemetry span whose body marks ``<span>.fenced = True`` —
+  the span *is* the readback barrier and the telemetry attributes the
+  stall to the device (``qba_tpu/obs/telemetry.py``); or
+* annotated ``# qba-lint: sync-ok (reason)`` at the call site — for
+  host-side numpy on data that was never on the device (key
+  derivation at intake, wire decoding).
+
+This pass mechanizes it three ways:
+
+* **AST sweep** over the hot modules (``rounds/``, ``ops/``,
+  ``serve/``, ``sweep.py``, ``benchmark.py``): every sync-shaped call
+  site must be fenced or annotated.  ``jnp.asarray`` is device-side
+  and never flagged.  Zero sites found across the serve/sweep
+  pipelines is itself a finding — the audit no longer matches the
+  module layout.
+* **Dispatch-order proof** over ``QBAServer._dispatch``: statically,
+  chunk k+1's ``_in_flight.append`` precedes any drain/sync in the
+  method (so chunk k's readback never forces a sync before the next
+  dispatch is enqueued), the drain loop is bounded by ``self.depth``,
+  the ``serve.dispatch`` span stays enqueue-only (no sync, never
+  fenced), and ``_drain_one`` pops FIFO (``pop(0)``) so readback
+  order matches dispatch order.
+* **Jaxpr sweep** over the traced build paths: callback primitives
+  (``pure_callback`` / ``io_callback`` / ``debug_callback``) inside a
+  hot jitted program are implicit host round-trips per grid step and
+  are flagged.
+
+Findings are tagged ``KI-6`` (docs/KNOWN_ISSUES.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from qba_tpu.analysis.effects import annotation_at, iter_eqns
+from qba_tpu.analysis.findings import Finding, Report
+
+#: Call-site marker demoting a host-sync finding to a note carrying
+#: the justification (docs/ANALYSIS.md annotation grammar).
+SYNC_ALLOW_MARKER = "qba-lint: sync-ok"
+
+#: Jaxpr-level primitives that round-trip to the host from inside a
+#: jitted program.
+_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+})
+
+#: Host-numpy module aliases whose ``asarray``/``array`` force a
+#: device readback when fed a device value.
+_HOST_NP_NAMES = ("np", "numpy", "onp")
+
+
+def hot_module_paths(root: str | None = None) -> list[str]:
+    """The audited surface: the modules on the dispatch/readback hot
+    path.  ``rounds/`` and ``ops/`` are in scope even though today
+    they only use device-side ``jnp`` — a future ``np`` leak there
+    would sync once per *trace*, the worst place possible."""
+    if root is None:
+        import qba_tpu
+
+        root = os.path.dirname(qba_tpu.__file__)
+    paths: list[str] = []
+    for sub in ("rounds", "ops", "serve"):
+        d = os.path.join(root, sub)
+        for fname in sorted(os.listdir(d)):
+            if fname.endswith(".py"):
+                paths.append(os.path.join(d, fname))
+    for fname in ("sweep.py", "benchmark.py"):
+        paths.append(os.path.join(root, fname))
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# Sync-site detection.
+
+
+def _contains_traced_call(node) -> bool:
+    """True if ``node``'s subtree references ``jnp.*`` / ``jax.*`` —
+    the cast argument is (or contains) a device value."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and isinstance(
+            sub.value, ast.Name
+        ) and sub.value.id in ("jnp", "jax"):
+            return True
+    return False
+
+
+def _sync_kind(call: ast.Call) -> str | None:
+    """Classify ``call`` as a device→host sync site, or None."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        if (
+            isinstance(fn.value, ast.Name)
+            and fn.value.id in _HOST_NP_NAMES
+            and fn.attr in ("asarray", "array")
+        ):
+            return f"{fn.value.id}.{fn.attr}"
+        if fn.attr == "item" and not call.args and not call.keywords:
+            return ".item()"
+        if fn.attr == "block_until_ready":
+            return ".block_until_ready()"
+        if (
+            fn.attr == "device_get"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "jax"
+        ):
+            return "jax.device_get"
+    elif isinstance(fn, ast.Name) and fn.id in ("bool", "int", "float"):
+        if len(call.args) == 1 and _contains_traced_call(call.args[0]):
+            return f"{fn.id}() on a traced value"
+    return None
+
+
+class _SyncVisitor(ast.NodeVisitor):
+    """Collects sync sites with their enclosing-``with`` fence state."""
+
+    def __init__(self):
+        self.with_stack: list[bool] = []
+        self.sites: list[tuple[ast.Call, str, bool]] = []
+
+    @staticmethod
+    def _is_fencing_with(node: ast.With) -> bool:
+        spanlike = any(
+            isinstance(item.context_expr, ast.Call)
+            and isinstance(item.context_expr.func, ast.Attribute)
+            and item.context_expr.func.attr in ("span", "time")
+            for item in node.items
+        )
+        if not spanlike:
+            return False
+        for stmt in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Attribute)
+                and stmt.targets[0].attr == "fenced"
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is True
+            ):
+                return True
+        return False
+
+    def visit_With(self, node: ast.With) -> None:
+        self.with_stack.append(self._is_fencing_with(node))
+        self.generic_visit(node)
+        self.with_stack.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        kind = _sync_kind(node)
+        if kind is not None:
+            self.sites.append((node, kind, any(self.with_stack)))
+        self.generic_visit(node)
+
+
+def audit_module(source_path: str, report: Report, stats: dict) -> None:
+    """KI-6 AST sweep over one module."""
+    with open(source_path) as fh:
+        tree = ast.parse(fh.read(), filename=source_path)
+    rel = os.path.basename(source_path)
+    visitor = _SyncVisitor()
+    visitor.visit(tree)
+    for call, kind, fenced in visitor.sites:
+        stats["sync_sites_checked"] += 1
+        where = f"{source_path}:{call.lineno}"
+        if fenced:
+            stats["sync_sites_fenced"] += 1
+            continue
+        justification = annotation_at(where, SYNC_ALLOW_MARKER)
+        if justification is not None:
+            stats["sync_sites_allowlisted"] += 1
+            report.notes.append(
+                f"transfers: allowlisted host-sync ({kind}) at "
+                f"{rel}:{call.lineno}: {justification}"
+            )
+            continue
+        report.findings.append(Finding(
+            ki="KI-6", check="host-sync", path=f"module:{rel}",
+            where=where,
+            message=(
+                f"{kind} outside a fenced telemetry span: an implicit "
+                "device→host transfer stalls async dispatch "
+                "unattributed — wrap it in a span that sets "
+                "`<span>.fenced = True`, or annotate "
+                f"'# {SYNC_ALLOW_MARKER} (reason)' if the data never "
+                "lives on the device"
+            ),
+        ))
+
+
+# ---------------------------------------------------------------------------
+# Serve dispatch-order proof.
+
+
+def _calls_named(node, name: str):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            if (isinstance(fn, ast.Attribute) and fn.attr == name) or (
+                isinstance(fn, ast.Name) and fn.id == name
+            ):
+                yield sub
+
+
+def _stmt_has_sync(stmt) -> bool:
+    return any(
+        isinstance(sub, ast.Call) and _sync_kind(sub) is not None
+        for sub in ast.walk(stmt)
+    )
+
+
+def _find_method(tree, cls_name: str, meth_name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for item in node.body:
+                if (
+                    isinstance(item, ast.FunctionDef)
+                    and item.name == meth_name
+                ):
+                    return item
+    return None
+
+
+def check_serve_dispatch(source_path: str | None = None) -> Report:
+    """Statically prove serve's double-buffer invariant on
+    ``QBAServer._dispatch`` / ``_drain_one`` (docs/SERVING.md): chunk
+    k's readback never forces a sync before chunk k+1's dispatch is
+    enqueued."""
+    report = Report()
+    if source_path is None:
+        import qba_tpu.serve.engine as serve_engine
+
+        source_path = serve_engine.__file__
+    rel = os.path.basename(source_path)
+    path = f"serve:{rel}"
+    with open(source_path) as fh:
+        tree = ast.parse(fh.read(), filename=source_path)
+
+    dispatch = _find_method(tree, "QBAServer", "_dispatch")
+    drain = _find_method(tree, "QBAServer", "_drain_one")
+    if dispatch is None or drain is None:
+        report.findings.append(Finding(
+            ki="KI-6", check="dispatch-order", path=path,
+            message=(
+                "QBAServer._dispatch/_drain_one not found — the "
+                "double-buffer proof no longer matches the module "
+                "layout"
+            ),
+        ))
+        return report
+
+    # 1. Statement order inside _dispatch: the in-flight append (the
+    #    enqueue of chunk k+1) must precede any drain or sync.
+    append_at = drain_at = sync_at = None
+    for i, stmt in enumerate(dispatch.body):
+        if append_at is None:
+            for call in _calls_named(stmt, "append"):
+                fn = call.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Attribute)
+                    and fn.value.attr == "_in_flight"
+                ):
+                    append_at = i
+                    break
+        if drain_at is None and any(_calls_named(stmt, "_drain_one")):
+            drain_at = i
+        if sync_at is None and _stmt_has_sync(stmt):
+            sync_at = i
+    if append_at is None:
+        report.findings.append(Finding(
+            ki="KI-6", check="dispatch-order", path=path,
+            where=f"{source_path}:{dispatch.lineno}",
+            message=(
+                "_dispatch never appends to _in_flight — the "
+                "double-buffer proof no longer matches the code"
+            ),
+        ))
+    else:
+        for label, at in (("a drain", drain_at), ("a host sync", sync_at)):
+            if at is not None and at < append_at:
+                report.findings.append(Finding(
+                    ki="KI-6", check="dispatch-order", path=path,
+                    where=f"{source_path}:{dispatch.body[at].lineno}",
+                    message=(
+                        f"_dispatch performs {label} before enqueuing "
+                        "the chunk on _in_flight: chunk k's readback "
+                        "would block before chunk k+1's dispatch is "
+                        "enqueued, serializing the double buffer"
+                    ),
+                ))
+
+    # 2. The drain loop must be bounded by the configured depth —
+    #    an unconditional drain degenerates to depth-1 (no overlap).
+    depth_bounded = False
+    for stmt in ast.walk(dispatch):
+        if isinstance(stmt, ast.While) and any(
+            _calls_named(stmt, "_drain_one")
+        ):
+            depth_bounded = any(
+                isinstance(sub, ast.Attribute) and sub.attr == "depth"
+                for sub in ast.walk(stmt.test)
+            )
+    if append_at is not None and not depth_bounded:
+        report.findings.append(Finding(
+            ki="KI-6", check="dispatch-order", path=path,
+            where=f"{source_path}:{dispatch.lineno}",
+            message=(
+                "_dispatch's drain loop is not bounded by self.depth: "
+                "the in-flight window no longer matches the "
+                "configured double-buffer depth"
+            ),
+        ))
+
+    # 3. The dispatch span must stay enqueue-only: fencing it (or
+    #    syncing inside it) would time the device, not the enqueue,
+    #    and stall the pipeline inside the dispatch phase.
+    for node in ast.walk(dispatch):
+        if not isinstance(node, ast.With):
+            continue
+        names = [
+            item.context_expr.args[0].value
+            for item in node.items
+            if isinstance(item.context_expr, ast.Call)
+            and isinstance(item.context_expr.func, ast.Attribute)
+            and item.context_expr.func.attr == "span"
+            and item.context_expr.args
+            and isinstance(item.context_expr.args[0], ast.Constant)
+        ]
+        if "serve.dispatch" not in names:
+            continue
+        fenced = _SyncVisitor._is_fencing_with(node)
+        synced = any(_stmt_has_sync(s) for s in node.body)
+        if fenced or synced:
+            report.findings.append(Finding(
+                ki="KI-6", check="dispatch-order", path=path,
+                where=f"{source_path}:{node.lineno}",
+                message=(
+                    "the serve.dispatch span must stay enqueue-only "
+                    "(no host sync, never fenced) — it measures the "
+                    "async enqueue, and a sync here serializes "
+                    "dispatch against the previous chunk's compute"
+                ),
+            ))
+
+    # 4. FIFO drain: _drain_one must pop the OLDEST chunk so chunk k
+    #    is read back before chunk k+1.
+    fifo = any(
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "pop"
+        and call.args
+        and isinstance(call.args[0], ast.Constant)
+        and call.args[0].value == 0
+        for call in _calls_named(drain, "pop")
+    )
+    if not fifo:
+        report.findings.append(Finding(
+            ki="KI-6", check="dispatch-order", path=path,
+            where=f"{source_path}:{drain.lineno}",
+            message=(
+                "_drain_one does not pop(0) from _in_flight: readback "
+                "order would diverge from dispatch order and the "
+                "oldest chunk's results could wait behind newer ones"
+            ),
+        ))
+    report.stats["dispatch_proof_obligations"] = 4
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Jaxpr half: host callbacks inside traced programs.
+
+
+def check_jaxpr_transfers(paths) -> Report:
+    """Flag host-callback primitives inside the traced build paths —
+    each one is a device→host round trip per invocation, inside code
+    that runs once per round per trial."""
+    from qba_tpu.analysis.intervals import source_location
+
+    report = Report()
+    scanned = 0
+    for p in paths:
+        for eqn in iter_eqns(p.closed_jaxpr.jaxpr):
+            scanned += 1
+            if eqn.primitive.name in _CALLBACK_PRIMS:
+                where = source_location(eqn)
+                justification = (
+                    annotation_at(where, SYNC_ALLOW_MARKER)
+                    if where else None
+                )
+                if justification is not None:
+                    report.notes.append(
+                        f"transfers: allowlisted host callback "
+                        f"({eqn.primitive.name}) at {where}: "
+                        f"{justification}"
+                    )
+                    continue
+                report.findings.append(Finding(
+                    ki="KI-6", check="host-callback", path=p.name,
+                    where=where,
+                    message=(
+                        f"{eqn.primitive.name} inside a hot traced "
+                        "program: a host round trip per invocation "
+                        "on the round path"
+                    ),
+                ))
+    report.stats["jaxpr_eqns_scanned"] = scanned
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Entry point.
+
+
+def check_transfers(module_paths=None) -> Report:
+    """Run the sitewide KI-6 audit: the AST sweep over every hot
+    module plus the serve dispatch-order proof."""
+    report = Report()
+    stats = {
+        "sync_sites_checked": 0,
+        "sync_sites_fenced": 0,
+        "sync_sites_allowlisted": 0,
+    }
+    for path in module_paths or hot_module_paths():
+        audit_module(path, report, stats)
+    if module_paths is None and stats["sync_sites_checked"] == 0:
+        report.findings.append(Finding(
+            ki="KI-6", check="host-sync", path="module:*",
+            message=(
+                "found zero host-sync sites across the hot modules — "
+                "the serve/sweep readback pipelines always sync "
+                "somewhere, so the audit no longer matches the module "
+                "layout"
+            ),
+        ))
+    report.stats.update(stats)
+    report.extend(check_serve_dispatch())
+    return report

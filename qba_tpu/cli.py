@@ -248,6 +248,18 @@ def _parser() -> argparse.ArgumentParser:
         "(docs/SERVING.md)",
     )
     lint.add_argument(
+        "--effects", action="store_true",
+        help="also run the KI-5 donation/aliasing audit and the KI-6 "
+        "host-sync discipline gate (jaxpr scan-carry/pallas alias "
+        "chase + AST sweep of the hot modules + serve dispatch-order "
+        "proof; docs/ANALYSIS.md)",
+    )
+    lint.add_argument(
+        "--findings-json", metavar="PATH", default=None,
+        help="write the full report (findings, notes, stats) as JSON "
+        "to PATH — the CI lint job uploads this as an artifact",
+    )
+    lint.add_argument(
         "-v", "--verbose", action="store_true",
         help="print notes (plan predictions, HBM ceilings) even when "
         "there are findings",
@@ -777,8 +789,28 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
         for label, cfg in saved_plan_configs(args.saved_plans):
             if (cfg.n_parties, cfg.size_l, cfg.n_dishonest) not in covered:
                 configs.append((label, cfg))
-    report = run_lint(configs=configs, engines=engines)
+    report = run_lint(
+        configs=configs, engines=engines, effects=args.effects,
+    )
     print(report.render(verbose=args.verbose), file=out)
+    if args.findings_json:
+        import dataclasses
+        import json
+
+        payload = {
+            "schema": "qba-tpu/lint-findings/v1",
+            "ok": report.ok,
+            "effects": bool(args.effects),
+            "findings": [dataclasses.asdict(f) for f in report.findings],
+            "notes": report.notes,
+            "stats": {
+                k: (sorted(v) if isinstance(v, (set, frozenset)) else v)
+                for k, v in report.stats.items()
+            },
+        }
+        with open(args.findings_json, "w") as fh:
+            json.dump(payload, fh, indent=2)
+        print(f"findings json: {args.findings_json}", file=out)
     return 0 if report.ok else 1
 
 

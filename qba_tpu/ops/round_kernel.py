@@ -465,7 +465,9 @@ def build_round_step(
     # loop at the headline config).  Safe because the kernel loads every
     # aliased ref into values before its first output store (vals/lens/
     # count/p/v/sent are read exactly once at the top; vi is copied into
-    # ovi and only ovi is read after).
+    # ovi and only ovi is read after).  Machine-checked: KI-5
+    # `qba-tpu lint --effects` chases every scan carry to an aliased
+    # kernel output (scan-carry / alias-consistency checks).
     n_vmem_in = 15
     n_smem_in = 2 if local else 1  # round_idx [+ recv offset]
     # The local variant cannot alias the global mailbox inputs into its

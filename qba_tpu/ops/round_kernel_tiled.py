@@ -562,6 +562,10 @@ def build_verdict_kernel(
         # un-aliased carry costs a copy per round (see the monolithic
         # kernel's aliasing note).  Safe: vi_ref is copied into the
         # revisited ovi block at grid step 0 and only ovi is read after.
+        # Machine-checked: KI-5 `qba-tpu lint --effects` chases every
+        # scan carry to an aliased kernel output (checks scan-carry /
+        # alias-consistency); editing this dict breaks the lint, not
+        # just this comment.
         input_output_aliases={(2 if local else 1) + 4: 1},
         compiler_params=CompilerParams(
             # See build_rebuild_kernel: large vmap batches multi-buffer
@@ -1190,7 +1194,8 @@ def build_rebuild_kernel(
         # first destination block writes back — and the caller never
         # reuses the donated arrays after this call.  The party-sharded
         # variant cannot alias (gathered global pool in, local pool
-        # out — different shapes).
+        # out — different shapes).  Machine-checked: KI-5
+        # `qba-tpu lint --effects` (scan-carry / alias-consistency).
         input_output_aliases=(
             {} if local else {1: 0, 2: 1, 3: 2, 4: 3}
         ),
@@ -1781,7 +1786,8 @@ def build_fused_round_kernel(
         # aliasing notes; same safety argument: constant-index-map
         # sources are fetched before the first destination write-back).
         # The party-sharded variant can alias only vi (the pools have
-        # different shapes).
+        # different shapes).  Machine-checked: KI-5
+        # `qba-tpu lint --effects` (scan-carry / alias-consistency).
         input_output_aliases=(
             {7: 5} if local else {1: 0, 2: 1, 3: 2, 4: 3, 6: 5}
         ),
@@ -2295,9 +2301,15 @@ def _pad(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def pool_bytes(cfg: QBAConfig, trials: int = 1) -> dict:
+def pool_bytes(cfg: QBAConfig, trials: int = 1,
+               n_recv: int | None = None) -> dict:
     """Logical vs TPU-padded resident bytes of the carried pool — the
     planning view of the HBM ceiling (VERDICT r3 item 2).
+
+    ``n_recv`` narrows the receiver axis to a per-device shard (tp-way
+    party sharding carries ``n_recv = n_lieutenants // tp`` receivers
+    per device), which is the per-device resident pool the sharded
+    KI-2 model budgets against.
 
     Padding model (observed on v5e): the minor dim tiles to 128 lanes
     (so ``size_l=64`` doubles ``vals``/``p`` and any narrow column pays
@@ -2307,7 +2319,8 @@ def pool_bytes(cfg: QBAConfig, trials: int = 1) -> dict:
     bytes, 4x less padded — and kernel donation removed the second
     resident pool generation the scan carry used to keep."""
     n_rv, slots, max_l, s = (
-        cfg.n_lieutenants, cfg.slots, cfg.max_l, cfg.size_l,
+        n_recv if n_recv is not None else cfg.n_lieutenants,
+        cfg.slots, cfg.max_l, cfg.size_l,
     )
     cap = n_rv * slots
     vb = 2 if pool_vals_dtype(cfg) == jnp.bfloat16 else 4

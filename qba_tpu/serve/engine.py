@@ -117,6 +117,10 @@ class QBAServer:
         cfg = req.config()
         import jax
 
+        # Intake key derivation: a tiny CPU-resident key table
+        # materialized before anything is in flight — nothing
+        # device-side exists yet for this request to stall.
+        # qba-lint: sync-ok (pre-dispatch host key derivation)
         key_data = np.asarray(
             jax.random.key_data(jax.random.split(jax.random.key(cfg.seed), cfg.trials)),
             dtype=np.uint32,

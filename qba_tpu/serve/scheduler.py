@@ -107,6 +107,9 @@ class BucketScheduler:
     ) -> QBAConfig:
         """Queue ``cfg.trials`` trials (``key_data`` rows) under the
         request's bucket; returns the bucket config."""
+        # Wire decode: the key table arrives as host numpy from the
+        # transport and never lives on the device.
+        # qba-lint: sync-ok (host-side wire decode)
         key_data = np.asarray(key_data, dtype=np.uint32)
         if key_data.shape != (cfg.trials, 2):
             raise ValueError(

@@ -1,0 +1,70 @@
+"""Seeded KI-5 violations: a kernel missing ``input_output_aliases``
+and an alias dict drifted out of sync with the operand layout.
+
+* :func:`missing_alias_update` — an in-place-shaped update kernel
+  (output exactly matches an input's shape+dtype) that declares *no*
+  aliases: the output is a fresh HBM buffer the input could have
+  carried.  This is the donation-miss the lint exists for — Pallas
+  accepts it silently.
+* :func:`tampered_alias_jaxpr` — Pallas rejects a shape/dtype-
+  mismatched alias at trace time, so operand-layout drift (an operand
+  inserted without renumbering the alias dict) is seeded post-trace by
+  rewriting the equation params, exactly the artifact a stale lowering
+  or hand-edited jaxpr would ship.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _update_kernel(x_ref, d_ref, o_ref):
+    o_ref[...] = x_ref[...] + d_ref[...]
+
+
+def missing_alias_update(pool, delta):
+    """State-shaped kernel with no aliases: KI-5 donation-miss."""
+    return pl.pallas_call(
+        _update_kernel,
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+        interpret=True,
+    )(pool, delta)
+
+
+def donated_alias_update(pool, delta):
+    """The shipped form: the state operand donates into the output."""
+    return pl.pallas_call(
+        _update_kernel,
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+        input_output_aliases={0: 0},
+        interpret=True,
+    )(pool, delta)
+
+
+def tampered_alias_jaxpr():
+    """A traced aliased kernel whose alias dict is then renumbered to
+    point at the (differently-shaped) delta operand — KI-5
+    alias-consistency."""
+    pool, delta = example_operands()
+    delta = delta[:4]  # different shape than the pool
+
+    def k(x_ref, d_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    closed = jax.make_jaxpr(lambda p, d: pl.pallas_call(
+        k,
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+        input_output_aliases={0: 0},
+        interpret=True,
+    )(p, d))(pool, delta)
+    for eqn in closed.jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            eqn.params["input_output_aliases"] = ((1, 0),)
+    return closed
+
+
+def example_operands():
+    return (
+        jnp.zeros((8, 128), jnp.float32),
+        jnp.ones((8, 128), jnp.float32),
+    )

@@ -1,0 +1,52 @@
+"""Seeded KI-5 violation: an undonated round-scan carry.
+
+The round engines carry the mailbox pool through a ``lax.scan`` whose
+body launches a kernel; the shipped kernels hand the carried HBM
+buffer back via ``input_output_aliases``.  This fixture is the same
+shape *without* the alias — every iteration allocates a fresh
+generation of the carry, which on TPU silently halves the KI-2 trial
+ceiling (two resident pool generations) and adds a copy per round.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _bump_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + 1.0
+
+
+def _step(pool, donate: bool):
+    aliases = {0: 0} if donate else {}
+    return pl.pallas_call(
+        _bump_kernel,
+        out_shape=jax.ShapeDtypeStruct(pool.shape, pool.dtype),
+        input_output_aliases=aliases,
+        interpret=True,
+    )(pool)
+
+
+def undonated_round_loop(pool):
+    """Kernel-in-scan with NO alias onto the carry output: KI-5
+    scan-carry finding."""
+
+    def body(carry, _):
+        return _step(carry, donate=False), ()
+
+    final, _ = jax.lax.scan(body, pool, (), length=3)
+    return final
+
+
+def donated_round_loop(pool):
+    """The shipped form: the carry aliases the kernel input."""
+
+    def body(carry, _):
+        return _step(carry, donate=True), ()
+
+    final, _ = jax.lax.scan(body, pool, (), length=3)
+    return final
+
+
+def example_pool():
+    return jnp.zeros((8, 128), jnp.float32)

@@ -1,0 +1,20 @@
+"""Seeded KI-2 violation: an oversharded per-device budget.
+
+A 257-party list size so large that even after tp-way receiver
+sharding one device cannot hold a single trial's pool shard under the
+v5e HBM model — the mesh shape is undersized for the mailbox pool and
+dispatching it would OOM per device.  The sharded KI-2 pass must
+predict that statically (``sharded-hbm`` finding), not leave it to the
+first device allocation failure.
+"""
+
+from qba_tpu.config import QBAConfig
+
+#: (dp, tp) mesh the fixture overshards against — matches the lint's
+#: default mesh so ``check_memory`` flags it without extra wiring.
+OVERSHARDED_MESH = (2, 4)
+
+
+def oversharded_config() -> QBAConfig:
+    """257 parties at size_l=16384: per-device pool shard > HBM."""
+    return QBAConfig(n_parties=257, size_l=16384, n_dishonest=10)

@@ -1,0 +1,30 @@
+"""Seeded KI-6 violation: an unfenced mid-pipeline host sync.
+
+``drain_results`` reads device results back with a bare
+``np.asarray`` between dispatches — an implicit device→host transfer
+that blocks the host until the device drains, with no fenced span to
+attribute the stall and nothing marking it intentional.  On the
+double-buffered serve path this is exactly the bug that serializes
+chunk k's compute against chunk k+1's dispatch.
+
+``drain_results_fenced`` is the shipped discipline: the same readback
+inside a telemetry span that sets ``fenced = True``.
+"""
+
+import numpy as np
+
+
+def drain_results(dev_results, sink):
+    """Unfenced mid-pipeline readback: KI-6 host-sync finding."""
+    for res in dev_results:
+        host = np.asarray(res)
+        sink.append(host.sum())
+
+
+def drain_results_fenced(dev_results, sink, recorder):
+    """The shipped form: readback inside a fenced span."""
+    for res in dev_results:
+        with recorder.span("fixture.readback") as sp:
+            sp.fenced = True
+            host = np.asarray(res)
+        sink.append(host.sum())

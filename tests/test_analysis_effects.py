@@ -1,0 +1,328 @@
+"""Effects lint (``qba-tpu lint --effects``): KI-5 donation/aliasing,
+KI-6 host-sync discipline, and the sharded KI-2 per-device budgets.
+
+Same contract as ``tests/test_analysis.py``: the passes must be
+silent on the shipped tree and loud on every seeded regression in
+``tests/analysis_fixtures/``.
+"""
+
+import io
+import os
+import textwrap
+
+import jax
+import pytest
+
+from qba_tpu.analysis.driver import run_lint
+from qba_tpu.analysis.effects import (
+    DONATE_ALLOW_MARKER,
+    annotation_at,
+    audit_pallas_calls,
+    audit_scans,
+    check_effects,
+    check_jit_donation,
+)
+from qba_tpu.analysis.findings import Report
+from qba_tpu.analysis.memory import (
+    NORTH_STAR_CEILING_BAND,
+    check_memory,
+    sharded_trial_ceiling,
+    trial_ceiling,
+)
+from qba_tpu.analysis.transfers import (
+    SYNC_ALLOW_MARKER,
+    audit_module,
+    check_serve_dispatch,
+    check_transfers,
+)
+from qba_tpu.config import QBAConfig
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+#: The lint matrix's cheap point (every engine live, fused plan
+#: resolves, even lieutenant count) — see tests/test_analysis.py.
+CHEAP = QBAConfig(17, 16, 4)
+
+
+def _sync_stats():
+    return {
+        "sync_sites_checked": 0,
+        "sync_sites_fenced": 0,
+        "sync_sites_allowlisted": 0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Clean tree: the shipped kernels and modules are donation- and
+# sync-clean by construction.
+
+
+@pytest.mark.slow
+def test_clean_tree_effects_zero_findings():
+    report = run_lint(configs=[("cheap", CHEAP)], effects=True)
+    assert report.ok, report.render()
+    # The audits actually bit: kernels audited, carries chased,
+    # sync sites resolved.
+    assert report.stats["pallas_calls_audited"] > 0
+    assert report.stats["alias_pairs_checked"] > 0
+    assert report.stats["kernel_scans_audited"] > 0
+    assert report.stats["donated_carries"] > 0
+    assert report.stats["sync_sites_checked"] > 0
+    assert report.stats["jits_audited"] > 0
+
+
+def test_clean_tree_transfers_zero_findings():
+    report = check_transfers()
+    assert report.ok, report.render()
+    # The hot tree resolves every sync site explicitly: the serve
+    # readback + sweep readback are fenced, the intake key derivation
+    # and wire decode are allowlisted with citations.
+    assert report.stats["sync_sites_fenced"] >= 2
+    assert report.stats["sync_sites_allowlisted"] >= 2
+    assert report.stats["dispatch_proof_obligations"] == 4
+
+
+def test_clean_tree_jit_donation_policy():
+    report = check_jit_donation()
+    assert report.ok, report.render()
+    assert report.stats["jits_audited"] > 0
+    # The zero-donation policy on dispatch jits is recorded, so a
+    # future donate_argnums claim is a conscious change.
+    assert any("zero donate_argnums" in n for n in report.notes)
+
+
+# ---------------------------------------------------------------------------
+# KI-5 fixtures: undonated scan carry, missing/tampered aliases.
+
+
+def test_fixture_undonated_scan_carry():
+    from tests.analysis_fixtures import bad_scan_carry as bsc
+
+    pool = bsc.example_pool()
+    report = audit_scans(jax.make_jaxpr(bsc.undonated_round_loop)(pool))
+    assert not report.ok
+    assert {(f.ki, f.check) for f in report.findings} == {
+        ("KI-5", "scan-carry")
+    }
+    assert report.stats["donated_carries"] == 0
+
+    report = audit_scans(jax.make_jaxpr(bsc.donated_round_loop)(pool))
+    assert report.ok, report.render()
+    assert report.stats["donated_carries"] == 1
+
+
+def test_fixture_missing_kernel_alias():
+    from tests.analysis_fixtures import bad_kernel_alias as bka
+
+    p, d = bka.example_operands()
+    report = audit_pallas_calls(
+        jax.make_jaxpr(bka.missing_alias_update)(p, d)
+    )
+    assert [(f.ki, f.check) for f in report.findings] == [
+        ("KI-5", "donation-miss")
+    ]
+    # The finding carries the fixture's call site so the annotation
+    # escape hatch is actionable.
+    assert "bad_kernel_alias.py" in report.findings[0].where
+    assert DONATE_ALLOW_MARKER in report.findings[0].message
+
+    report = audit_pallas_calls(
+        jax.make_jaxpr(bka.donated_alias_update)(p, d)
+    )
+    assert report.ok, report.render()
+
+
+def test_fixture_tampered_alias_inconsistent():
+    from tests.analysis_fixtures import bad_kernel_alias as bka
+
+    report = audit_pallas_calls(bka.tampered_alias_jaxpr())
+    assert [(f.ki, f.check) for f in report.findings] == [
+        ("KI-5", "alias-consistency")
+    ]
+
+
+def test_donate_allow_marker_demotes(tmp_path):
+    """An annotated donation miss becomes a note, not a finding —
+    and the justification text survives into the note."""
+    from tests.analysis_fixtures import bad_kernel_alias as bka
+
+    p, d = bka.example_operands()
+    closed = jax.make_jaxpr(bka.missing_alias_update)(p, d)
+    # The finding anchors at the fixture's pallas_call line; verify
+    # annotation_at's window against a copy we annotate ourselves.
+    report = audit_pallas_calls(closed)
+    where = report.findings[0].where
+    path, _, line = where.rpartition(":")
+    src = open(path).readlines()
+    src.insert(int(line) - 1, f"    # {DONATE_ALLOW_MARKER} (test)\n")
+    marked = tmp_path / "marked.py"
+    marked.write_text("".join(src))
+    assert annotation_at(
+        f"{marked}:{int(line) + 1}", DONATE_ALLOW_MARKER
+    ) == "(test)"
+
+
+# ---------------------------------------------------------------------------
+# KI-6 fixtures: unfenced mid-pipeline sync, dispatch-order drift.
+
+
+def test_fixture_unfenced_sync():
+    report = Report()
+    stats = _sync_stats()
+    audit_module(
+        os.path.join(FIXTURES, "bad_unfenced_sync.py"), report, stats
+    )
+    assert [(f.ki, f.check) for f in report.findings] == [
+        ("KI-6", "host-sync")
+    ]
+    assert SYNC_ALLOW_MARKER in report.findings[0].message
+    # The fenced twin of the same readback is recognized, not flagged.
+    assert stats == {
+        "sync_sites_checked": 2,
+        "sync_sites_fenced": 1,
+        "sync_sites_allowlisted": 0,
+    }
+
+
+def test_sync_allow_marker_demotes(tmp_path):
+    src = textwrap.dedent("""\
+        import numpy as np
+
+        def decode(payload):
+            # qba-lint: sync-ok (host-side wire decode)
+            return np.asarray(payload)
+    """)
+    mod = tmp_path / "annotated.py"
+    mod.write_text(src)
+    report = Report()
+    stats = _sync_stats()
+    audit_module(str(mod), report, stats)
+    assert report.ok, report.render()
+    assert stats["sync_sites_allowlisted"] == 1
+    assert any("wire decode" in n for n in report.notes)
+
+
+def test_serve_dispatch_proof_clean():
+    report = check_serve_dispatch()
+    assert report.ok, report.render()
+
+
+def test_serve_dispatch_proof_flags_reordered(tmp_path):
+    """A _dispatch that drains (and syncs) before enqueuing the new
+    chunk — the double-buffer-serializing regression — is flagged."""
+    src = textwrap.dedent("""\
+        import numpy as np
+
+        class QBAServer:
+            def _dispatch(self, chunk):
+                while len(self._in_flight) > self.depth - 1:
+                    self._drain_one()
+                res = np.asarray(chunk.result)
+                self._in_flight.append((chunk, res))
+
+            def _drain_one(self):
+                return self._in_flight.pop()
+    """)
+    mod = tmp_path / "engine.py"
+    mod.write_text(src)
+    report = check_serve_dispatch(str(mod))
+    checks = [(f.ki, f.check) for f in report.findings]
+    assert checks.count(("KI-6", "dispatch-order")) >= 2
+    msgs = " ".join(f.message for f in report.findings)
+    assert "before enqueuing" in msgs  # drain/sync precede append
+    assert "pop(0)" in msgs  # non-FIFO drain
+
+
+# ---------------------------------------------------------------------------
+# Sharded KI-2: per-device budgets.
+
+
+def test_sharded_ceiling_reduces_to_single_chip():
+    ns = QBAConfig(33, 64, 10)
+    sc = sharded_trial_ceiling(ns, dp=1, tp=1)
+    assert sc["per_device_trials"] == trial_ceiling(ns)
+    assert sc["mesh_trials"] == trial_ceiling(ns)
+
+
+def test_sharded_north_star_budgets():
+    """Pins BOTH bands: the measured single-chip north-star band and
+    the (dp=2, tp=4) per-device prediction derived from it."""
+    ns = QBAConfig(33, 64, 10)
+    lo, hi = NORTH_STAR_CEILING_BAND
+    assert lo <= trial_ceiling(ns) <= hi
+    sc = sharded_trial_ceiling(ns, dp=2, tp=4)
+    assert sc["n_recv"] == 8
+    assert sc["per_device_pool_bytes"] == 2228224
+    assert sc["per_device_trials"] == 4577
+    assert sc["mesh_trials"] == 9154
+
+
+def test_sharded_budget_notes_emitted():
+    report = check_memory(CHEAP)
+    assert report.ok, report.render()
+    assert report.stats["sharded_meshes_checked"] == 1
+    assert any("sharded-hbm[dp=2,tp=4]" in n for n in report.notes)
+    # The per-device plan audit ran at the tp=4 shard.
+    assert any(n.startswith("spmd[tp=4]/") for n in report.notes)
+
+
+def test_sharded_mesh_skip_note_when_indivisible():
+    # f32-gdt point: n_lieutenants=10, tp=4 does not divide — a note,
+    # never a finding (the mesh simply does not apply to the shape).
+    report = check_memory(QBAConfig(11, 16, 3))
+    assert report.ok, report.render()
+    assert report.stats["sharded_meshes_checked"] == 0
+    assert any("skipped" in n and "tp does not divide" in n
+               for n in report.notes)
+
+
+def test_fixture_oversharded_budget():
+    from tests.analysis_fixtures import bad_sharded_budget as bsb
+
+    cfg = bsb.oversharded_config()
+    sc = sharded_trial_ceiling(cfg, *bsb.OVERSHARDED_MESH)
+    assert sc["per_device_trials"] < 1
+    report = check_memory(cfg)
+    assert ("KI-2", "sharded-hbm") in {
+        (f.ki, f.check) for f in report.findings
+    }
+
+
+# ---------------------------------------------------------------------------
+# Per-config entry + CLI.
+
+
+def test_check_effects_cheap_clean():
+    from qba_tpu.analysis.traces import trace_paths
+
+    paths, _ = trace_paths(CHEAP, {"pallas_tiled"})
+    report = check_effects(CHEAP, paths, {"pallas_tiled"})
+    assert report.ok, report.render()
+    assert report.stats["pallas_calls_audited"] > 0
+    assert report.stats["donated_carries"] > 0
+
+
+@pytest.mark.slow
+def test_cli_lint_effects_clean(tmp_path):
+    import json
+
+    from qba_tpu.cli import main
+
+    out = io.StringIO()
+    findings_json = tmp_path / "findings.json"
+    rc = main(
+        [
+            "lint", "--effects", "--config", "17,16,4",
+            "--findings-json", str(findings_json), "-v",
+        ],
+        out=out,
+    )
+    text = out.getvalue()
+    assert rc == 0, text
+    assert "0 finding(s)" in text
+    payload = json.loads(findings_json.read_text())
+    assert payload["schema"] == "qba-tpu/lint-findings/v1"
+    assert payload["ok"] is True
+    assert payload["effects"] is True
+    assert payload["findings"] == []
+    assert payload["stats"]["sync_sites_checked"] > 0
