@@ -1,29 +1,126 @@
-"""Success probability vs the security parameter sizeL.
+"""Success probability over the (strategy × noise × sizeL) surface.
 
 The protocol's agreement guarantee sharpens as the particle lists grow;
-this sweeps sizeL and (optionally) plots the curve.
+this maps that curve against the adversary zoo (strategy-indexed
+Byzantine fault injection, docs/ARCHITECTURE.md) and imperfect quantum
+resources (depolarizing + readout flip, qba_tpu/qsim/noise.py) in ONE
+sharded Monte-Carlo run: every cell goes through
+``qba_tpu.sweep.run_surface`` — dp-sharded over all visible devices,
+checkpoint-resumable, with per-cell kernel-plan manifest attribution.
 
-Usage: python examples/security_study.py [out.png]
+Usage:
+  python examples/security_study.py                 # full surface
+  python examples/security_study.py --quick         # CI-sized smoke
+  python examples/security_study.py --json out.json # surface + manifests
+  python examples/security_study.py --plot out.png  # per-strategy curves
 """
 
+import argparse
+import json
 import pathlib
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from qba_tpu import QBAConfig  # noqa: E402
+from qba_tpu.adversary import STRATEGIES  # noqa: E402
+from qba_tpu.sweep import run_surface  # noqa: E402
 
-from qba_tpu import QBAConfig, run_trials
 
-values = [1, 2, 4, 8, 16, 32, 64]
-rates = []
-for L in values:
-    cfg = QBAConfig(n_parties=5, size_l=L, n_dishonest=2, trials=256, seed=7)
-    rate = float(run_trials(cfg).success_rate)
-    rates.append(rate)
-    print(f"sizeL={L:3d}: success_rate={rate:.4f}")
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-parties", type=int, default=5)
+    ap.add_argument("--dishonest", type=int, default=2)
+    ap.add_argument("--trials", type=int, default=256)
+    ap.add_argument("--size-l", default="1,2,4,8,16,32,64")
+    ap.add_argument(
+        "--strategies", default=",".join(STRATEGIES),
+        help="comma list from the zoo (default: all)",
+    )
+    ap.add_argument(
+        "--noise", default="0:0,0.02:0.01",
+        help="comma list of p_depolarize:p_measure_flip pairs",
+    )
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--json", default=None, help="write the surface (with "
+                    "per-cell manifests) as JSON")
+    ap.add_argument("--plot", default=None, help="PNG of per-strategy "
+                    "curves at zero noise (requires matplotlib)")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny surface for CI/smoke")
+    args = ap.parse_args()
 
-if len(sys.argv) > 1:
-    from qba_tpu.obs.plots import plot_param_study
+    if args.quick:
+        strategies = ["reference", "split"]
+        noise_points = [(0.0, 0.0), (0.05, 0.02)]
+        size_ls = [4, 16]
+        trials = 64
+    else:
+        strategies = [s for s in args.strategies.split(",") if s]
+        noise_points = [
+            tuple(float(x) for x in pair.split(":"))
+            for pair in args.noise.split(",")
+        ]
+        size_ls = [int(x) for x in args.size_l.split(",")]
+        trials = args.trials
 
-    print("plot:", plot_param_study(values, rates, 256, "size_l",
-                                    sys.argv[1], log_x=True))
+    cfg = QBAConfig(
+        n_parties=args.n_parties, size_l=size_ls[0],
+        n_dishonest=args.dishonest, trials=trials, seed=7,
+    )
+    cells = run_surface(
+        cfg,
+        strategies=strategies,
+        noise_points=noise_points,
+        size_ls=size_ls,
+        n_chunks=1,
+        chunk_trials=trials,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    for c in cells:
+        plan = (c.manifest or {}).get("plan", {})
+        print(
+            f"strategy={c.strategy:9s} p={c.p_depolarize:.3f} "
+            f"q={c.p_measure_flip:.3f} sizeL={c.size_l:4d}: "
+            f"success_rate={c.result.success_rate:.4f} "
+            f"({c.result.n_trials} trials, "
+            f"engine={plan.get('engine', '?')})"
+        )
+
+    if args.json:
+        payload = [
+            {
+                "strategy": c.strategy,
+                "p_depolarize": c.p_depolarize,
+                "p_measure_flip": c.p_measure_flip,
+                "size_l": c.size_l,
+                "trials": c.result.n_trials,
+                "success_rate": c.result.success_rate,
+                "manifest": c.manifest,
+            }
+            for c in cells
+        ]
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+        print("wrote", args.json)
+
+    if args.plot:
+        from qba_tpu.obs.plots import plot_param_study
+
+        for strat in strategies:
+            pts = [
+                c for c in cells
+                if c.strategy == strat
+                and c.p_depolarize == 0.0 and c.p_measure_flip == 0.0
+            ]
+            if len(pts) > 1:
+                path = args.plot.replace(".png", f"_{strat}.png")
+                print("plot:", plot_param_study(
+                    [c.size_l for c in pts],
+                    [c.result.success_rate for c in pts],
+                    trials, "size_l", path, log_x=True,
+                ))
+
+
+if __name__ == "__main__":
+    main()

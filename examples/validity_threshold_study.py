@@ -64,6 +64,13 @@ def main() -> None:
     ap.add_argument("--dishonest", default="1,2,3,4,5")
     ap.add_argument("--size-l", default="4,16,64,256,1000")
     ap.add_argument("--trials", type=int, default=10_000)
+    ap.add_argument(
+        "--strategy", default="reference",
+        help="adversary-zoo strategy the grid runs under "
+        "(reference/collude/adaptive/split; docs/ARCHITECTURE.md)",
+    )
+    ap.add_argument("--p-depolarize", type=float, default=0.0)
+    ap.add_argument("--p-measure-flip", type=float, default=0.0)
     ap.add_argument("--out", default="docs/assets")
     ap.add_argument("--quick", action="store_true",
                     help="tiny grid for CI/smoke (overrides the above)")
@@ -89,6 +96,9 @@ def main() -> None:
             cfg = QBAConfig(
                 n_parties=n_p, size_l=L, n_dishonest=d,
                 trials=trials, seed=17 * d + L,
+                strategy=args.strategy,
+                p_depolarize=args.p_depolarize,
+                p_measure_flip=args.p_measure_flip,
             )
             # Chunk by pool footprint: sizeL=1000 at 10k trials would
             # blow the single-batch HBM ceiling (KI-2).
@@ -98,6 +108,9 @@ def main() -> None:
             b = study_breakdown(succ, hon[:, 0])
             b["profile"] = decision_profile(dec, hon, vc, cfg.w)
             b.update(n_parties=n_p, n_dishonest=d, size_l=L,
+                     strategy=args.strategy,
+                     p_depolarize=args.p_depolarize,
+                     p_measure_flip=args.p_measure_flip,
                      trials=int(succ.size), seconds=round(time.time() - t0, 1))
             points.append(b)
             va, pr = b["validity"], b["profile"]

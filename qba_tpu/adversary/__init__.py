@@ -3,11 +3,14 @@
 The reference threads an ``is_biz`` flag through every broadcast
 (``tfg.py:101-125,169-181,271-284``); here the adversary is a first-class
 configurable model: a per-rank honesty mask, commander equivocation as a
-per-recipient order vector, and the 4-action lieutenant attack applied at
-delivery time — sampled independently per (broadcast, recipient) under
-``attack_scope="delivery"``, or with the reference's shared-object
-mutation-leak semantics under ``attack_scope="broadcast"``
-(docs/DIVERGENCES.md D3).
+per-recipient order vector, and a strategy-indexed zoo of lieutenant
+attacks (``cfg.strategy``: reference / collude / adaptive / split)
+applied at delivery time — sampled independently per (broadcast,
+recipient) under ``attack_scope="delivery"``, or with the reference's
+shared-object mutation-leak semantics under ``attack_scope="broadcast"``
+(docs/DIVERGENCES.md D3).  Every strategy compiles down to the same
+``(attack, rand_v, late)`` effective-edit arrays, so all engines and
+backends consume it unchanged (see :mod:`qba_tpu.adversary.model`).
 """
 
 from qba_tpu.adversary.model import (
@@ -16,6 +19,11 @@ from qba_tpu.adversary.model import (
     DROP_BIT,
     EFFECT_NAMES,
     FORGE_BIT,
+    FORGE_P_BIT,
+    STRATEGIES,
+    STRATEGY_FORGE_BOUND,
+    AdversaryCtx,
+    adversary_ctx,
     assign_dishonest,
     effect_names,
     commander_orders,
@@ -30,6 +38,11 @@ __all__ = [
     "DROP_BIT",
     "EFFECT_NAMES",
     "FORGE_BIT",
+    "FORGE_P_BIT",
+    "STRATEGIES",
+    "STRATEGY_FORGE_BOUND",
+    "AdversaryCtx",
+    "adversary_ctx",
     "effect_names",
     "assign_dishonest",
     "commander_orders",

@@ -16,9 +16,42 @@ Corruption is applied at delivery time with a key derived from
 (trial, round, sender, slot, receiver) — distributionally identical to the
 reference's send-side sampling, minus its shared-object mutation accident
 (docs/DIVERGENCES.md D3).
+
+The strategy zoo (``cfg.strategy``) generalizes the third site into a
+family of batched adversary laws.  Every strategy is expressed as the
+same effective-edit arrays ``(attack, rand_v, late)`` from
+:func:`sample_attacks_round` — the narrow waist all round engines and
+backends already consume — so a new strategy automatically runs
+bit-identically on xla/pallas/pallas_tiled/pallas_fused/spmd and in the
+local/native event trails:
+
+* ``"reference"`` — the law above, byte-identical to historical outputs
+  (no new key-tree folds on this path).
+* ``"collude"`` — same action law, but every forging traitor writes ONE
+  shared per-trial target value (drawn once from the trial's rounds key)
+  instead of independent draws: coordinated equivocation.
+* ``"adaptive"`` — traitors condition on the packet's round and on the
+  value they received from the commander: early rounds
+  (``2 * round <= n_rounds``) are drop-heavy reconnaissance (drop 1/2),
+  late rounds are forge-heavy (forge 1/2), and the forged order is an
+  offset of the sender's own received value (never equal to it, always
+  in ``[0, w)`` by modular construction).
+* ``"split"`` — distinct commander and lieutenant policies: the
+  commander equivocates by rank *parity* (maximally interleaved
+  partition, see :func:`commander_orders`) while lieutenants mount
+  worst-case P-set forgery — fabricating a *maximal* evidence mask
+  (FORGE_P: every particle position claimed present) instead of
+  clearing it, half the time also forging ``v``.
+
+Strategies that need per-trial state (the collude target, the adaptive
+conditioning on received orders) read it from an :class:`AdversaryCtx`
+built once per trial by :func:`adversary_ctx` and threaded into
+``sample_attacks_round`` alongside the round index.
 """
 
 from __future__ import annotations
+
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -60,7 +93,15 @@ def commander_orders(
     # rejection loop (tfg.py:173-175).
     v2 = (v1 + 1 + jax.random.randint(k_2, (), 0, w - 1, dtype=jnp.int32)) % w
     ranks = jnp.arange(2, cfg.n_parties + 1, dtype=jnp.int32)
-    equivocated = jnp.where(ranks <= (cfg.n_parties + 1) // 2, v1, v2)
+    if cfg.strategy == "split":
+        # Split-strategy commander policy: equivocate by rank PARITY —
+        # the maximally interleaved partition, so no contiguous majority
+        # bloc shares an order (vs the reference's midpoint split).  The
+        # v/v1/v2 draws reuse the reference's key discipline so the
+        # commander's decided order distribution is unchanged.
+        equivocated = jnp.where(ranks % 2 == 0, v1, v2)
+    else:
+        equivocated = jnp.where(ranks <= (cfg.n_parties + 1) // 2, v1, v2)
     v_sent = jnp.where(commander_honest, v, equivocated)
     return v_sent, v
 
@@ -74,6 +115,11 @@ def commander_orders(
 # three separate threefry streams were ~6 ms per 1000-trial batch.
 _ATTACK_TAG = 0x0AC7
 _LATE_TAG = 0x17A7E
+# Fresh tags for the zoo strategies' extra draws.  fold_in with a new
+# tag opens an independent counter-mode stream, so the reference
+# strategy (which never folds these) keeps its historical bit-identity.
+_COLLUDE_TAG = 0xC011
+_ADAPT_TAG = 0xADA7
 
 # Effective-edit bitmask: the attacks a receiver actually observes on one
 # delivery.  Disjoint edits, so leaked combinations under
@@ -82,14 +128,34 @@ DROP_BIT = 1  # action 0 with coin 0 (tfg.py:274)
 FORGE_BIT = 2  # action 1: v replaced (tfg.py:277)
 CLEAR_P_BIT = 4  # action 2 (tfg.py:281)
 CLEAR_L_BIT = 8  # action 3 (tfg.py:283)
+FORGE_P_BIT = 16  # strategy="split": fabricate a MAXIMAL presence mask
+
+# The strategy zoo — single source of truth for config validation and
+# the dispatch in sample_attacks_round.
+STRATEGIES = ("reference", "collude", "adaptive", "split")
+
+# Exclusive upper bound of each strategy's forged-order values, as a
+# function of the config.  sample_attacks_round refuses (ValueError, not
+# a silent clamp) any strategy whose forged values could leave [0, w) —
+# the value domain the engines' verdict identities are exact on.  The
+# "adaptive" law is modular in w by construction; the others reuse the
+# reference's [0, nParties+1) range.
+STRATEGY_FORGE_BOUND = {
+    "reference": lambda cfg: cfg.n_parties + 1,
+    "collude": lambda cfg: cfg.n_parties + 1,
+    "adaptive": lambda cfg: cfg.w,
+    "split": lambda cfg: cfg.n_parties + 1,
+}
 
 # tfg.py:272-284 — trail names for the attack edits, shared by every
-# backend that renders protocol events so the trails cannot drift.
+# backend that renders protocol events so the trails cannot drift
+# (asserted equal across jax/local/native in tests/test_event_trail.py).
 EFFECT_NAMES = (
     (DROP_BIT, "drop"),
     (FORGE_BIT, "corrupt-v"),
     (CLEAR_P_BIT, "clear-P"),
     (CLEAR_L_BIT, "clear-L"),
+    (FORGE_P_BIT, "forge-P"),
 )
 
 
@@ -140,8 +206,55 @@ def raw_attack_draws(cfg: QBAConfig, k_round: jax.Array):
     return action, coin, rand_v
 
 
-def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
-    """Draw one round's attack randomness and fold in the attack scope.
+class AdversaryCtx(NamedTuple):
+    """Per-trial adversary state threaded into :func:`sample_attacks_round`.
+
+    Built once per trial (outside the round loop) by
+    :func:`adversary_ctx`; ``None`` stands in for strategies that are
+    stateless across rounds ("reference", "split").
+
+    Attributes:
+      collude_target: int32 scalar — the one shared forged order every
+        colluding traitor writes ("collude").
+      v_sent: int32[n_lieutenants] — the order each lieutenant received
+        from the commander, the conditioning value for "adaptive".
+    """
+
+    collude_target: jax.Array
+    v_sent: jax.Array
+
+
+def adversary_ctx(
+    cfg: QBAConfig, k_rounds: jax.Array, v_sent: jax.Array
+) -> AdversaryCtx | None:
+    """Build the per-trial :class:`AdversaryCtx` for ``cfg.strategy``.
+
+    ``k_rounds`` is the trial's rounds key (the same key the round loop
+    folds round indices into); the collude target opens an independent
+    stream from it via ``_COLLUDE_TAG``, so the per-round attack draws
+    are unperturbed.  Returns ``None`` for stateless strategies — the
+    reference path stays byte-identical because nothing new is drawn.
+    """
+    if cfg.strategy in ("reference", "split"):
+        return None
+    target = jax.random.randint(
+        jax.random.fold_in(k_rounds, _COLLUDE_TAG),
+        (),
+        0,
+        cfg.n_parties + 1,
+        dtype=jnp.int32,
+    )
+    return AdversaryCtx(collude_target=target, v_sent=v_sent)
+
+
+def sample_attacks_round(
+    cfg: QBAConfig,
+    k_round: jax.Array,
+    round_idx: jax.Array | int | None = None,
+    ctx: AdversaryCtx | None = None,
+):
+    """Draw one round's attack randomness under ``cfg.strategy`` and fold
+    in the attack scope.
 
     Returns ``(attack, rand_v, late)``, each
     ``[n_lieutenants * slots, n_lieutenants]`` indexed by
@@ -150,16 +263,17 @@ def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
     slice and no engine ever materializes a transpose:
 
     * ``attack`` — int32 bitmask of the edits this receiver observes
-      (DROP/FORGE/CLEAR_P/CLEAR_L bits above).  Under the default
-      ``attack_scope="delivery"`` at most one bit is set — the raw
-      per-recipient action, applied independently per delivery.  Under
-      ``attack_scope="broadcast"`` the forge/clear bits are the
-      *cumulative leaked state* of the reference's shared-object
-      mutations (``tfg.py:271-284``): ``P.clear()`` / ``L.clear()`` at
-      one recipient persist for every later recipient of the same
-      broadcast, and an action-1 ``v`` reassignment carries forward
-      until the next action-1 draw.  The drop bit never leaks (``sent``
-      resets per recipient, ``tfg.py:270``).
+      (DROP/FORGE/CLEAR_P/CLEAR_L/FORGE_P bits above).  Under the
+      default ``attack_scope="delivery"`` the bits are this delivery's
+      strategy action, applied independently per delivery.  Under
+      ``attack_scope="broadcast"`` (reference strategy only) the
+      forge/clear bits are the *cumulative leaked state* of the
+      reference's shared-object mutations (``tfg.py:271-284``):
+      ``P.clear()`` / ``L.clear()`` at one recipient persist for every
+      later recipient of the same broadcast, and an action-1 ``v``
+      reassignment carries forward until the next action-1 draw.  The
+      drop bit never leaks (``sent`` resets per recipient,
+      ``tfg.py:270``).
     * ``rand_v`` — the forged order accompanying the FORGE bit; under
       broadcast scope, the draw of the *most recent* forging recipient
       in rank order.
@@ -167,18 +281,92 @@ def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
       all-False under ``delivery="sync"`` so sync and racy-with-p_late=0
       runs are bit-identical.
 
-    The leak chain runs along the receiver axis in rank order, skipping
-    the sender's own column (the reference's recipient loop skips self
-    *before* drawing, ``tfg.py:267-269``).  All three protocol backends
-    (jax / local / native) consume exactly these effective arrays, so
-    their randomness matches bit for bit in either scope.
+    ``round_idx`` (the 1-based protocol round) and ``ctx`` (from
+    :func:`adversary_ctx`) are consumed by the strategies that condition
+    on them ("adaptive" needs both, "collude" needs ``ctx``); the
+    reference law ignores them, so existing two-argument callers are
+    unchanged.  All strategies draw the action stream from the same
+    ``_ATTACK_TAG`` fold, so switching strategy never perturbs the rest
+    of the key tree.
+
+    The broadcast leak chain runs along the receiver axis in rank order,
+    skipping the sender's own column (the reference's recipient loop
+    skips self *before* drawing, ``tfg.py:267-269``).  All three
+    protocol backends (jax / local / native) consume exactly these
+    effective arrays, so their randomness matches bit for bit in any
+    scope or strategy.
     """
     shape = (cfg.n_lieutenants * cfg.slots, cfg.n_lieutenants)
+    bound = STRATEGY_FORGE_BOUND[cfg.strategy](cfg)
+    if bound > cfg.w:  # survives -O, unlike assert
+        raise ValueError(
+            f"strategy {cfg.strategy!r} forges orders in [0, {bound}), "
+            f"outside the value domain [0, {cfg.w}) the round engines "
+            "are exact on"
+        )
     action, coin, rand_v = raw_attack_draws(cfg, k_round)
-    drop = (action == 0) & (coin == 0)
-    forge = action == 1
-    clear_p = action == 2
-    clear_l = action == 3
+    forge_p = None
+    if cfg.strategy == "reference":
+        drop = (action == 0) & (coin == 0)
+        forge = action == 1
+        clear_p = action == 2
+        clear_l = action == 3
+    elif cfg.strategy == "collude":
+        # Reference action law; the forged value is the ONE shared
+        # per-trial target — coordinated equivocation.
+        if ctx is None:
+            raise ValueError(
+                "strategy='collude' requires ctx=adversary_ctx(...)"
+            )
+        drop = (action == 0) & (coin == 0)
+        forge = action == 1
+        clear_p = action == 2
+        clear_l = action == 3
+        rand_v = jnp.broadcast_to(
+            ctx.collude_target.astype(jnp.int32), shape
+        )
+    elif cfg.strategy == "adaptive":
+        # Phase-conditioned law from the 3-bit uniform action*2+coin:
+        # early rounds (2*round <= n_rounds) drop half of everything
+        # (reconnaissance), late rounds forge half of everything; the
+        # remaining 4 outcomes are uniform at 1/8 each.
+        if round_idx is None or ctx is None:
+            raise ValueError(
+                "strategy='adaptive' requires round_idx and "
+                "ctx=adversary_ctx(...)"
+            )
+        u3 = action * 2 + coin  # uniform {0..7}
+        late_phase = (
+            2 * jnp.asarray(round_idx, dtype=jnp.int32) > cfg.n_rounds
+        )
+        drop = jnp.where(late_phase, u3 == 4, u3 < 4)
+        forge = jnp.where(late_phase, u3 < 4, u3 == 6)
+        clear_p = jnp.where(late_phase, u3 == 5, u3 == 4)
+        clear_l = jnp.where(late_phase, u3 == 6, u3 == 5)
+        # Forged order = sender's received order + nonzero offset mod w:
+        # never the value the traitor was told, always in [0, w).
+        bits2 = jax.random.bits(
+            jax.random.fold_in(k_round, _ADAPT_TAG), shape, jnp.uint32
+        )
+        offset = (
+            ((bits2 & 0xFFFFFF) % max(cfg.w - 1, 1)).astype(jnp.int32) + 1
+        )
+        senders = jnp.arange(shape[0], dtype=jnp.int32) // cfg.slots
+        v_recv = ctx.v_sent.astype(jnp.int32)[senders][:, None]
+        rand_v = (v_recv + offset) % cfg.w
+    elif cfg.strategy == "split":
+        # Lieutenant policy: worst-case P-set forgery.  action 0 ->
+        # fabricate a maximal presence mask (FORGE_P); action 1 ->
+        # FORGE_P and forge v too; action 2 -> clear L; action 3 ->
+        # drop with the coin (1/8 drop, 1/8 clean).  P is never cleared
+        # — it is always *inflated*.
+        forge_p = (action == 0) | (action == 1)
+        forge = action == 1
+        clear_l = action == 2
+        drop = (action == 3) & (coin == 0)
+        clear_p = jnp.zeros(shape, dtype=bool)
+    else:  # pragma: no cover — config validation owns membership
+        raise ValueError(f"unknown strategy {cfg.strategy!r}")
     if cfg.attack_scope == "broadcast":
         senders = jnp.arange(shape[0], dtype=jnp.int32)[:, None] // cfg.slots
         recv = jnp.arange(cfg.n_lieutenants, dtype=jnp.int32)[None, :]
@@ -204,6 +392,10 @@ def sample_attacks_round(cfg: QBAConfig, k_round: jax.Array):
         + clear_p * CLEAR_P_BIT
         + clear_l * CLEAR_L_BIT
     ).astype(jnp.int32)
+    if forge_p is not None:
+        # Added as a separate term so the reference path's arithmetic —
+        # and hence its jaxpr and outputs — is untouched.
+        attack = attack + (forge_p * FORGE_P_BIT).astype(jnp.int32)
     if cfg.delivery == "racy":
         late = jax.random.bernoulli(
             jax.random.fold_in(k_round, _LATE_TAG), cfg.p_late, shape
@@ -238,6 +430,13 @@ def corrupt_at_delivery(
     # Clear P (tfg.py:281).
     p_mask = jnp.where(
         biz & ((attack & CLEAR_P_BIT) != 0), False, packet.p_mask
+    )
+
+    # Forge P (strategy="split"): fabricate a MAXIMAL presence mask —
+    # every particle position claimed.  Applied after CLEAR_P so forgery
+    # wins if both bits ever compose.
+    p_mask = jnp.where(
+        biz & ((attack & FORGE_P_BIT) != 0), True, p_mask
     )
 
     # Clear L (tfg.py:283).

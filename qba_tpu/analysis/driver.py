@@ -45,6 +45,12 @@ LINT_MATRIX = (
     ("stabilizer", dict(
         n_parties=11, size_l=16, n_dishonest=3, qsim_path="stabilizer",
     )),
+    # split traces the forge-P flag algebra + full-mask MXU identities
+    # that every other strategy statically gates OUT of its jaxpr — the
+    # only matrix point where those dots exist to be interval-checked.
+    ("split-strategy", dict(
+        n_parties=17, size_l=16, n_dishonest=4, strategy="split",
+    )),
 )
 
 ENGINE_CHOICES = (

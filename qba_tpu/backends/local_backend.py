@@ -26,6 +26,8 @@ from qba_tpu.adversary import (
     CLEAR_P_BIT,
     DROP_BIT,
     FORGE_BIT,
+    FORGE_P_BIT,
+    adversary_ctx,
     assign_dishonest,
     commander_orders,
     effect_names,
@@ -59,16 +61,19 @@ def presample_trial(cfg: QBAConfig, key: jax.Array):
     """The message-level backends' shared per-trial randomness: the
     identical key tree every engine consumes (dishonesty, lists,
     commander orders, and the rounds key for the per-cell attack
-    draws).  Returns ``(honest, lists, v_sent, v_comm, k_rounds)`` as
-    host values (numpy / Python ints)."""
+    draws).  Returns ``(honest, lists, v_sent, v_comm, k_rounds, ctx)``
+    as host values (numpy / Python ints) plus the strategy context
+    (:func:`qba_tpu.adversary.adversary_ctx`; None for strategies that
+    need none)."""
     k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
     honest = np.asarray(assign_dishonest(cfg, k_dis))
     lists = np.asarray(generate_lists_for(cfg, k_lists)[0])
     v_sent_arr, v_comm = commander_orders(
         cfg, k_comm, jax.numpy.asarray(bool(honest[1]))
     )
+    ctx = adversary_ctx(cfg, k_rounds, v_sent_arr)
     v_sent = [int(x) for x in np.asarray(v_sent_arr)]
-    return honest, lists, v_sent, int(v_comm), k_rounds
+    return honest, lists, v_sent, int(v_comm), k_rounds, ctx
 
 
 def emit_host_phases(cfg: QBAConfig, log, trial, honest, lists, v_comm,
@@ -121,7 +126,7 @@ def run_trial_local(
     and the final decision summary (``tfg.py:360-363``).  Phase
     summaries are INFO; per-packet events are DEBUG.
     """
-    honest, lists, v_sent, v_comm, k_rounds = presample_trial(cfg, key)
+    honest, lists, v_sent, v_comm, k_rounds, ctx = presample_trial(cfg, key)
 
     n_lieu, w, slots = cfg.n_lieutenants, cfg.w, cfg.slots
     li = [[int(x) for x in lists[i + 2]] for i in range(n_lieu)]
@@ -186,7 +191,8 @@ def run_trial_local(
     for rnd in range(1, cfg.n_rounds + 1):
         k_round = jax.random.fold_in(k_rounds, rnd)
         a_att, a_rv, a_late = (
-            np.asarray(x) for x in sample_attacks_round(cfg, k_round)
+            np.asarray(x)
+            for x in sample_attacks_round(cfg, k_round, rnd, ctx)
         )
         out: list[list] = [[] for _ in range(n_lieu)]
         next_deferred: list[list] = [[] for _ in range(n_lieu)]
@@ -273,6 +279,10 @@ def run_trial_local(
                             p2 = set()
                         if bits & CLEAR_L_BIT:
                             ell2 = set()
+                        if bits & FORGE_P_BIT:
+                            # Worst-case P-set forgery: the fabricated
+                            # all-positions mask wins over clear.
+                            p2 = set(range(cfg.size_l))
                     if late:  # racy_mode == "defer"
                         if log:
                             log.debug(

@@ -428,7 +428,7 @@ def run_trials_mp(
 def _dispatch_trial(cfg, key, procs, pipes, log, trial, timeout) -> dict:
     """Presample one trial, stream the per-party work over the pipes,
     collect and assemble the rank-0 summary."""
-    honest, lists, v_sent, v_comm, k_rounds = presample_trial(cfg, key)
+    honest, lists, v_sent, v_comm, k_rounds, ctx = presample_trial(cfg, key)
     # Per-round effective draws, identical arrays to every other engine.
     attacks = np.stack(
         [
@@ -436,7 +436,7 @@ def _dispatch_trial(cfg, key, procs, pipes, log, trial, timeout) -> dict:
                 [
                     np.asarray(d)
                     for d in sample_attacks_round(
-                        cfg, jax.random.fold_in(k_rounds, r)
+                        cfg, jax.random.fold_in(k_rounds, r), r, ctx
                     )
                 ],
                 axis=-1,

@@ -43,10 +43,12 @@ import numpy as np
 
 _i32p = ctypes.POINTER(ctypes.c_int32)
 
-# Attack-edit bits (qba_tpu.adversary; redeclared to stay jax-free).
-_DROP, _FORGE, _CLEAR_P, _CLEAR_L = 1, 2, 4, 8
+# Attack-edit bits (qba_tpu.adversary; redeclared to stay jax-free —
+# tests/test_event_trail.py asserts the table matches EFFECT_NAMES).
+_DROP, _FORGE, _CLEAR_P, _CLEAR_L, _FORGE_P = 1, 2, 4, 8, 16
 _EFFECTS = ((_DROP, "drop"), (_FORGE, "corrupt-v"),
-            (_CLEAR_P, "clear-P"), (_CLEAR_L, "clear-L"))
+            (_CLEAR_P, "clear-P"), (_CLEAR_L, "clear-L"),
+            (_FORGE_P, "forge-P"))
 
 
 def _effect_names(bits: int) -> str:
@@ -397,6 +399,10 @@ def _run_lieutenant(rank, codec, conns, params, work):
                         p2 = set()
                     if bits & _CLEAR_L:
                         ell2 = set()
+                    if bits & _FORGE_P:
+                        # Worst-case P forgery (strategy="split"):
+                        # fabricated all-positions mask, wins over clear.
+                        p2 = set(range(params["size_l"]))
                 if late:  # racy_mode="defer": next round's drain
                     emit((rnd, 1, me, seq[0]), "round", "late defer",
                          round=rnd, sender=r, recv=rank)

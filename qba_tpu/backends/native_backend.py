@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from qba_tpu.adversary import (
+    adversary_ctx,
     assign_dishonest,
     commander_orders,
     effect_names,
@@ -47,15 +48,18 @@ def _u8(a: np.ndarray):
 
 
 @functools.partial(jax.jit, static_argnums=0)
-def _attack_triples(cfg: QBAConfig, k_rounds: jax.Array) -> jax.Array:
+def _attack_triples(cfg: QBAConfig, k_rounds: jax.Array, ctx=None) -> jax.Array:
     """int32[n_rounds, n_lieu, n_lieu*slots, 3] — the (attack, rand_v,
     late) effective draws for every delivery cell: the same batched
     per-round arrays of :func:`sample_attacks_round` the other two
-    backends consume (bit-exact three-way contract, attack scope folded
-    in).  ``late`` is the racy-delivery loss flag (docs/DIVERGENCES.md
+    backends consume (bit-exact three-way contract, attack scope and
+    strategy folded in — the C engine only ever sees effective edits).
+    ``late`` is the racy-delivery loss flag (docs/DIVERGENCES.md
     D1), all-zero under ``delivery="sync"``."""
     def one_round(r):
-        draws = sample_attacks_round(cfg, jax.random.fold_in(k_rounds, r))
+        draws = sample_attacks_round(
+            cfg, jax.random.fold_in(k_rounds, r), r, ctx
+        )
         # Draws are packet-major [n_pk, n_lieu]; the C ABI keeps the
         # (receiver, cell) order, so transpose host-side (cheap, CPU jit).
         return jnp.stack([d.astype(jnp.int32).T for d in draws], axis=-1)
@@ -206,7 +210,11 @@ def _batch_presample(cfg: QBAConfig, keys: jax.Array):
         honest = assign_dishonest(cfg, k_dis)
         lists = generate_lists_for(cfg, k_lists)[0]
         v_sent, v_comm = commander_orders(cfg, k_comm, honest[1])
-        return honest, lists, v_sent, v_comm, _attack_triples(cfg, k_rounds)
+        ctx = adversary_ctx(cfg, k_rounds, v_sent)
+        return (
+            honest, lists, v_sent, v_comm,
+            _attack_triples(cfg, k_rounds, ctx),
+        )
 
     return jax.vmap(one)(keys)
 

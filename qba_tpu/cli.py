@@ -95,6 +95,26 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
         "(tfg.py:271-284, docs/DIVERGENCES.md D3)",
     )
     p.add_argument(
+        "--strategy",
+        choices=("reference", "collude", "adaptive", "split"),
+        default="reference",
+        help="Byzantine strategy family (docs/ARCHITECTURE.md adversary "
+        "zoo): reference = the paper's independent random 4-action "
+        "attack; collude = traitors forge one shared per-trial target; "
+        "adaptive = action law conditions on round phase and received "
+        "value; split = commander equivocation + worst-case P-set "
+        "forgery.  All strategies run bit-identically on every engine",
+    )
+    p.add_argument(
+        "--p-depolarize", type=float, default=0.0,
+        help="per-qubit depolarizing probability before measurement "
+        "(imperfect quantum resources; qba_tpu/qsim/noise.py)",
+    )
+    p.add_argument(
+        "--p-measure-flip", type=float, default=0.0,
+        help="per-qubit classical readout flip probability",
+    )
+    p.add_argument(
         "--collect-counters", action="store_true",
         help="emit on-device protocol counters (rounds-to-acceptance, "
         "per-value accept counts, slot high-water mark) as an auxiliary "
@@ -117,6 +137,9 @@ def _config(args: argparse.Namespace, trials: int | None = None) -> QBAConfig:
         p_late=args.p_late,
         racy_mode=args.racy_mode,
         attack_scope=args.attack_scope,
+        strategy=args.strategy,
+        p_depolarize=args.p_depolarize,
+        p_measure_flip=args.p_measure_flip,
         collect_counters=args.collect_counters,
     )
 
@@ -181,12 +204,16 @@ def _parser() -> argparse.ArgumentParser:
     _add_config_args(bench, trials_default=256)
     bench.add_argument("--reps", type=int, default=3)
     bench.add_argument(
-        "--scenario", choices=("rounds", "resource_gen"), default="rounds",
+        "--scenario",
+        choices=("rounds", "resource_gen", "adversary_sweep"),
+        default="rounds",
         help="rounds = full protocol Monte-Carlo (rounds/s headline); "
         "resource_gen = list generation only through the qsim dispatch "
         "(shots/s over trials x size_l, with sampler attribution — "
         "combine with --qsim-path stabilizer for the batched GF(2) "
-        "engine)",
+        "engine); adversary_sweep = the (strategy x noise) surface at "
+        "the given size_l through qba_tpu.sweep.run_surface, one "
+        "kernel_plan-attributed JSON row per cell",
     )
     bench.add_argument("--profile-dir", default=None)
     bench.add_argument(
@@ -312,6 +339,23 @@ def _parser() -> argparse.ArgumentParser:
     serve.add_argument(
         "--poll-s", type=float, default=0.05,
         help="file-queue inbox poll interval in seconds",
+    )
+    serve.add_argument(
+        "--reclaim-timeout-s", type=float, default=None,
+        help="file-queue crash recovery: claims older than this with no "
+        "result are pushed back to the inbox (exponential backoff per "
+        "retry; docs/SERVING.md); default: no reclaim",
+    )
+    serve.add_argument(
+        "--max-reclaims", type=int, default=3,
+        help="reclaim attempts per request file before dead-lettering "
+        "it to <queue-dir>/dead with an error result",
+    )
+    serve.add_argument(
+        "--deadline-s", type=float, default=None,
+        help="per-request wall-clock deadline: an overdue request gets "
+        "a structured error result (with manifest) instead of wedging "
+        "the stream; requests can override via their deadline_s field",
     )
     serve.add_argument(
         "--cache-stats", action="store_true",
@@ -508,6 +552,8 @@ def _cmd_bench(args: argparse.Namespace, out) -> int:
     with _telemetry(args, cfg, "bench") as session:
         if args.scenario == "resource_gen":
             return _bench_resource_gen(args, cfg, session, out)
+        if args.scenario == "adversary_sweep":
+            return _bench_adversary_sweep(args, cfg, out)
         return _bench_impl(args, cfg, chunk_trials, session, out)
 
 
@@ -602,6 +648,64 @@ def _bench_impl(
                 "manifest": manifest,
             },
             default=str,
+        ),
+        file=out,
+    )
+    return 0
+
+
+def _bench_adversary_sweep(args: argparse.Namespace, cfg: QBAConfig, out) -> int:
+    """The (strategy × noise) surface at the CLI config's size_l — one
+    JSON row per cell, each carrying the cell's own kernel-plan
+    attribution (strategy changes the traced round program: forge-P is
+    statically gated into the split-strategy kernels only)."""
+    import json
+    import time
+
+    from qba_tpu.adversary import STRATEGIES
+    from qba_tpu.benchmark import engine_description, kernel_plan
+    from qba_tpu.sweep import run_surface
+
+    noise_points = [(0.0, 0.0)]
+    if args.p_depolarize > 0.0 or args.p_measure_flip > 0.0:
+        noise_points.append((args.p_depolarize, args.p_measure_flip))
+    t0 = time.time()
+    cells = run_surface(
+        cfg,
+        strategies=STRATEGIES,
+        noise_points=noise_points,
+        size_ls=[cfg.size_l],
+        n_chunks=1,
+        chunk_trials=cfg.trials,
+    )
+    for cell in cells:
+        cfg_cell = cell.result.cfg
+        print(
+            json.dumps(
+                {
+                    "metric": "adversary_surface_cell",
+                    "strategy": cell.strategy,
+                    "p_depolarize": cell.p_depolarize,
+                    "p_measure_flip": cell.p_measure_flip,
+                    "size_l": cell.size_l,
+                    "trials": cell.result.n_trials,
+                    "success_rate": round(cell.result.success_rate, 4),
+                    "overflow": cell.result.any_overflow,
+                    "engine": engine_description(cfg_cell),
+                    "kernel_plan": kernel_plan(cfg_cell),
+                    "manifest": cell.manifest,
+                },
+                default=str,
+            ),
+            file=out,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "adversary_surface",
+                "cells": len(cells),
+                "seconds": round(time.time() - t0, 2),
+            }
         ),
         file=out,
     )
@@ -849,6 +953,7 @@ def _cmd_serve(args: argparse.Namespace, out) -> int:
         telemetry_dir=args.telemetry,
         cache_dir=args.cache_dir,
         warm_start=not args.no_warm_start,
+        deadline_s=args.deadline_s,
     )
     if args.transport == "file-queue":
         if not args.queue_dir:
@@ -860,6 +965,8 @@ def _cmd_serve(args: argparse.Namespace, out) -> int:
             args.queue_dir,
             poll_s=args.poll_s,
             max_requests=args.max_requests,
+            reclaim_timeout_s=args.reclaim_timeout_s,
+            max_reclaims=args.max_reclaims,
         )
     else:
         stats = serve_jsonl(

@@ -91,7 +91,28 @@ class QBAConfig:
         mutation semantics (``tfg.py:271-284``): ``P.clear()`` /
         ``L.clear()`` at one recipient of a broadcast leak into every
         later recipient, and a forged ``v`` persists until re-forged.
-        See docs/DIVERGENCES.md D3.
+        Only meaningful for ``strategy="reference"`` (the leak chain
+        models the reference's mutation accident; the zoo strategies
+        define per-delivery laws).  See docs/DIVERGENCES.md D3.
+      strategy: adversary strategy (the zoo,
+        :mod:`qba_tpu.adversary.model`): "reference" (default — the
+        reference's random 4-action attack, bit-identical to historical
+        outputs), "collude" (all traitors forge one shared per-trial
+        target value), "adaptive" (drop-heavy reconnaissance in early
+        rounds, forge-heavy in late rounds, forged values conditioned
+        on the value the sender received), or "split" (commander
+        parity-equivocation + lieutenant worst-case P-set forgery that
+        fabricates maximal evidence masks).  Every strategy is
+        expressed as the same effective-edit arrays from
+        :func:`~qba_tpu.adversary.sample_attacks_round`, so all round
+        engines/backends consume it unchanged and bit-identically.
+      p_depolarize: per-qubit depolarizing probability applied to the
+        quantum resource state before measurement (uniform X/Y/Z Pauli
+        with probability ``p``; keeps the stabilizer tableau Clifford).
+        0.0 (default) leaves every qsim path byte-identical to the
+        noiseless sampler.
+      p_measure_flip: classical per-bit measurement flip probability
+        applied to every measured qubit.  0.0 (default) = noiseless.
       racy_mode: under ``delivery="racy"``: "loss" (default) — a late
         packet is silently lost, the *effect* of the reference's barrier
         race; or "defer" — the *mechanism*: the packet is delivered in
@@ -133,6 +154,9 @@ class QBAConfig:
     p_late: float = 0.0
     round_engine: str = "auto"
     attack_scope: str = "delivery"
+    strategy: str = "reference"
+    p_depolarize: float = 0.0
+    p_measure_flip: float = 0.0
     racy_mode: str = "loss"
     tiled_block: int | None = None
     trial_pack: int | None = None
@@ -196,6 +220,30 @@ class QBAConfig:
             )
         if self.attack_scope not in ("delivery", "broadcast"):
             raise ValueError(f"unknown attack_scope {self.attack_scope!r}")
+        # Strategy-zoo membership is validated against the single source
+        # of truth in qba_tpu.adversary.model (imported lazily: config is
+        # imported by the adversary module).
+        from qba_tpu.adversary.model import STRATEGIES
+
+        if self.strategy not in STRATEGIES:
+            raise ValueError(
+                f"unknown strategy {self.strategy!r}; "
+                f"expected one of {sorted(STRATEGIES)}"
+            )
+        if self.attack_scope == "broadcast" and self.strategy != "reference":
+            raise ValueError(
+                "attack_scope='broadcast' models the reference's "
+                "shared-object mutation accident and is only defined for "
+                f"strategy='reference'; got strategy={self.strategy!r}"
+            )
+        if not 0.0 <= self.p_depolarize <= 1.0:
+            raise ValueError(
+                f"p_depolarize must be in [0, 1]; got {self.p_depolarize}"
+            )
+        if not 0.0 <= self.p_measure_flip <= 1.0:
+            raise ValueError(
+                f"p_measure_flip must be in [0, 1]; got {self.p_measure_flip}"
+            )
         if self.racy_mode not in ("loss", "defer"):
             raise ValueError(f"unknown racy_mode {self.racy_mode!r}")
         if self.racy_mode == "defer" and self.delivery != "racy":

@@ -135,7 +135,11 @@ def build_gf2_sample_core(n: int, ops, n_params: int):
     lt = jnp.asarray(prog.l.T, jnp.int32)               # [P, 2n]
     rows2n = jnp.arange(2 * n, dtype=jnp.int32)
 
-    def sample(rnds: jnp.ndarray, params: jnp.ndarray | None = None):
+    def sample(
+        rnds: jnp.ndarray,
+        params: jnp.ndarray | None = None,
+        phase_noise: jnp.ndarray | None = None,
+    ):
         b = rnds.shape[0]
         rnds = rnds.astype(jnp.int32) & 1
         if params is not None and n_params > 0:
@@ -145,6 +149,12 @@ def build_gf2_sample_core(n: int, ops, n_params: int):
             r = r0[None, :] ^ phase
         else:
             r = jnp.broadcast_to(r0[None, :], (b, 2 * n))
+        if phase_noise is not None:
+            # Depolarizing channel as a phase-only edit (the drawn Pauli
+            # conjugates the evolved tableau — see qsim/noise.py): the
+            # caller supplies [B, 2n] parities, precomputed against the
+            # compiled rows, keeping this core PRNG-free for lint.
+            r = r ^ phase_noise
         xw = jnp.broadcast_to(x0w[None], (b, 2 * n, x0w.shape[-1]))
         zw = jnp.broadcast_to(z0w[None], (b, 2 * n, z0w.shape[-1]))
 
@@ -208,15 +218,33 @@ def _draw_coins(keys: jax.Array, n: int) -> jnp.ndarray:
     return (bits & 1).astype(jnp.int32)
 
 
-def build_gf2_tableau_run_batch(n: int, ops, n_params: int):
+def build_gf2_tableau_run_batch(
+    n: int,
+    ops,
+    n_params: int,
+    p_depolarize: float = 0.0,
+    p_measure_flip: float = 0.0,
+):
     """``run_batch(keys[B], params=None) -> int32 bits[B, n]``.
 
     ``keys`` is a batch of PRNG keys (one per shot/list position);
     ``params`` is ``None``, a shared ``[P]`` vector, or a per-shot
     ``[B, P]`` matrix.  This is the entry ``generate_lists_stabilizer``
     feeds per-position meas keys and per-position permutation bits.
+
+    Nonzero noise draws the per-shot channels of
+    :func:`qba_tpu.qsim.noise.noise_draws` from each shot's own key —
+    the same draw the per-shot tableau engine makes, so the two
+    stabilizer engines stay bit-identical under noise.  The Pauli lands
+    as a batched phase parity against the compiled rows (two GF(2)
+    matmuls), keeping the traced core Clifford-only and PRNG-free.
     """
     core = build_gf2_sample_core(n, ops, n_params)
+    noisy = p_depolarize > 0.0 or p_measure_flip > 0.0
+    if noisy:
+        prog = compile_symplectic(n, ops, n_params)
+        zt = jnp.asarray(prog.z.T, jnp.int32)  # [n, 2n]
+        xt = jnp.asarray(prog.x.T, jnp.int32)
 
     def run_batch(keys: jax.Array, params: jnp.ndarray | None = None):
         rnds = _draw_coins(keys, n)
@@ -224,19 +252,38 @@ def build_gf2_tableau_run_batch(n: int, ops, n_params: int):
             params = jnp.broadcast_to(
                 params[None, :], (rnds.shape[0], params.shape[0])
             )
-        return core(rnds, params)
+        if not noisy:
+            return core(rnds, params)
+        from qba_tpu.qsim.noise import noise_draws
+
+        bx, bz, mflip = jax.vmap(
+            lambda k: noise_draws(k, n, p_depolarize, p_measure_flip)
+        )(keys)
+        # Per-row phase parity of the drawn Pauli against the evolved
+        # tableau: rows are shared across the batch (params only touch
+        # phases), so  r ^= bx . z_row ^ bz . x_row  batches as matmuls.
+        phase_noise = gf2_matmul(bx, zt) ^ gf2_matmul(bz, xt)  # [B, 2n]
+        return core(rnds, params, phase_noise=phase_noise) ^ mflip
 
     return run_batch
 
 
-def build_gf2_tableau_run_shots(n: int, ops, n_params: int):
+def build_gf2_tableau_run_shots(
+    n: int,
+    ops,
+    n_params: int,
+    p_depolarize: float = 0.0,
+    p_measure_flip: float = 0.0,
+):
     """``run(key, shots, params=None) -> int32 bits[shots, n]`` — the
     :meth:`Circuit.compile_shots` contract on the batched GF(2) engine,
     key-tree-identical to the per-shot reference
     (:func:`qba_tpu.qsim.stabilizer.build_tableau_run_shots`): the key
     splits into ``shots`` subkeys and each shot's coins come from its
     own subkey."""
-    run_batch = build_gf2_tableau_run_batch(n, ops, n_params)
+    run_batch = build_gf2_tableau_run_batch(
+        n, ops, n_params, p_depolarize, p_measure_flip
+    )
 
     def run(
         key: jax.Array, shots: int, params: jnp.ndarray | None = None

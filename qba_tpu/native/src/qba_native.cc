@@ -165,9 +165,11 @@ int qba_decode_pvl(const int32_t* buf, int len, int32_t* p_out, int np_cap,
 //              (round-1, receiver, sender*slots+slot) triples
 //              (attack, rand_v, late): the sample_attacks_round layout.
 //              `attack` is the effective edit bitmask (bit0 drop, bit1
-//              forge-v, bit2 clear-P, bit3 clear-L) with the configured
-//              attack scope already folded in, so this engine is
-//              scope-agnostic; `late` = 1 -> the delivery is silently
+//              forge-v, bit2 clear-P, bit3 clear-L, bit4 forge-P: the
+//              fabricated all-positions evidence mask, applied after the
+//              clears so forgery wins) with the configured attack scope
+//              and strategy already folded in, so this engine is
+//              scope- and strategy-agnostic; `late` = 1 -> the delivery is silently
 //              late: under racy_defer=0 the delivery is silently lost
 //              before any corruption; under racy_defer=1 the corrupted
 //              packet is instead delivered at the start of the NEXT
@@ -347,6 +349,10 @@ int qba_run_trial(int n_parties, int size_l, int n_dishonest, int32_t w,
             if (a[0] & 2) pk.v = a[1];    // forged v
             if (a[0] & 4) pk.p.clear();   // clear P
             if (a[0] & 8) pk.L.clear();   // clear L
+            if (a[0] & 16) {              // forge-P: full mask wins
+              pk.p.resize(size_l);
+              for (int32_t k = 0; k < size_l; ++k) pk.p[k] = k;
+            }
           }
           if (a[2]) {  // racy_defer: queue for the next round's drain
             trace(10, rnd, sender + 2, recv + 2, 0, 0, 0);

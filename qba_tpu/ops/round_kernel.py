@@ -42,6 +42,7 @@ from qba_tpu.adversary import (
     CLEAR_P_BIT,
     DROP_BIT,
     FORGE_BIT,
+    FORGE_P_BIT,
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
@@ -284,6 +285,14 @@ def build_round_step(
         v2_all = jnp.where(biz & ((act_all & FORGE_BIT) != 0), rv_all, v_in)
         clearp_all = biz & ((act_all & CLEAR_P_BIT) != 0)
         clearl_all = biz & ((act_all & CLEAR_L_BIT) != 0)
+        # Forge-P (strategy="split" only — statically gated so every
+        # other strategy's traced kernel, and the reference bit-identity
+        # pin, are byte-for-byte unchanged).
+        forgep_all = (
+            biz & ((act_all & FORGE_P_BIT) != 0)
+            if cfg.strategy == "split"
+            else None
+        )
         delivered_all = (
             ~dropped_all & (late_all == 0) & sent & (sender_col != lane_recv)
         )
@@ -308,6 +317,7 @@ def build_round_step(
             ok_g, dup_g, own_len_g = va.group(
                 gi, v2_all[:, sl], clearp_all[:, sl], clearl_all[:, sl],
                 count_eff_all[:, sl], delivered_all[:, sl],
+                None if forgep_all is None else forgep_all[:, sl],
             )
             # int32 before slicing/concatenating (Mosaic rejects i1
             # tpu.concatenate); tail-group overlap keeps only the not-
@@ -420,6 +430,10 @@ def build_round_step(
             precision=_exact_prec(gdt),
         ).astype(jnp.int32)
         p2_g = (pin_g != 0) & (clrp_g == 0)
+        if forgep_all is not None:
+            # Forged-full P survives the rebuild: the rebroadcast packet
+            # carries the fabricated all-True mask (forgery wins).
+            p2_g = (gsel(forgep_all.astype(jnp.int32)) != 0) | p2_g
         own_g = jnp.where(p2_g, li_exp, SENTINEL)
 
         iota_l = jax.lax.broadcasted_iota(jnp.int32, (n_c, max_l), 1)

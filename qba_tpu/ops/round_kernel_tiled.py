@@ -64,6 +64,7 @@ from qba_tpu.adversary import (
     CLEAR_P_BIT,
     DROP_BIT,
     FORGE_BIT,
+    FORGE_P_BIT,
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
@@ -141,6 +142,7 @@ def _verdict_block_accepts(
     rv_t,
     late_t,
     tables,
+    use_fp: bool = False,
 ):
     """The acceptance-verdict algebra for ONE packet block, as a pure
     value-level function: ``(acc [blk, n_rv] i32, new_vi [n_rv, w] i32)``
@@ -212,6 +214,12 @@ def _verdict_block_accepts(
                        rv_all, v_col)
     clearp_all = biz & ((act_all & CLEAR_P_BIT) != 0)
     clearl_all = biz & ((act_all & CLEAR_L_BIT) != 0)
+    # forge-P (strategy="split" only): statically gated so every other
+    # strategy's jaxpr — and the reference bit-identity pin — is
+    # untouched.
+    forgep_all = (
+        biz & ((act_all & FORGE_P_BIT) != 0) if use_fp else None
+    )
     delivered_all = (
         ~dropped_all & (late_all == 0) & sent
         & (sender_col != lane_recv)
@@ -229,7 +237,7 @@ def _verdict_block_accepts(
         )
         ok_all = ar.flags(
             v2_all, clearp_all, clearl_all, count_eff_all,
-            delivered_all,
+            delivered_all, forgep_all,
         )
         return accept_first_per_value_all(
             ok_all, v2_all, vi, idx_col, blk, n_rv, w
@@ -262,6 +270,7 @@ def _verdict_block_accepts(
                 gi, v2_all[:, sl], clearp_all[:, sl],
                 clearl_all[:, sl], count_eff_all[:, sl],
                 delivered_all[:, sl],
+                None if forgep_all is None else forgep_all[:, sl],
             )
             # int32 before slicing/concatenating: Mosaic rejects i1
             # tpu.concatenate and i1 lane relayouts.
@@ -293,6 +302,7 @@ def _verdict_block_accepts(
             gi, v2_all[:, sl], clearp_all[:, sl],
             clearl_all[:, sl], count_eff_all[:, sl],
             delivered_all[:, sl],
+            None if forgep_all is None else forgep_all[:, sl],
         )
         if grp > 1 and grp * w <= 512:
             # Group-batched dedup: one [blk, grp*w]-lane pass for the
@@ -504,6 +514,7 @@ def build_verdict_kernel(
                 honest_col=honest_ref[:],
                 att_t=act_ref[:], rv_t=rv_ref[:], late_t=late_ref[:],
                 tables=tables,
+                use_fp=cfg.strategy == "split",
             )
             ovi_ref[:] = new_vi
             acc_ref[:] = acc
@@ -756,6 +767,11 @@ def rebuild_pool(cfg: QBAConfig, round_idx, pool, li, acc,
     clear_l = biz & ((attack_pool & CLEAR_L_BIT) != 0)
     v2 = jnp.where(biz & ((attack_pool & FORGE_BIT) != 0),
                    rand_v_pool, v)
+    # forge-P (strategy="split"): statically gated, None elsewhere.
+    forge_p = (
+        biz & ((attack_pool & FORGE_P_BIT) != 0)
+        if cfg.strategy == "split" else None
+    )
 
     rebroadcast = (acc != 0) & (round_idx <= cfg.n_dishonest)
     # Per-receiver slot index (draw identity for the next round) and the
@@ -815,6 +831,10 @@ def rebuild_pool(cfg: QBAConfig, round_idx, pool, li, acc,
     # The keep/append row algebra — identical to the monolithic kernel's
     # tail (lieu_receive's L.add of the own sub-list, tfg.py:291).
     p2 = (p_g != 0) & ~clearp_c
+    if forge_p is not None:
+        # Forged-full P survives the rebuild (forgery wins over clear);
+        # own_len = sum(p2) then yields size_l automatically.
+        p2 = forge_p[srcc, recv_c][:, None] | p2
     own = jnp.where(p2, li_c, SENTINEL)
     own_len = jnp.sum(p2.astype(jnp.int32), axis=1, keepdims=True)
     cnt_eff = jnp.where(clearl_c, 0, cnt_g)
@@ -1091,6 +1111,10 @@ def build_rebuild_kernel(
             # Keep/append row algebra — mirrors rebuild_pool /
             # lieu_receive's L.add (tfg.py:291).
             p2 = (p_g != 0) & ~clearp_c
+            if cfg.strategy == "split":
+                # forge-P: the fabricated all-True mask survives the
+                # rebuild (statically gated; see rebuild_pool).
+                p2 = (biz & ((att_c & FORGE_P_BIT) != 0)) | p2
             own = jnp.where(p2, li_row, SENTINEL)
             own_len = jnp.sum(jnp.where(p2, 1, 0), axis=1, keepdims=True)
             cnt_eff = jnp.where(clearl_c, 0, cnt_g)
@@ -1430,6 +1454,7 @@ def build_fused_round_kernel(
                             att_t=T(att_ref, t), rv_t=T(rv_ref, t),
                             late_t=T(late_ref, t),
                             tables=tables_t,
+                            use_fp=cfg.strategy == "split",
                         )
                         if packed:
                             acc_scr[t, sl] = acc
@@ -1625,6 +1650,9 @@ def build_fused_round_kernel(
 
                 # Keep/append row algebra — mirrors rebuild_pool.
                 p2 = (p_g != 0) & ~clearp_c
+                if cfg.strategy == "split":
+                    # forge-P: statically gated (see rebuild_pool).
+                    p2 = (biz & ((att_c & FORGE_P_BIT) != 0)) | p2
                 own = jnp.where(p2, li_row, SENTINEL)
                 own_len = jnp.sum(
                     jnp.where(p2, 1, 0), axis=1, keepdims=True

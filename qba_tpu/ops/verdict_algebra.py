@@ -176,13 +176,16 @@ class VerdictAlgebra:
         return (jnp.right_shift(sel, q_lanes & 31) & 1) != 0
 
     def group(self, gi, v2_g, clearp_g, clearl_g, count_eff_g,
-              delivered_g):
+              delivered_g, forgep_g=None):
         """One receiver group's verdicts (group ``gi`` = the ``gi``-th
         contiguous receiver slice): returns ``(ok_g, dup_g,
         own_len_g)``, each ``[n_p, grp]`` (``own_len_g`` int32); the
         arguments are the group's per-receiver columns ``[n_p, grp]``
         (post-corruption order value, clear-P/clear-L flags, effective
-        evidence count, delivery mask).
+        evidence count, delivery mask, and — under strategy="split" —
+        the forge-P flag: the packet arrives claiming a MAXIMAL
+        presence mask, so the effective P is all-True regardless of the
+        raw mask; forgery wins over clear-P).
 
         Mirrors ``consistent_after_append``'s decomposition, including
         the round-3 ``appended`` fullness guard (reducible to ``~dup``
@@ -192,6 +195,10 @@ class VerdictAlgebra:
         v2_lanes = self.expand(v2_g).astype(jnp.int32)
         clearp_lanes = self.expand(clearp_g) != 0
         p2_lanes = self.p_tile & ~clearp_lanes
+        if forgep_g is not None:
+            # Every downstream term (own row, dup identity, own_len,
+            # bad_own, own collision) flows from the effective mask.
+            p2_lanes = (self.expand(forgep_g) != 0) | p2_lanes
         li_row = self.lip_vals[gi : gi + 1, :]
         li_bc = jnp.broadcast_to(li_row, (n_p, self.seg_l))
         own_lanes = jnp.where(p2_lanes, li_bc, SENTINEL)
@@ -372,21 +379,40 @@ class AllReceiverVerdict:
         ) != 0
 
     def flags(self, v2_all, clearp_all, clearl_all, count_eff_all,
-              delivered_all):
+              delivered_all, forgep_all=None):
         """All receivers' verdicts in one pass: returns ``ok_all``
         ``[n_p, n_rv]`` bool — the batched equivalent of running
-        :meth:`VerdictAlgebra.group` over every lane group."""
+        :meth:`VerdictAlgebra.group` over every lane group.
+
+        ``forgep_all`` (strategy="split" only; ``None`` keeps the
+        historical path untouched) marks deliveries whose P mask is
+        FORGED to all-True.  The P-factored MXU identities blend in
+        their full-mask counterparts — which are receiver-table column
+        sums or one extra unmasked contraction, not new per-group
+        loops — selected per (packet, receiver) by the flag."""
         n_p, n_rv, max_l = self.n_p, self.n_rv, self.max_l
         size_l, w = self.size_l, self.w
         notcp = jnp.where(clearp_all, 0.0, 1.0)  # (1 - cp) [n_p, n_rv]
+        fp = (
+            None if forgep_all is None
+            else jnp.where(forgep_all, 1.0, 0.0)  # [n_p, n_rv]
+        )
 
         # ---- dup: evidence row == own row, via the integer identity.
         # own = p2*(li+1) - 1; mism_r = ssq_v - 2*cross + ssq_own with
         #   cross  = (1-cp) * [p*v]@(li+1) - sum_v
         #   ssq_own = (1-cp) * [p]@(li^2-1) + size_l
-        # (rounds/engine.py's MXU dup form, here per block).
+        # (rounds/engine.py's MXU dup form, here per block).  Under
+        # forge-P the effective mask is all-True: the masked
+        # contractions are replaced by their full-mask forms
+        # (column sums of t_li2; one unmasked vals @ t_li1 per row).
         m2 = self._mm(self.p_f32, self.t_li2)  # [n_p, n_rv]
         ssq_own = notcp * m2 + float(size_l)
+        if fp is not None:
+            m2_full = jnp.sum(self.t_li2, axis=0, keepdims=True)
+            ssq_own = (
+                fp * (m2_full + float(size_l)) + (1.0 - fp) * ssq_own
+            )
         dup_all = jnp.zeros((n_p, n_rv), jnp.bool_)
         for r in range(max_l):
             pv = jnp.where(self.p_b, self.vals[r], 0).astype(jnp.float32)
@@ -396,12 +422,21 @@ class AllReceiverVerdict:
                 self.vals[r] * self.vals[r], axis=1, keepdims=True
             )
             cross = notcp * m1 - s_v.astype(jnp.float32)
+            if fp is not None:
+                m1_full = self._mm(
+                    self.vals[r].astype(jnp.float32), self.t_li1
+                )
+                cross = (
+                    fp * (m1_full - s_v.astype(jnp.float32))
+                    + (1.0 - fp) * cross
+                )
             mism = ssq_v.astype(jnp.float32) - 2.0 * cross + ssq_own
             dup_all |= self.valid[r] & (mism == 0.0)
         dup_all &= ~clearl_all
-        own_len_all = (
-            notcp * jnp.sum(self.p_f32, axis=1, keepdims=True)
-        ).astype(jnp.int32)
+        own_len_f = notcp * jnp.sum(self.p_f32, axis=1, keepdims=True)
+        if fp is not None:
+            own_len_f = fp * float(size_l) + (1.0 - fp) * own_len_f
+        own_len_all = own_len_f.astype(jnp.int32)
 
         # ---- contains: v2 present anywhere in a valid row (bit select
         # on the position-folded planes).
@@ -415,6 +450,7 @@ class AllReceiverVerdict:
         # evidence there.  PB[(q, pos)] = P & bit q of the presence
         # plane at pos; contract against the per-receiver li one-hot.
         pb_planes = []
+        bit_planes = []  # un-P-masked — the forge-P full-mask variant
         for p_i in range(self.n_planes):
             reps = min(32, w - 32 * p_i)  # only q < w has Lh2 rows
             # Concatenate int32 vectors only — tpu.concatenate on i1
@@ -429,6 +465,8 @@ class AllReceiverVerdict:
             )
             bits_i = jnp.right_shift(tiled, q_in_tile) & 1  # 0/1 int32
             pb_planes.append(bits_i & p_rep)
+            if fp is not None:
+                bit_planes.append(bits_i)
         pb_i = (
             jnp.concatenate(pb_planes, axis=1)
             if len(pb_planes) > 1 else pb_planes[0]
@@ -440,6 +478,22 @@ class AllReceiverVerdict:
             preferred_element_type=jnp.float32,
         )
         own_coll_all = (notcp * own_coll_cnt) > 0.0
+        if fp is not None:
+            bits_all_i = (
+                jnp.concatenate(bit_planes, axis=1)
+                if len(bit_planes) > 1 else bit_planes[0]
+            )
+            pb_full = jnp.where(bits_all_i != 0, 1.0, 0.0).astype(
+                self.gdt
+            )
+            own_coll_full = jax.lax.dot_general(
+                pb_full, self.t_lh2.astype(self.gdt),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            own_coll_all = jnp.where(
+                forgep_all, own_coll_full > 0.0, own_coll_all
+            )
 
         # ---- bad_own: a P position whose li equals v2 or is oob.
         oob_cnt = self._mm(self.p_f32, self.t_oob)
@@ -484,6 +538,33 @@ class AllReceiverVerdict:
         ]
         li_eq_v2 = self._select_bit(half_cols, v2_all, 16)
         bad_own_all = ~clearp_all & ((oob_cnt > 0.0) | li_eq_v2)
+        if fp is not None:
+            # Full-mask bad_own: every own-list position is claimed, so
+            # "some P position has li == v2 / li oob" degenerates to
+            # per-receiver column sums of the tables — no new matmul.
+            # Presence of value q anywhere in receiver r's list is the
+            # column sum of t_lh (positions with li == q), bit-packed by
+            # constant shifts into the same 16-bit plane select.
+            oob_full = jnp.sum(self.t_oob, axis=0, keepdims=True)
+            cq_full = jnp.sum(
+                self.t_lh.astype(jnp.float32), axis=0, keepdims=True
+            )  # [1, w * n_rv], q-major
+            pres_full = jnp.where(cq_full > 0.0, 1, 0)  # int32
+            full_planes = []
+            for j in range(n_half):
+                acc = jnp.zeros((1, n_rv), jnp.int32)
+                for qq in range(min(16, self.w - 16 * j)):
+                    q = 16 * j + qq
+                    acc = acc | jnp.left_shift(
+                        pres_full[:, q * n_rv : (q + 1) * n_rv], qq
+                    )
+                full_planes.append(jnp.broadcast_to(acc, (n_p, n_rv)))
+            li_eq_v2_full = self._select_bit(full_planes, v2_all, 16)
+            bad_own_all = jnp.where(
+                forgep_all,
+                (oob_full > 0.0) | li_eq_v2_full,
+                bad_own_all,
+            )
 
         # ---- the shared condition algebra (consistent_after_append).
         appended_all = ~dup_all & (count_eff_all < max_l)

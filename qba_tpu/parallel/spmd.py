@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from qba_tpu.adversary import sample_attacks_round
+from qba_tpu.adversary import adversary_ctx, sample_attacks_round
 from qba_tpu.backends.jax_backend import MonteCarloResult, aggregate, trial_keys
 from qba_tpu.config import QBAConfig
 from qba_tpu.diagnostics import QBADemotionWarning, warn_and_record
@@ -96,6 +96,10 @@ def _trial_party_sharded(
     """
     n_local = cfg.n_lieutenants // n_tp
     honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = setup_trial(cfg, key)
+    # Strategy context (collude target / adaptive v_sent); replicated
+    # per device like the rest of setup — same key, same values, so the
+    # spmd draws stay bit-identical to the single-device engines.
+    ctx = adversary_ctx(cfg, k_rounds, v_sent)
 
     # This device's block of lieutenants.
     start = jax.lax.axis_index("tp") * n_local
@@ -150,7 +154,7 @@ def _trial_party_sharded(
                 for i, x in enumerate(packed_local)
             )
             k_round = jax.random.fold_in(k_rounds, round_idx)
-            draws = sample_attacks_round(cfg, k_round)
+            draws = sample_attacks_round(cfg, k_round, round_idx, ctx)
             att, rv, late = (
                 jax.lax.dynamic_slice_in_dim(d, start, n_local, 1)
                 for d in draws
@@ -224,7 +228,7 @@ def _trial_party_sharded(
                 for i, x in enumerate(pool_l)
             )
             k_round = jax.random.fold_in(k_rounds, round_idx)
-            draws = sample_attacks_round(cfg, k_round)
+            draws = sample_attacks_round(cfg, k_round, round_idx, ctx)
             att_c, rv_c, late_c = (
                 jax.lax.dynamic_slice_in_dim(d, start, n_local, 1)
                 .astype(jnp.int32)
@@ -305,7 +309,7 @@ def _trial_party_sharded(
                 for i, x in enumerate(pool_l)
             )
             k_round = jax.random.fold_in(k_rounds, round_idx)
-            draws = sample_attacks_round(cfg, k_round)
+            draws = sample_attacks_round(cfg, k_round, round_idx, ctx)
             att_c, rv_c, late_c = (
                 jax.lax.dynamic_slice_in_dim(d, start, n_local, 1)
                 .astype(jnp.int32)
@@ -345,7 +349,7 @@ def _trial_party_sharded(
             # Same batched round draws as the single-device engines; each
             # device consumes its own receivers' rows, so placement cannot
             # change the randomness.
-            draws = sample_attacks_round(cfg, k_round)
+            draws = sample_attacks_round(cfg, k_round, round_idx, ctx)
             my_draws = tuple(
                 jax.lax.dynamic_slice_in_dim(d, start, n_local, 1)
                 for d in draws

@@ -196,7 +196,12 @@ class Circuit:
 
         return state_fn
 
-    def compile(self, impl: str = "xla"):
+    def compile(
+        self,
+        impl: str = "xla",
+        p_depolarize: float = 0.0,
+        p_measure_flip: float = 0.0,
+    ):
         """Build ``run(key, params=None) -> int32 bits[n_qubits]``.
 
         The returned function is pure and jit/vmap-safe; measurement of
@@ -210,6 +215,10 @@ class Circuit:
         ``impl="auto"`` picks per :meth:`resolve_auto_impl` — past the
         dense cap, Clifford circuits hand off to the stabilizer engine
         rather than OOM.
+
+        Nonzero noise applies the channels of :mod:`qba_tpu.qsim.noise`
+        — the dense path via the exact classical reduction on the
+        measured bits, the stabilizer path via tableau-phase injection.
         """
         n = self.n_qubits
         if impl == "auto":
@@ -217,16 +226,32 @@ class Circuit:
         if impl == "stabilizer":
             from qba_tpu.qsim.stabilizer import build_tableau_run
 
-            return build_tableau_run(n, tuple(self.ops), self.n_params)
+            return build_tableau_run(
+                n, tuple(self.ops), self.n_params,
+                p_depolarize, p_measure_flip,
+            )
         state_fn = self.compile_state(impl)
+        noisy = p_depolarize > 0.0 or p_measure_flip > 0.0
 
         def run(key: jax.Array, params: jnp.ndarray | None = None) -> jnp.ndarray:
             state = state_fn(params)
-            return sv.measure_all(state.reshape((2,) * n), key)
+            bits = sv.measure_all(state.reshape((2,) * n), key)
+            if noisy:
+                from qba_tpu.qsim.noise import classical_flips
+
+                bits = bits ^ classical_flips(
+                    key, n, p_depolarize, p_measure_flip
+                )
+            return bits
 
         return run
 
-    def compile_shots(self, impl: str = "xla"):
+    def compile_shots(
+        self,
+        impl: str = "xla",
+        p_depolarize: float = 0.0,
+        p_measure_flip: float = 0.0,
+    ):
         """Build ``run(key, shots, params=None) -> int32 bits[shots, n]``.
 
         Multi-shot batching: the statevector is prepared ONCE and only
@@ -239,6 +264,8 @@ class Circuit:
         tableau (:func:`~qba_tpu.qsim.stabilizer.build_tableau_run_shots`,
         the differential reference) under identical keys.
         ``impl="auto"`` resolves per :meth:`resolve_auto_impl`.
+        Noise follows the same split as :meth:`compile` (classical
+        reduction on dense bits, phase injection on the GF(2) engine).
         """
         n = self.n_qubits
         if impl == "auto":
@@ -247,14 +274,23 @@ class Circuit:
             from qba_tpu.gf2 import build_gf2_tableau_run_shots
 
             return build_gf2_tableau_run_shots(
-                n, tuple(self.ops), self.n_params
+                n, tuple(self.ops), self.n_params,
+                p_depolarize, p_measure_flip,
             )
         state_fn = self.compile_state(impl)
+        noisy = p_depolarize > 0.0 or p_measure_flip > 0.0
 
         def run(
             key: jax.Array, shots: int, params: jnp.ndarray | None = None
         ) -> jnp.ndarray:
             state = state_fn(params)
-            return sv.measure_shots(state.reshape((2,) * n), key, shots)
+            bits = sv.measure_shots(state.reshape((2,) * n), key, shots)
+            if noisy:
+                from qba_tpu.qsim.noise import classical_flips_shots
+
+                bits = bits ^ classical_flips_shots(
+                    key, shots, n, p_depolarize, p_measure_flip
+                )
+            return bits
 
         return run

@@ -1,0 +1,128 @@
+"""Imperfect quantum resources: depolarizing + readout-flip channels.
+
+The reference assumes noiseless Clifford circuits and perfect
+measurement (``tfg.py:15-84``).  This module adds the two standard
+imperfections as *channels on the terminal measurement*:
+
+* **Depolarizing** (``cfg.p_depolarize``): independently per qubit,
+  with probability ``p`` a uniformly random Pauli (X, Y or Z) is
+  applied immediately before measurement.
+* **Measurement flip** (``cfg.p_measure_flip``): independently per
+  qubit, the classical readout bit is flipped with probability ``q``.
+
+Because every protocol circuit ends in a full computational-basis
+measurement, the depolarizing channel has an exact classical
+reduction: an X or Y error on qubit ``j`` flips outcome bit ``j``
+(``P(X-component) = 2p/3``), a Z error is invisible.  The dense
+statevector and factorized-sampler paths therefore apply
+:func:`classical_flips` to the measured bits — *exactly* the channel,
+not an approximation.  The stabilizer paths instead inject the drawn
+Pauli into the tableau phase vector (:mod:`qba_tpu.qsim.stabilizer`,
+:mod:`qba_tpu.gf2.symplectic`) — a phase-only edit, so the tableau
+stays Clifford and the KI-3 / gf2 lint surface is untouched; the two
+stabilizer engines share :func:`noise_draws` and remain bit-identical
+to each other, while dense-vs-stabilizer equality under noise is
+distributional (pinned statistically in tests/test_noise.py).
+
+Draw discipline (shared by every path): the noise stream forks off the
+*measurement* key via ``fold_in(key, _NOISE_TAG)`` with a fresh tag, so
+zero-noise runs consume exactly the byte-identical key tree as before —
+``p_depolarize = p_measure_flip = 0.0`` is bit-identical to current
+outputs on every engine, and the noise branches are statically gated on
+the Python floats (never traced at zero).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Fresh fold_in tag for the noise stream (disjoint from the adversary
+# tags in qba_tpu.adversary.model and every split already in use).
+_NOISE_TAG = 0x401E
+
+
+def noise_draws(
+    key: jax.Array,
+    n: int,
+    p_depolarize: float,
+    p_measure_flip: float,
+):
+    """One shot's channel draws: ``(bx, bz, mflip)`` int32 ``[n]``.
+
+    ``bx``/``bz`` are the X/Z components of the drawn Pauli (X -> (1,0),
+    Y -> (1,1), Z -> (0,1), identity -> (0,0)); ``mflip`` the readout
+    flips.  Both stabilizer engines consume these identically (their
+    bit-identity contract extends to noisy runs)."""
+    k_noise = jax.random.fold_in(key, _NOISE_TAG)
+    kn_p, kn_k, kn_f = jax.random.split(k_noise, 3)
+    pauli = jax.random.bernoulli(kn_p, p_depolarize, (n,))
+    kind = jax.random.randint(kn_k, (n,), 0, 3, dtype=jnp.int32)
+    bx = (pauli & (kind != 2)).astype(jnp.int32)  # X or Y
+    bz = (pauli & (kind != 0)).astype(jnp.int32)  # Y or Z
+    mflip = jax.random.bernoulli(
+        kn_f, p_measure_flip, (n,)
+    ).astype(jnp.int32)
+    return bx, bz, mflip
+
+
+def classical_flips(
+    key: jax.Array,
+    n: int,
+    p_depolarize: float,
+    p_measure_flip: float,
+) -> jnp.ndarray:
+    """The exact classical reduction for a terminal measurement:
+    int32 ``[n]`` of outcome-bit flips (``bx ^ mflip`` — X/Y errors
+    flip the readout, Z errors are invisible)."""
+    bx, _bz, mflip = noise_draws(key, n, p_depolarize, p_measure_flip)
+    return bx ^ mflip
+
+
+def classical_flips_shots(
+    key: jax.Array,
+    shots: int,
+    n: int,
+    p_depolarize: float,
+    p_measure_flip: float,
+) -> jnp.ndarray:
+    """Batched classical reduction for a multi-shot dense run: int32
+    ``[shots, n]`` of outcome-bit flips, one independent channel per
+    shot, drawn off the run key's noise fork (the dense engine prepares
+    the state once and Born-samples the batch, so there is no per-shot
+    subkey to fold into)."""
+    k_noise = jax.random.fold_in(key, _NOISE_TAG)
+    kn_p, kn_k, kn_f = jax.random.split(k_noise, 3)
+    full = (shots, n)
+    pauli = jax.random.bernoulli(kn_p, p_depolarize, full)
+    kind = jax.random.randint(kn_k, full, 0, 3, dtype=jnp.int32)
+    bx = (pauli & (kind != 2)).astype(jnp.int32)
+    mflip = jax.random.bernoulli(
+        kn_f, p_measure_flip, full
+    ).astype(jnp.int32)
+    return bx ^ mflip
+
+
+def classical_flip_ints(
+    key: jax.Array,
+    shape: tuple[int, ...],
+    n_qubits: int,
+    p_depolarize: float,
+    p_measure_flip: float,
+) -> jnp.ndarray:
+    """Batched classical flips packed as big-endian ``n_qubits``-bit
+    integers: int32 ``[*shape]`` in ``[0, 2**n_qubits)`` — the XOR mask
+    for decoded order values (the factorized sampler's layout, one
+    independent channel per (group, position) qubit block)."""
+    k_noise = jax.random.fold_in(key, _NOISE_TAG)
+    kn_p, kn_k, kn_f = jax.random.split(k_noise, 3)
+    full = (*shape, n_qubits)
+    pauli = jax.random.bernoulli(kn_p, p_depolarize, full)
+    kind = jax.random.randint(kn_k, full, 0, 3, dtype=jnp.int32)
+    bx = (pauli & (kind != 2)).astype(jnp.int32)
+    mflip = jax.random.bernoulli(
+        kn_f, p_measure_flip, full
+    ).astype(jnp.int32)
+    flips = bx ^ mflip  # [*shape, n_qubits] 0/1, big-endian bit order
+    shifts = jnp.arange(n_qubits - 1, -1, -1, dtype=jnp.int32)
+    return jnp.sum(flips << shifts, axis=-1).astype(jnp.int32)

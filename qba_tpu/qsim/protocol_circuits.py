@@ -100,8 +100,15 @@ def generate_lists_dense(cfg: QBAConfig, key: jax.Array, impl: str = "xla"):
         impl = gen_q_corr_circuit(n, nq).resolve_auto_impl()
         if impl == "stabilizer":
             return generate_lists_stabilizer(cfg, key)
-    run_q = gen_q_corr_circuit(n, nq).compile(impl)
-    run_nq = gen_nq_corr_circuit(n, nq).compile(impl)
+    # Imperfect resources (cfg.p_depolarize / cfg.p_measure_flip) apply
+    # per position off that position's measurement key — compile() owns
+    # the channel (classical reduction on the dense engines).
+    run_q = gen_q_corr_circuit(n, nq).compile(
+        impl, cfg.p_depolarize, cfg.p_measure_flip
+    )
+    run_nq = gen_nq_corr_circuit(n, nq).compile(
+        impl, cfg.p_depolarize, cfg.p_measure_flip
+    )
 
     k_qcorr, k_perm, k_meas = jax.random.split(key, 3)
     qcorr = jax.random.bernoulli(k_qcorr, 0.5, (cfg.size_l,))
@@ -155,8 +162,17 @@ def generate_lists_stabilizer(cfg: QBAConfig, key: jax.Array):
     total = (n + 1) * nq
     circ_q = gen_q_corr_circuit(n, nq)
     circ_nq = gen_nq_corr_circuit(n, nq)
-    run_q = build_gf2_tableau_run_batch(total, tuple(circ_q.ops), circ_q.n_params)
-    run_nq = build_gf2_tableau_run_batch(total, tuple(circ_nq.ops), 0)
+    # Noise rides each position's measurement key (tableau-phase
+    # injection — keeps the program Clifford; see qsim/noise.py), so
+    # both stabilizer engines stay bit-identical under noise too.
+    run_q = build_gf2_tableau_run_batch(
+        total, tuple(circ_q.ops), circ_q.n_params,
+        cfg.p_depolarize, cfg.p_measure_flip,
+    )
+    run_nq = build_gf2_tableau_run_batch(
+        total, tuple(circ_nq.ops), 0,
+        cfg.p_depolarize, cfg.p_measure_flip,
+    )
 
     k_qcorr, k_perm, k_meas = jax.random.split(key, 3)
     qcorr = jax.random.bernoulli(k_qcorr, 0.5, (cfg.size_l,))

@@ -72,4 +72,19 @@ def generate_lists(cfg: QBAConfig, key: jax.Array):
     rows_nq = jnp.concatenate([u[0:1], u], axis=0)
 
     lists = jnp.where(qcorr[None, :], rows_q, rows_nq)
+    if cfg.p_depolarize > 0.0 or cfg.p_measure_flip > 0.0:
+        # Imperfect resources (qsim/noise.py): the exact classical
+        # reduction of per-qubit depolarizing + readout flip on a
+        # terminal measurement — one independent channel per
+        # (group, position) qubit block, XORed into the decoded values
+        # (closed under [0, w): flip ints < 2**n_qubits = w).  The
+        # noise stream forks off a fresh fold_in tag, so the zero-noise
+        # draws above are byte-identical to the noiseless sampler —
+        # and the branch is statically gated (never traced at zero).
+        from qba_tpu.qsim.noise import classical_flip_ints
+
+        lists = lists ^ classical_flip_ints(
+            key, (n + 1, s), cfg.n_qubits,
+            cfg.p_depolarize, cfg.p_measure_flip,
+        )
     return lists, qcorr

@@ -133,7 +133,13 @@ def _validate_ops(ops) -> None:
             )
 
 
-def build_tableau_run(n: int, ops, n_params: int):
+def build_tableau_run(
+    n: int,
+    ops,
+    n_params: int,
+    p_depolarize: float = 0.0,
+    p_measure_flip: float = 0.0,
+):
     """Build ``run(key, params=None) -> int32 bits[n]`` on the tableau
     engine — same contract as :meth:`Circuit.compile`'s other impls:
     one computational-basis sample of every qubit, qubit ``q`` at index
@@ -142,10 +148,18 @@ def build_tableau_run(n: int, ops, n_params: int):
     The per-qubit measurement sweep consumes one pre-drawn uniform bit
     per qubit (used only when that qubit's outcome is random), so the
     whole program is a fixed-shape ``fori_loop`` — jit/vmap-safe.
+
+    Nonzero ``p_depolarize``/``p_measure_flip`` inject the channels of
+    :mod:`qba_tpu.qsim.noise`: the drawn Pauli conjugates the evolved
+    tableau — a pure phase edit (``X(a): r ^= z_a``, ``Z(a): r ^= x_a``,
+    Y both), so the tableau stays Clifford — and readout flips XOR the
+    output bits.  Statically gated: at zero the traced program (and the
+    key stream) is byte-identical to the noiseless build.
     """
     ops = tuple(ops)
     _validate_ops(ops)
     rows2n = jnp.arange(2 * n, dtype=jnp.int32)
+    noisy = p_depolarize > 0.0 or p_measure_flip > 0.0
 
     def run(key: jax.Array, params: jnp.ndarray | None = None) -> jnp.ndarray:
         if params is None:
@@ -158,6 +172,16 @@ def build_tableau_run(n: int, ops, n_params: int):
         r = jnp.zeros((2 * n,), dtype=jnp.int32)
 
         x, z, r = _apply_ops(ops, x, z, r, params)
+
+        mflip = None
+        if noisy:
+            from qba_tpu.qsim.noise import noise_draws
+
+            bx, bz, mflip = noise_draws(
+                key, n, p_depolarize, p_measure_flip
+            )
+            # Pauli conjugation of every row: phase-only in XZ form.
+            r = r ^ ((z @ bx + x @ bz) & 1)
 
         rnds = (jax.random.bits(key, (n,), jnp.uint32) & 1).astype(jnp.int32)
 
@@ -221,12 +245,20 @@ def build_tableau_run(n: int, ops, n_params: int):
         _, _, _, out = jax.lax.fori_loop(
             0, n, measure_one, (x, z, r, out0)
         )
+        if mflip is not None:
+            out = out ^ mflip
         return out
 
     return run
 
 
-def build_tableau_run_shots(n: int, ops, n_params: int):
+def build_tableau_run_shots(
+    n: int,
+    ops,
+    n_params: int,
+    p_depolarize: float = 0.0,
+    p_measure_flip: float = 0.0,
+):
     """``run(key, shots, params=None) -> int32 bits[shots, n]``.
 
     Unlike the dense engine (state prepared once, Born sampling
@@ -234,7 +266,7 @@ def build_tableau_run_shots(n: int, ops, n_params: int):
     independent vmapped tableau run.  Tableau prep is O(n^2) per shot,
     which is the cheap part at any scale this engine targets.
     """
-    run1 = build_tableau_run(n, ops, n_params)
+    run1 = build_tableau_run(n, ops, n_params, p_depolarize, p_measure_flip)
 
     def run(
         key: jax.Array, shots: int, params: jnp.ndarray | None = None

@@ -29,6 +29,8 @@ from qba_tpu.adversary import (
     CLEAR_P_BIT,
     DROP_BIT,
     FORGE_BIT,
+    FORGE_P_BIT,
+    adversary_ctx,
     assign_dishonest,
     commander_orders,
     corrupt_at_delivery,
@@ -220,6 +222,15 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
     v2 = jnp.where(biz & ((attack & FORGE_BIT) != 0), rand_v, v_f)  # tfg.py:277
     clear_p = biz & ((attack & CLEAR_P_BIT) != 0)  # tfg.py:281
     clear_l = biz & ((attack & CLEAR_L_BIT) != 0)  # tfg.py:283
+    # Forge-P (strategy="split" only): the delivered P mask is forged to
+    # all-True.  Statically gated so every other strategy's arithmetic —
+    # and the reference bit-identity pin — is untouched.
+    use_fp = cfg.strategy == "split"
+    forge_p = (
+        biz & ((attack & FORGE_P_BIT) != 0)
+        if use_fp
+        else jnp.zeros_like(biz)
+    )
     delivered = ~dropped & ~late & sent_f & (senders != receiver_idx)
 
     # Receiver-independent raw-mailbox reductions (shared by all receivers).
@@ -333,9 +344,13 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
 
     # Receiver-dependent part: the would-be own row (tfg.py:291).
     p2 = p_f & ~clear_p[:, None]  # [n_pk, size_l]
+    if use_fp:
+        p2 = p2 | forge_p[:, None]  # forged-full mask wins over clear
     own = jnp.where(p2, li[None, :], SENTINEL)  # [n_pk, size_l]
     s_p = jnp.sum(p_f.astype(jnp.int32), axis=-1)  # [n_pk] (hoisted)
     own_len = jnp.where(clear_p, 0, s_p)  # |own row| = (1-cp) * |P|
+    if use_fp:
+        own_len = jnp.where(forge_p, cfg.size_l, own_len)
 
     count_eff = jnp.where(clear_l, 0, count_f)
     # Dup detection (row == own).  The direct form materializes a
@@ -377,6 +392,26 @@ def receiver_round(cfg: QBAConfig, round_idx, draws, receiver_idx, vi_row, li, m
         cp_f = clear_p.astype(jnp.float32)[:, None]
         cross = (1.0 - cp_f) * m1 - s_v.astype(jnp.float32)
         ssq_o = (1.0 - cp_f) * m2[:, None] + float(cfg.size_l)
+        if use_fp:
+            # Forged-full mask: the P factor drops out of the identity —
+            # one extra unmasked contraction (m1_full) and a scalar
+            # (sum li^2-1) replace the masked terms where forge_p.
+            fp_f = forge_p.astype(jnp.float32)[:, None]
+            m1_full = jax.lax.dot_general(
+                vals_f.astype(jnp.float32).reshape(
+                    n_pk * max_l, cfg.size_l
+                ),
+                (li_f + 1.0)[:, None],
+                (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+            ).reshape(n_pk, max_l)
+            m2_full = jnp.sum(li_f * li_f - 1.0)
+            cross = fp_f * (m1_full - s_v.astype(jnp.float32)) + (
+                1.0 - fp_f
+            ) * cross
+            ssq_o = fp_f * (m2_full + float(cfg.size_l)) + (
+                1.0 - fp_f
+            ) * ssq_o
         mism = ssq_v.astype(jnp.float32) - 2.0 * cross + ssq_o
         dup_rows = mism == 0.0  # [n_pk, max_l]
     else:  # pragma: no cover - w > 256-class configs
@@ -618,7 +653,8 @@ def _finish_counters(cfg: QBAConfig, counter_state, vi_final, overflows):
     return counters_finish(cfg, state, vi_final, accepts, per_round)
 
 
-def run_rounds_xla(cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds):
+def run_rounds_xla(cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds,
+                   ctx=None):
     """Step 3b (tfg.py:337-348) as pure XLA: ``lax.scan`` over rounds,
     receivers vmapped.  Portable to any backend."""
     receiver_ids = jnp.arange(cfg.n_lieutenants)
@@ -626,7 +662,9 @@ def run_rounds_xla(cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds):
     def round_body(carry, round_idx):
         vi, mb = carry
         k_round = jax.random.fold_in(k_rounds, round_idx)
-        draws = sample_attacks_round(cfg, k_round)  # each [n_pk, n_lieu]
+        draws = sample_attacks_round(
+            cfg, k_round, round_idx, ctx
+        )  # each [n_pk, n_lieu]
         vi, out_cells, ovf = jax.vmap(
             lambda d, r, vrow, li: receiver_round(cfg, round_idx, d, r, vrow, li, mb, honest),
             in_axes=(1, 0, 0, 0),
@@ -638,7 +676,8 @@ def run_rounds_xla(cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds):
 
 
 def run_rounds_pallas(
-    cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds, *, interpret: bool
+    cfg: QBAConfig, vi, mb, lieu_lists, honest, k_rounds, ctx=None,
+    *, interpret: bool,
 ):
     """Step 3b on the fused Pallas round kernel
     (:func:`qba_tpu.ops.round_kernel.build_round_step`): one kernel per
@@ -661,7 +700,9 @@ def run_rounds_pallas(
     def round_body(carry, round_idx):
         vi_i32, packed = carry
         k_round = jax.random.fold_in(k_rounds, round_idx)
-        attack, rand_v, late = sample_attacks_round(cfg, k_round)
+        attack, rand_v, late = sample_attacks_round(
+            cfg, k_round, round_idx, ctx
+        )
         out = step(
             round_idx, *packed, lieu_lists, vi_i32, honest_pk,
             attack.astype(jnp.int32), rand_v.astype(jnp.int32),
@@ -677,7 +718,7 @@ def run_rounds_pallas(
 
 
 def run_rounds_tiled(
-    cfg: QBAConfig, vi, out_cells, lieu_lists, honest, k_rounds,
+    cfg: QBAConfig, vi, out_cells, lieu_lists, honest, k_rounds, ctx=None,
     *, interpret: bool,
 ):
     """Step 3b on the packet-tiled engine
@@ -723,7 +764,9 @@ def run_rounds_tiled(
     def round_body(carry, round_idx):
         vi_i32, pool = carry
         k_round = jax.random.fold_in(k_rounds, round_idx)
-        attack, rand_v, late = sample_attacks_round(cfg, k_round)
+        attack, rand_v, late = sample_attacks_round(
+            cfg, k_round, round_idx, ctx
+        )
         # Draws stay mailbox-cell-ordered — both kernels select each
         # pool entry's row in-kernel by its cell id (one-hot MXU), so
         # the randomness keeps its identity without XLA-side gathers.
@@ -756,7 +799,7 @@ def run_rounds_tiled(
 
 
 def run_rounds_fused(
-    cfg: QBAConfig, vi, out_cells, lieu_lists, honest, k_rounds,
+    cfg: QBAConfig, vi, out_cells, lieu_lists, honest, k_rounds, ctx=None,
     *, interpret: bool,
 ):
     """Step 3b on the FUSED round engine
@@ -795,7 +838,7 @@ def run_rounds_fused(
             slots=cfg.slots,
         )
         return run_rounds_tiled(
-            cfg, vi, out_cells, lieu_lists, honest, k_rounds,
+            cfg, vi, out_cells, lieu_lists, honest, k_rounds, ctx,
             interpret=interpret,
         )
     fused = build_fused_round_kernel(
@@ -812,7 +855,9 @@ def run_rounds_fused(
     def round_body(carry, round_idx):
         vi_i32, pool = carry
         k_round = jax.random.fold_in(k_rounds, round_idx)
-        attack, rand_v, late = sample_attacks_round(cfg, k_round)
+        attack, rand_v, late = sample_attacks_round(
+            cfg, k_round, round_idx, ctx
+        )
         pool_new, vi_i32, ovf = fused(
             round_idx, *pool, lieu_lists, li_arg, vi_i32,
             honest_cells, attack.astype(jnp.int32),
@@ -883,17 +928,18 @@ def run_trials_fused_packed(cfg: QBAConfig, keys, pack: int):
             honest, lieu_lists, li_arg, v_comm, k_rounds,
             vi.astype(jnp.int32), pool,
             honest_cells_fn(honest, cfg),
+            adversary_ctx(cfg, k_rounds, v_sent),
         )
 
     (honest_t, li_t, li_arg_t, v_comm_t, k_rounds_t, vi_t, pool_t,
-     hc_t) = jax.vmap(setup_one)(keys)
+     hc_t, ctx_t) = jax.vmap(setup_one)(keys)
 
     def group(x):  # [trials, ...] -> [n_groups, pack, ...]
         return jax.tree_util.tree_map(
             lambda a: a.reshape((n_groups, pack) + a.shape[1:]), x
         )
 
-    def run_group(li_k, li_arg_k, k_rounds_k, vi_k, pool_k, hc_k):
+    def run_group(li_k, li_arg_k, k_rounds_k, vi_k, pool_k, hc_k, ctx_k):
         vals, lens, p, meta = pool_k
         # The kernel's packed vals layout is [max_l, k, cap, s].
         vals = jnp.moveaxis(vals, 0, 1)
@@ -901,10 +947,11 @@ def run_trials_fused_packed(cfg: QBAConfig, keys, pack: int):
         def round_body(carry, round_idx):
             vi_k, pool = carry
             att, rv, late = jax.vmap(
-                lambda kr: sample_attacks_round(
-                    cfg, jax.random.fold_in(kr, round_idx)
+                lambda kr, cx: sample_attacks_round(
+                    cfg, jax.random.fold_in(kr, round_idx),
+                    round_idx, cx,
                 )
-            )(k_rounds_k)
+            )(k_rounds_k, ctx_k)
             pool_new, vi_k, ovf = fused(
                 round_idx, *pool, li_k, li_arg_k, vi_k, hc_k,
                 att.astype(jnp.int32), rv.astype(jnp.int32),
@@ -937,7 +984,7 @@ def run_trials_fused_packed(cfg: QBAConfig, keys, pack: int):
 
     vi_g, ovf_g, cnt_g = jax.vmap(run_group)(
         group(li_t), group(li_arg_t), group(k_rounds_t),
-        group(vi_t), group(pool_t), group(hc_t),
+        group(vi_t), group(pool_t), group(hc_t), group(ctx_t),
     )
     vi_flat = vi_g.reshape((keys.shape[0],) + vi_g.shape[2:])
     ovf_flat = ovf_g.reshape((keys.shape[0],))
@@ -1005,24 +1052,25 @@ def run_trial(
     mb = Mailbox(*out_cells)
 
     # Step 3b (tfg.py:337-348): synchronous rounds 1..n_dishonest+1.
+    ctx = adversary_ctx(cfg, k_rounds, v_sent)
     engine = resolve_round_engine(cfg)
     if engine == "pallas":
         vi, overflow, counters = run_rounds_pallas(
-            cfg, vi, mb, lieu_lists, honest, k_rounds,
+            cfg, vi, mb, lieu_lists, honest, k_rounds, ctx,
             interpret=jax.default_backend() != "tpu",
         )
     elif engine == "pallas_tiled":
         vi, overflow, counters = run_rounds_tiled(
-            cfg, vi, out_cells, lieu_lists, honest, k_rounds,
+            cfg, vi, out_cells, lieu_lists, honest, k_rounds, ctx,
             interpret=jax.default_backend() != "tpu",
         )
     elif engine == "pallas_fused":
         vi, overflow, counters = run_rounds_fused(
-            cfg, vi, out_cells, lieu_lists, honest, k_rounds,
+            cfg, vi, out_cells, lieu_lists, honest, k_rounds, ctx,
             interpret=jax.default_backend() != "tpu",
         )
     else:
         vi, overflow, counters = run_rounds_xla(
-            cfg, vi, mb, lieu_lists, honest, k_rounds
+            cfg, vi, mb, lieu_lists, honest, k_rounds, ctx
         )
     return finish_trial(cfg, vi, v_comm, honest, overflow, counters)

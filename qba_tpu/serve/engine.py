@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Any
 
 import numpy as np
@@ -67,9 +68,18 @@ class _Active:
     probe_before: dict[str, int]
     success: np.ndarray
     overflow: np.ndarray
+    arrived: float = 0.0  # time.monotonic() at submit
+    deadline_s: float | None = None  # resolved wall-clock budget
     decisions: np.ndarray | None = None  # allocated at first readback
     filled: int = 0
     chunks: int = 0
+
+    @property
+    def overdue(self) -> bool:
+        return (
+            self.deadline_s is not None
+            and time.monotonic() - self.arrived > self.deadline_s
+        )
 
 
 class QBAServer:
@@ -85,11 +95,16 @@ class QBAServer:
         telemetry_dir: str | None = None,
         cache_dir: str | None = None,
         warm_start: bool = True,
+        deadline_s: float | None = None,
     ) -> None:
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self.scheduler = BucketScheduler(chunk_trials)
         self.depth = depth
+        self.deadline_s = deadline_s
+        self._expired = 0
         self.telemetry_dir = telemetry_dir
         self.cache_dir = cache_dir
         self.recorder = SpanRecorder()  # server-level chunk spans
@@ -149,6 +164,11 @@ class QBAServer:
             probe_before=probe_before,
             success=np.zeros(cfg.trials, dtype=bool),
             overflow=np.zeros(cfg.trials, dtype=bool),
+            arrived=time.monotonic(),
+            deadline_s=(
+                req.deadline_s if req.deadline_s is not None
+                else self.deadline_s
+            ),
         )
 
     # ---- dispatch / drain --------------------------------------------
@@ -156,7 +176,7 @@ class QBAServer:
         """Dispatch every *full* chunk, draining as the double buffer
         fills; returns requests completed along the way.  Partial
         chunks wait for more same-bucket traffic until :meth:`flush`."""
-        done: list[EvalResult] = []
+        done: list[EvalResult] = self.expire_overdue()
         while self.scheduler.has_full_chunk():
             chunk = self.scheduler.next_chunk()
             assert chunk is not None
@@ -166,7 +186,7 @@ class QBAServer:
     def flush(self) -> list[EvalResult]:
         """Dispatch all pending trials (padding partial chunks), drain
         every in-flight chunk, and persist the resolver plans."""
-        done: list[EvalResult] = []
+        done: list[EvalResult] = self.expire_overdue()
         while True:
             chunk = self.scheduler.next_chunk()
             if chunk is None:
@@ -177,6 +197,57 @@ class QBAServer:
         if self.cache_dir is not None:
             persist.save_plans(self.cache_dir, self._served_buckets)
         return done
+
+    def expire_overdue(self) -> list[EvalResult]:
+        """Turn every request past its wall-clock deadline into a
+        structured error result NOW — still-queued trials are cancelled,
+        in-flight ones compute but their readback segments are
+        discarded.  The stream never wedges behind one slow request:
+        this runs at the head of every :meth:`pump`/:meth:`flush`."""
+        overdue = [ar for ar in self._active.values() if ar.overdue]
+        return [self._expire(ar) for ar in overdue]
+
+    def _expire(self, ar: _Active) -> EvalResult:
+        self.scheduler.cancel(ar.req.request_id)
+        del self._active[ar.req.request_id]
+        ar.root_ctx.__exit__(None, None, None)
+        self._request_spans.append(ar.root_span)
+        self._expired += 1
+        latency = float(ar.root_span.dur or 0.0)
+        label = bucket_label(ar.bucket)
+        # The error result still carries the full validated manifest —
+        # the caller learns which engine/plan the request WAS bound to
+        # and how far it got, not just that it timed out.
+        manifest = validate_manifest(
+            collect_manifest(
+                ar.cfg,
+                command="serve",
+                decisions=self._bucket_decisions.get(ar.bucket, []),
+                probe_stats_before=ar.probe_before,
+                spans=ar.recorder,
+                extra={
+                    "request_id": ar.req.request_id,
+                    "bucket": label,
+                    "latency_s": latency,
+                    "chunks": ar.chunks,
+                    "restored_plans": self.restored_plans,
+                    "expired": True,
+                    "trials_completed": ar.filled,
+                },
+            )
+        )
+        if self.telemetry_dir is not None:
+            self._write_telemetry(ar, manifest)
+        res = EvalResult.failure(
+            ar.req.request_id,
+            f"deadline exceeded: {ar.deadline_s}s wall clock, "
+            f"{ar.filled}/{ar.cfg.trials} trials complete",
+        )
+        res.latency_s = latency
+        res.bucket = label
+        res.chunks = ar.chunks
+        res.manifest = manifest
+        return res
 
     def close(self) -> list[EvalResult]:
         return self.flush()
@@ -232,7 +303,11 @@ class QBAServer:
             sp.fenced = True
         done: list[EvalResult] = []
         for seg in chunk.segments:
-            ar = self._active[seg.request_id]
+            ar = self._active.get(seg.request_id)
+            if ar is None:
+                # Request expired (deadline) between dispatch and
+                # readback — its computed rows are discarded.
+                continue
             with ar.recorder.span(
                 "serve.chunk", cat="serve",
                 chunk=chunk.index, trials=seg.length, bucket=label,
@@ -322,6 +397,7 @@ class QBAServer:
 
         return {
             "completed": self._completed,
+            "expired": self._expired,
             "in_flight_chunks": len(self._in_flight),
             "pending_trials": self.scheduler.pending_trials(),
             "buckets": [bucket_label(b) for b in self._served_buckets],

@@ -43,8 +43,18 @@ class EvalRequest:
     p_late: float = 0.0
     racy_mode: str = "loss"
     attack_scope: str = "delivery"
+    # Adversary zoo + imperfect resources (the ROADMAP item-3 adversary
+    # axis): the strategy is part of the bucket identity, so distinct
+    # strategies never share a compiled program they shouldn't.
+    strategy: str = "reference"
+    p_depolarize: float = 0.0
+    p_measure_flip: float = 0.0
     tiled_block: int | None = None
     trial_pack: int | None = None
+    # Per-request wall-clock deadline (seconds from submit); None defers
+    # to the server's default.  An overdue request gets a structured
+    # error EvalResult (with manifest) instead of wedging the stream.
+    deadline_s: float | None = None
     # Per-trial decisions are O(trials * n_parties) ints on the wire;
     # callers that only want the rate leave this off.
     return_decisions: bool = False
@@ -65,6 +75,9 @@ class EvalRequest:
             p_late=self.p_late,
             racy_mode=self.racy_mode,
             attack_scope=self.attack_scope,
+            strategy=self.strategy,
+            p_depolarize=self.p_depolarize,
+            p_measure_flip=self.p_measure_flip,
             tiled_block=self.tiled_block,
             trial_pack=self.trial_pack,
         )

@@ -39,11 +39,16 @@ def bucket_config(cfg: QBAConfig, chunk_trials: int) -> QBAConfig:
 
 def bucket_label(bucket: QBAConfig) -> str:
     """Human-readable bucket id used in spans/results, e.g.
-    ``5p-L8-d1-auto``."""
-    return (
+    ``5p-L8-d1-auto`` (non-reference strategies get a suffix: the
+    strategy is already part of the bucket *identity* via the config
+    object — split traces a different kernel — so the label shows it)."""
+    label = (
         f"{bucket.n_parties}p-L{bucket.size_l}-d{bucket.n_dishonest}"
         f"-{bucket.round_engine}"
     )
+    if bucket.strategy != "reference":
+        label += f"-{bucket.strategy}"
+    return label
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,6 +129,24 @@ class BucketScheduler:
 
     def pending_trials(self) -> int:
         return sum(q.remaining for dq in self._queues.values() for q in dq)
+
+    def cancel(self, request_id: str) -> int:
+        """Drop every still-queued trial of ``request_id`` (deadline
+        expiry); returns how many trials were removed.  Trials already
+        assembled into chunks are untouched — their readback segments
+        are discarded by the server when the request is no longer
+        active."""
+        removed = 0
+        for dq in self._queues.values():
+            keep = deque()
+            while dq:
+                q = dq.popleft()
+                if q.request_id == request_id:
+                    removed += q.remaining
+                else:
+                    keep.append(q)
+            dq.extend(keep)
+        return removed
 
     def has_full_chunk(self) -> bool:
         return any(

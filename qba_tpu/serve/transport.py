@@ -81,6 +81,8 @@ def queue_paths(queue_dir: str) -> dict[str, str]:
     return {
         "inbox": os.path.join(queue_dir, "inbox"),
         "claimed": os.path.join(queue_dir, "claimed"),
+        "done": os.path.join(queue_dir, "done"),
+        "dead": os.path.join(queue_dir, "dead"),
         "outbox": os.path.join(queue_dir, "outbox"),
         "stop": os.path.join(queue_dir, "stop"),
         "summary": os.path.join(queue_dir, "summary.json"),
@@ -101,27 +103,120 @@ def _result_path(outbox: str, request_id: str) -> str:
     return os.path.join(outbox, slug + ".json")
 
 
+def _reclaim_stale(
+    paths: dict[str, str],
+    attempts: dict[str, int],
+    live: set[str],
+    timeout_s: float,
+    max_reclaims: int,
+    emit,
+) -> int:
+    """Crash recovery for the file-queue claim protocol: a worker that
+    died mid-request leaves its claim file in ``claimed/`` with no
+    result — this moves such stale claims back to ``inbox/`` so any
+    consumer can retry them.
+
+    Bounds (so one poison request can't loop forever): the k-th reclaim
+    of a file requires age ``timeout_s * 2**k`` (exponential backoff —
+    a request that keeps killing workers is retried at 1x, 2x, 4x...),
+    and after ``max_reclaims`` attempts the file is dead-lettered to
+    ``dead/`` with a structured error result in the outbox.  ``live``
+    names this process's own in-progress claims, which are never stale.
+    """
+    reclaimed = 0
+    now = time.time()
+    try:
+        names = sorted(
+            n for n in os.listdir(paths["claimed"]) if n.endswith(".json")
+        )
+    except OSError:
+        return 0
+    for name in names:
+        if name in live:
+            continue
+        path = os.path.join(paths["claimed"], name)
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            continue  # raced away
+        k = attempts.get(name, 0)
+        if k >= max_reclaims:
+            try:
+                os.replace(path, os.path.join(paths["dead"], name))
+            except OSError:
+                continue
+            emit([
+                EvalResult.failure(
+                    os.path.splitext(name)[0],
+                    f"dead-lettered after {k} reclaim attempts without a "
+                    "result (every claimant died mid-request)",
+                )
+            ])
+            continue
+        if age < timeout_s * (2 ** k):
+            continue
+        try:
+            os.replace(path, os.path.join(paths["inbox"], name))
+        except OSError:
+            continue
+        attempts[name] = k + 1
+        reclaimed += 1
+    return reclaimed
+
+
 def serve_file_queue(
     server: QBAServer,
     queue_dir: str,
     *,
     poll_s: float = 0.05,
     max_requests: int | None = None,
+    reclaim_timeout_s: float | None = None,
+    max_reclaims: int = 3,
 ) -> dict[str, Any]:
     """Drive ``server`` from ``queue_dir`` until the ``stop`` sentinel
     appears (or ``max_requests`` requests have been consumed); returns
-    the final stats (also written to ``summary.json``)."""
+    the final stats (also written to ``summary.json``).
+
+    Claim lifecycle: ``inbox/ -> claimed/`` (atomic rename at claim)
+    ``-> done/`` once the request's result lands in the outbox.  With
+    ``reclaim_timeout_s`` set, claims older than the (exponentially
+    backed-off) timeout that belong to no live consumer are pushed back
+    to the inbox — crash recovery for a worker killed mid-request —
+    with at most ``max_reclaims`` retries before dead-lettering
+    (:func:`_reclaim_stale`)."""
     paths = queue_paths(queue_dir)
-    for key in ("inbox", "claimed", "outbox"):
+    for key in ("inbox", "claimed", "done", "dead", "outbox"):
         os.makedirs(paths[key], exist_ok=True)
+
+    # request_id -> this process's claim file awaiting its result.
+    claim_of: dict[str, str] = {}
+    reclaim_attempts: dict[str, int] = {}
+    reclaimed_total = 0
+
+    def settle(name: str) -> None:
+        try:
+            os.replace(
+                os.path.join(paths["claimed"], name),
+                os.path.join(paths["done"], name),
+            )
+        except OSError:
+            pass  # already moved (e.g. reclaimed by a peer); result wins
 
     def emit(results: Iterable[EvalResult]) -> None:
         for res in results:
             _write_json(_result_path(paths["outbox"], res.request_id), res.to_json())
+            name = claim_of.pop(res.request_id, None)
+            if name is not None:
+                settle(name)
 
     seen = 0
     try:
         while True:
+            if reclaim_timeout_s is not None:
+                reclaimed_total += _reclaim_stale(
+                    paths, reclaim_attempts, set(claim_of.values()),
+                    reclaim_timeout_s, max_reclaims, emit,
+                )
             names = sorted(
                 n for n in os.listdir(paths["inbox"]) if n.endswith(".json")
             )
@@ -138,14 +233,16 @@ def serve_file_queue(
                     server.submit(req)
                 except ValueError as e:
                     emit([EvalResult.failure(os.path.splitext(name)[0], str(e))])
+                    settle(name)
                 else:
+                    claim_of[req.request_id] = name
                     emit(server.pump())
                 if max_requests is not None and seen >= max_requests:
                     emit(server.flush())
-                    return _finish(server, paths)
+                    return _finish(server, paths, reclaimed_total)
             if os.path.exists(paths["stop"]):
                 emit(server.flush())
-                return _finish(server, paths)
+                return _finish(server, paths, reclaimed_total)
             if not names:
                 # Quiet inbox: flush stragglers in partial chunks so a
                 # lone request is never stuck behind an unfilled chunk.
@@ -156,7 +253,10 @@ def serve_file_queue(
         emit(server.flush())
 
 
-def _finish(server: QBAServer, paths: dict[str, str]) -> dict[str, Any]:
+def _finish(
+    server: QBAServer, paths: dict[str, str], reclaimed: int = 0
+) -> dict[str, Any]:
     stats = server.stats()
+    stats["reclaimed"] = reclaimed
     _write_json(paths["summary"], stats)
     return stats

@@ -147,6 +147,106 @@ def _default_runner(chunk_trials: int, log: EventLog | None):
     return runner
 
 
+@dataclasses.dataclass(frozen=True)
+class SurfaceCell:
+    """One (strategy × noise × size_l) grid point of an adversary
+    surface, with the dispatch-decision manifest of the config that
+    actually ran (kernel-plan attribution per cell)."""
+
+    strategy: str
+    p_depolarize: float
+    p_measure_flip: float
+    size_l: int
+    result: SweepResult
+    manifest: dict[str, Any] | None = None
+
+
+def run_surface(
+    cfg: QBAConfig,
+    strategies: tuple[str, ...] | list[str],
+    noise_points: list[tuple[float, float]],
+    size_ls: list[int],
+    n_chunks: int = 1,
+    chunk_trials: int | None = None,
+    checkpoint_dir: str | None = None,
+    log: EventLog | None = None,
+    runner=None,
+    with_manifest: bool = True,
+) -> list[SurfaceCell]:
+    """The (strategy × noise × sizeL) adversary surface as ONE sharded
+    Monte-Carlo: every cell is a :func:`run_sweep` over the same runner
+    (dp-sharded over all visible devices when several are up — the
+    ``parallel.montecarlo`` path), so the whole grid shares key-tree
+    discipline, checkpoint format and placement independence.
+
+    ``noise_points`` are ``(p_depolarize, p_measure_flip)`` pairs.  With
+    ``checkpoint_dir``, each cell checkpoints to its own file (named by
+    the cell coordinates) and a re-run resumes cell-by-cell.  With
+    ``with_manifest``, each cell carries the dispatch-decision manifest
+    collected around its own run — per-cell kernel attribution, since
+    strategy changes the traced round program (forge-P is statically
+    gated) and size_l changes the block plan.
+    """
+    from qba_tpu.diagnostics import record_decisions
+    from qba_tpu.obs.manifest import collect_manifest
+
+    cells: list[SurfaceCell] = []
+    for strat in strategies:
+        for p_dep, p_mf in noise_points:
+            for size_l in size_ls:
+                cfg_cell = dataclasses.replace(
+                    cfg,
+                    strategy=strat,
+                    p_depolarize=p_dep,
+                    p_measure_flip=p_mf,
+                    size_l=size_l,
+                )
+                ckpt = None
+                if checkpoint_dir:
+                    os.makedirs(checkpoint_dir, exist_ok=True)
+                    ckpt = os.path.join(
+                        checkpoint_dir,
+                        f"surface_{strat}_p{p_dep}_q{p_mf}_L{size_l}.json",
+                    )
+                with record_decisions() as decisions:
+                    res = run_sweep(
+                        cfg_cell,
+                        n_chunks=n_chunks,
+                        chunk_trials=chunk_trials,
+                        checkpoint=ckpt,
+                        log=log,
+                        runner=runner,
+                    )
+                manifest = (
+                    collect_manifest(
+                        cfg_cell, command="surface", decisions=decisions
+                    )
+                    if with_manifest
+                    else None
+                )
+                cells.append(
+                    SurfaceCell(
+                        strategy=strat,
+                        p_depolarize=p_dep,
+                        p_measure_flip=p_mf,
+                        size_l=size_l,
+                        result=res,
+                        manifest=manifest,
+                    )
+                )
+                if log:
+                    log.info(
+                        "surface",
+                        "cell done",
+                        strategy=strat,
+                        p_depolarize=p_dep,
+                        p_measure_flip=p_mf,
+                        size_l=size_l,
+                        success_rate=res.success_rate,
+                    )
+    return cells
+
+
 def run_sweep(
     cfg: QBAConfig,
     n_chunks: int,

@@ -50,11 +50,15 @@ def test_clean_tree_zero_findings():
 
 def test_lint_matrix_covers_planner_phases():
     labels = [label for label, _ in lint_configs()]
-    assert labels == ["cheap", "north-star", "f32-gdt", "stabilizer"]
+    assert labels == [
+        "cheap", "north-star", "f32-gdt", "stabilizer", "split-strategy"
+    ]
     # The stabilizer point pins the batched GF(2) resource path.
     assert any(
         c.qsim_path == "stabilizer" for _, c in lint_configs()
     )
+    # The split point pins the FORGE_P effect path through the gates.
+    assert any(c.strategy == "split" for _, c in lint_configs())
     # The north-star point is the calibration anchor; losing it from
     # the matrix silently drops the HBM-band check.
     assert (33, 64, 10) in [

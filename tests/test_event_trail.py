@@ -113,6 +113,56 @@ class TestEventGrammar:
         assert "success" in res
 
 
+class TestEffectTable:
+    def test_effect_table_shared_across_backends(self):
+        # One attack-edit vocabulary, everywhere: the jax-free
+        # multiprocessing party mirrors the adversary table verbatim,
+        # and the local/native trail renderers ARE the shared function.
+        from qba_tpu import adversary
+        from qba_tpu.backends import local_backend, mp_party, native_backend
+
+        assert mp_party._EFFECTS == adversary.EFFECT_NAMES
+        assert local_backend.effect_names is adversary.effect_names
+        assert native_backend.effect_names is adversary.effect_names
+        for bits in range(32):  # every combination of the 5 edit bits
+            assert mp_party._effect_names(bits) == adversary.effect_names(
+                bits
+            ), bits
+
+    def test_effect_table_covers_every_strategy_edit(self):
+        from qba_tpu.adversary import (
+            CLEAR_L_BIT,
+            CLEAR_P_BIT,
+            DROP_BIT,
+            EFFECT_NAMES,
+            FORGE_BIT,
+            FORGE_P_BIT,
+            effect_names,
+        )
+
+        assert [b for b, _ in EFFECT_NAMES] == [
+            DROP_BIT, FORGE_BIT, CLEAR_P_BIT, CLEAR_L_BIT, FORGE_P_BIT,
+        ]
+        assert effect_names(FORGE_P_BIT | FORGE_BIT) == "corrupt-v+forge-P"
+        assert effect_names(0) == "none"
+
+    def test_split_trail_renders_forge_p(self):
+        # The split strategy's signature edit must surface in the local
+        # backend's event trail under its table name.
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=2, trials=64,
+            strategy="split",
+        )
+        key = _find_key(cfg, lambda h: (~h[2:]).any())
+        log, _ = _trail(cfg, key)
+        actions = {
+            e.fields.get("action")
+            for e in log.events
+            if e.phase == "round" and e.message == "attack"
+        }
+        assert any("forge-P" in a for a in actions if a), actions
+
+
 class TestCLITrail:
     def test_run_verbose_local_prints_trail_and_jsonl(self, tmp_path):
         from qba_tpu.cli import main

@@ -1,0 +1,190 @@
+"""Imperfect quantum resources (qba_tpu.qsim.noise, ISSUE PR 9).
+
+Contract layers, mirroring tests/test_gf2.py:
+
+* **Zero-noise gating** — ``p_depolarize = p_measure_flip = 0.0`` is
+  *byte-identical* to the pre-noise sampler on every path (the noise
+  branch is statically gated on Python floats and never traced).
+* **Bit-identity differentials** — the two stabilizer engines (per-shot
+  tableau and batched GF(2)) share one ``noise_draws`` stream per shot
+  key, so their noisy outputs must match bit for bit; likewise the two
+  protocol list-generation paths on the stabilizer impl.
+* **Statistical cross-checks** — the classical reduction's flip rate
+  matches the closed-form channel rate, and dense-vs-stabilizer outcome
+  distributions agree under noise (chi-square; the classical-reduction
+  and phase-injection implementations are exact realizations of the
+  SAME channel, so only sampling noise separates them).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.gf2 import build_gf2_tableau_run_batch
+from qba_tpu.qsim.noise import (
+    classical_flip_ints,
+    classical_flips,
+    classical_flips_shots,
+    noise_draws,
+)
+from qba_tpu.qsim.protocol_circuits import (
+    gen_q_corr_circuit,
+    generate_lists_dense,
+    generate_lists_stabilizer,
+)
+from qba_tpu.qsim.sampler import generate_lists
+from qba_tpu.qsim.stabilizer import build_tableau_run
+
+P, Q = 0.08, 0.03  # channel strengths shared by the tests below
+
+
+def pflip(p=P, q=Q):
+    """Closed-form outcome-bit flip rate: X/Y component (2p/3) XOR the
+    readout flip (q)."""
+    px = 2.0 * p / 3.0
+    return px * (1 - q) + q * (1 - px)
+
+
+class TestZeroNoiseGating:
+    def test_factorized_sampler_unchanged_at_zero(self):
+        cfg = QBAConfig(n_parties=5, size_l=64, n_dishonest=1)
+        cfg0 = dataclasses.replace(cfg, p_depolarize=0.0, p_measure_flip=0.0)
+        key = jax.random.key(9)
+        a, qa = generate_lists(cfg, key)
+        b, qb = generate_lists(cfg0, key)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(qa), np.asarray(qb))
+
+    def test_tableau_build_at_zero_is_noiseless_build(self):
+        circ = gen_q_corr_circuit(3, 2)
+        run0 = build_tableau_run(circ.n_qubits, tuple(circ.ops), circ.n_params)
+        runz = build_tableau_run(
+            circ.n_qubits, tuple(circ.ops), circ.n_params, 0.0, 0.0
+        )
+        params = jnp.zeros((circ.n_params,), jnp.int32)
+        for seed in range(4):
+            k = jax.random.key(seed)
+            np.testing.assert_array_equal(
+                np.asarray(run0(k, params)), np.asarray(runz(k, params))
+            )
+
+
+class TestStabilizerBitIdentity:
+    def test_gf2_batch_matches_per_shot_tableau_under_noise(self):
+        # The two stabilizer engines consume the same noise_draws per
+        # shot key — their bit-identity contract extends to noisy runs.
+        circ = gen_q_corr_circuit(3, 2)
+        n = circ.n_qubits
+        run1 = build_tableau_run(n, tuple(circ.ops), circ.n_params, P, Q)
+        runb = build_gf2_tableau_run_batch(
+            n, tuple(circ.ops), circ.n_params, P, Q
+        )
+        keys = jax.random.split(jax.random.key(17), 32)
+        params = jax.random.randint(
+            jax.random.key(18), (32, circ.n_params), 0, 2, dtype=jnp.int32
+        )
+        batch = runb(keys, params)
+        single = jax.vmap(run1)(keys, params)
+        np.testing.assert_array_equal(np.asarray(batch), np.asarray(single))
+
+    def test_protocol_list_paths_bit_identical_under_noise(self):
+        cfg = QBAConfig(
+            n_parties=3, size_l=16, n_dishonest=1,
+            p_depolarize=P, p_measure_flip=Q,
+        )
+        key = jax.random.key(4)
+        la, qa = generate_lists_stabilizer(cfg, key)
+        lb, qb = generate_lists_dense(cfg, key, impl="stabilizer")
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        np.testing.assert_array_equal(np.asarray(qa), np.asarray(qb))
+
+
+class TestChannelLaws:
+    def test_noise_perturbs_but_stays_in_value_domain(self):
+        cfg = QBAConfig(
+            n_parties=5, size_l=256, n_dishonest=1,
+            p_depolarize=P, p_measure_flip=Q,
+        )
+        key = jax.random.key(12)
+        noisy, _ = generate_lists(cfg, key)
+        clean, _ = generate_lists(
+            dataclasses.replace(cfg, p_depolarize=0.0, p_measure_flip=0.0),
+            key,
+        )
+        noisy, clean = np.asarray(noisy), np.asarray(clean)
+        assert ((noisy >= 0) & (noisy < cfg.w)).all()
+        assert (noisy != clean).any()
+
+    def test_classical_reduction_flip_rate(self):
+        flips = np.asarray(
+            classical_flips_shots(jax.random.key(3), 4000, 16, P, Q)
+        )
+        rate = flips.mean()
+        exp = pflip()
+        # Bernoulli CI at 64k draws: ~4 sigma half-width below.
+        assert abs(rate - exp) < 4.5 * np.sqrt(exp * (1 - exp) / flips.size)
+
+    def test_flip_ints_consistent_with_flip_vector(self):
+        # The packed-int form is exactly the bit-vector form of the same
+        # key, big-endian — the factorized sampler and the dense engines
+        # realize one channel, not two.
+        key = jax.random.key(5)
+        ints = np.asarray(classical_flip_ints(key, (), 8, P, Q))
+        vec = np.asarray(classical_flips(key, 8, P, Q))
+        assert ints == int("".join(map(str, vec)), 2)
+
+    def test_noise_draw_components_are_valid_paulis(self):
+        bx, bz, mflip = noise_draws(jax.random.key(1), 5000, P, Q)
+        bx, bz, mflip = (np.asarray(v) for v in (bx, bz, mflip))
+        assert set(np.unique(bx)) <= {0, 1}
+        assert set(np.unique(bz)) <= {0, 1}
+        # P(any Pauli) = p, split uniformly over X/Y/Z.
+        any_pauli = (bx | bz).mean()
+        assert abs(any_pauli - P) < 4.5 * np.sqrt(P * (1 - P) / bx.size)
+        assert abs(mflip.mean() - Q) < 4.5 * np.sqrt(Q * (1 - Q) / bx.size)
+
+    @pytest.mark.slow
+    def test_dense_vs_stabilizer_distributional_under_noise(self):
+        # Classical reduction (dense) vs tableau-phase injection
+        # (stabilizer): exact realizations of the same channel, so the
+        # outcome-pattern histograms must agree up to sampling noise
+        # (two-sample chi-square at significance 1e-4).
+        circ = gen_q_corr_circuit(2, 2)  # 6 qubits, 64 patterns
+        shots = 4096
+        params = jnp.asarray([0, 1, 1, 0], jnp.int32)
+        run_d = circ.compile_shots("xla", P, Q)
+        run_s = circ.compile_shots("stabilizer", P, Q)
+        bits_d = np.asarray(run_d(jax.random.key(40), shots, params))
+        bits_s = np.asarray(run_s(jax.random.key(41), shots, params))
+        weights = 1 << np.arange(circ.n_qubits - 1, -1, -1)
+        pats_d = bits_d @ weights
+        pats_s = bits_s @ weights
+        table = np.stack([
+            np.bincount(pats_d, minlength=64),
+            np.bincount(pats_s, minlength=64),
+        ])
+        table = table[:, table.sum(axis=0) > 0]
+        assert stats.chi2_contingency(table).pvalue > 1e-4
+
+
+class TestEndToEnd:
+    def test_noise_flows_through_trial_and_degrades_agreement(self):
+        # All-honest runs succeed deterministically when noiseless; under
+        # heavy readout noise the parties' lists decohere and the
+        # success rate must drop measurably.
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=0, trials=32,
+                        seed=2)
+        from qba_tpu.backends.jax_backend import run_trials, trial_keys
+
+        clean = run_trials(cfg, trial_keys(cfg))
+        assert float(clean.success_rate) == 1.0
+        noisy_cfg = dataclasses.replace(
+            cfg, p_depolarize=0.3, p_measure_flip=0.2
+        )
+        noisy = run_trials(noisy_cfg, trial_keys(noisy_cfg))
+        assert float(noisy.success_rate) < 1.0

@@ -333,6 +333,182 @@ def test_set_resolve_cache_cap_rejects_nonpositive():
         set_resolve_cache_cap(0)
 
 
+# ---- crash recovery: stale-claim reclaim + wall-clock deadline ---------
+
+
+def _queue_dirs(tmp_path):
+    import os
+
+    qdir = tmp_path / "q"
+    for d in ("inbox", "claimed", "done", "dead", "outbox"):
+        os.makedirs(qdir / d)
+    return qdir
+
+
+def _inject_stale_claim(qdir, rid, age_s=3600.0, **kw):
+    """A claim file left behind by a worker killed mid-request."""
+    import os
+    import time
+
+    req = _req(rid, **kw)
+    path = qdir / "claimed" / f"{rid}.json"
+    path.write_text(json.dumps(req.to_json()))
+    old = time.time() - age_s
+    os.utime(path, (old, old))
+    return req
+
+
+def test_stale_claim_reclaimed_and_served(tmp_path):
+    # A request claimed by a dead worker must be pushed back to the
+    # inbox and served to completion — bit-identical to a direct run.
+    from qba_tpu.serve.transport import serve_file_queue
+
+    qdir = _queue_dirs(tmp_path)
+    req = _inject_stale_claim(qdir, "stale0", trials=3, seed=6)
+    server = QBAServer(chunk_trials=4)
+    stats = serve_file_queue(
+        server, str(qdir), poll_s=0.01, max_requests=1,
+        reclaim_timeout_s=1.0,
+    )
+    assert stats["reclaimed"] == 1
+    res = EvalResult.from_json(
+        json.loads((qdir / "outbox" / "stale0.json").read_text())
+    )
+    assert res.error is None
+    direct = run_trials(req.config(), trial_keys(req.config()))
+    assert res.success == [bool(x) for x in np.asarray(direct.trials.success)]
+    # Claim lifecycle: settled to done/, nothing left in claimed/.
+    assert (qdir / "done" / "stale0.json").exists()
+    assert not (qdir / "claimed" / "stale0.json").exists()
+
+
+def test_fresh_claim_left_alone(tmp_path):
+    # A claim younger than the timeout belongs to a live worker — the
+    # reclaimer must not steal it.
+    from qba_tpu.serve.transport import serve_file_queue
+
+    qdir = _queue_dirs(tmp_path)
+    _inject_stale_claim(qdir, "young0", age_s=0.0, trials=2)
+    (qdir / "stop").touch()
+    stats = serve_file_queue(
+        QBAServer(chunk_trials=4), str(qdir), poll_s=0.01,
+        reclaim_timeout_s=3600.0,
+    )
+    assert stats["reclaimed"] == 0
+    assert (qdir / "claimed" / "young0.json").exists()
+    assert not (qdir / "outbox" / "young0.json").exists()
+
+
+def test_poison_claim_dead_lettered_with_structured_error(tmp_path):
+    # After max_reclaims attempts the claim is quarantined in dead/ and
+    # the outbox gets a structured error result — never an infinite
+    # reclaim loop.
+    from qba_tpu.serve.transport import serve_file_queue
+
+    qdir = _queue_dirs(tmp_path)
+    _inject_stale_claim(qdir, "poison0", trials=2)
+    (qdir / "stop").touch()
+    stats = serve_file_queue(
+        QBAServer(chunk_trials=4), str(qdir), poll_s=0.01,
+        reclaim_timeout_s=1.0, max_reclaims=0,
+    )
+    assert stats["reclaimed"] == 0
+    res = EvalResult.from_json(
+        json.loads((qdir / "outbox" / "poison0.json").read_text())
+    )
+    assert res.error and "dead-lettered" in res.error
+    assert (qdir / "dead" / "poison0.json").exists()
+    assert not (qdir / "claimed" / "poison0.json").exists()
+
+
+def test_reclaim_backoff_is_exponential(tmp_path):
+    # k-th reclaim needs age >= timeout * 2**k: after one reclaim, a
+    # claim of the same age is NOT immediately reclaimable again.
+    from qba_tpu.serve.transport import _reclaim_stale, queue_paths
+
+    qdir = _queue_dirs(tmp_path)
+    _inject_stale_claim(qdir, "b0", age_s=1.5)
+    paths = queue_paths(str(qdir))
+    attempts: dict[str, int] = {}
+    emitted: list = []
+    n1 = _reclaim_stale(paths, attempts, set(), 1.0, 3, emitted.extend)
+    assert n1 == 1 and attempts["b0.json"] == 1
+    # Back in claimed/ at the same age: next threshold is 2.0s > 1.5s.
+    (qdir / "inbox" / "b0.json").rename(qdir / "claimed" / "b0.json")
+    import os
+    import time
+
+    old = time.time() - 1.5
+    os.utime(qdir / "claimed" / "b0.json", (old, old))
+    n2 = _reclaim_stale(paths, attempts, set(), 1.0, 3, emitted.extend)
+    assert n2 == 0 and not emitted
+
+
+def test_deadline_expiry_returns_structured_error_with_manifest():
+    import time
+
+    server = QBAServer(chunk_trials=4, deadline_s=0.01)
+    server.submit(_req("dl0", trials=4))
+    time.sleep(0.05)
+    results = server.pump() + server.flush()
+    [res] = [r for r in results if r.request_id == "dl0"]
+    assert res.error and "deadline exceeded" in res.error
+    validate_manifest(res.manifest)
+    assert res.manifest["expired"] is True
+    assert res.manifest["trials_completed"] == 0
+    assert server.stats()["expired"] == 1
+    # The scheduler holds no orphaned trials for the expired request.
+    assert server.scheduler.pending_trials() == 0
+
+
+def test_per_request_deadline_overrides_server_default():
+    import time
+
+    server = QBAServer(chunk_trials=4)  # no server-wide deadline
+    server.submit(_req("fast", trials=2))
+    server.submit(_req("slow", trials=2, deadline_s=0.01))
+    time.sleep(0.05)
+    by_id = {r.request_id: r for r in server.pump() + server.flush()}
+    assert by_id["slow"].error and "deadline exceeded" in by_id["slow"].error
+    assert by_id["fast"].error is None
+    assert len(by_id["fast"].success) == 2
+
+
+def test_server_rejects_nonpositive_deadline():
+    with pytest.raises(ValueError, match="deadline_s"):
+        QBAServer(chunk_trials=4, deadline_s=0.0)
+
+
+def test_strategy_and_noise_split_buckets():
+    # Strategy / noise knobs are part of the bucket identity (different
+    # compiled programs must never share a bucket), and the strategy is
+    # surfaced in the label.
+    from qba_tpu.serve.scheduler import bucket_label
+
+    base = QBAConfig(5, 8, 1, trials=7, seed=42)
+    split = dataclasses.replace(base, strategy="split")
+    noisy = dataclasses.replace(base, p_depolarize=0.05)
+    assert bucket_config(base, 64) != bucket_config(split, 64)
+    assert bucket_config(base, 64) != bucket_config(noisy, 64)
+    assert bucket_label(bucket_config(split, 64)).endswith("-split")
+
+
+def test_scheduler_cancel_removes_only_target_request():
+    sched = BucketScheduler(8)
+    cfg = QBAConfig(4, 8, 1, trials=4)
+    rng = np.random.default_rng(0)
+    for rid in ("keep", "drop"):
+        sched.enqueue(
+            rid, cfg,
+            rng.integers(0, 2**32, size=(4, 2), dtype=np.uint32),
+        )
+    assert sched.cancel("drop") == 4
+    assert sched.cancel("drop") == 0
+    assert sched.pending_trials() == 4
+    chunk = sched.next_chunk()
+    assert {s.request_id for s in chunk.segments} == {"keep"}
+
+
 # ---- latency summary ---------------------------------------------------
 
 

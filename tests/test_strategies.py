@@ -1,0 +1,335 @@
+"""Adversary strategy zoo (qba_tpu.adversary.model, ISSUE PR 9).
+
+Four layers of contract:
+
+* **Baseline pin** — ``strategy="reference"`` with zero noise is
+  *bit-identical to historical outputs*: hardcoded golden success /
+  decision vectors (computed on the pre-zoo code) must keep
+  reproducing, on every round engine.  Any drift in the reference key
+  tree — a new fold_in, a reordered draw — breaks these.
+* **Distributional laws** — per-strategy chi-square tests of the
+  sampled action/value laws at significance 1e-4 (the style of the
+  reference-law tests in tests/test_adversary.py), at 5p and 11p.
+* **Cross-engine / cross-backend bit-identity** — every strategy is
+  expressed as the same effective-edit arrays from
+  ``sample_attacks_round``, so the vectorized engines and the
+  message-level local backend must agree trial for trial.
+* **Loud validation** — unknown strategies, out-of-range noise
+  probabilities, and forged values that could leave ``[0, w)`` raise
+  ``ValueError`` instead of silently shifting verdicts.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats
+
+from qba_tpu.adversary import (
+    CLEAR_L_BIT,
+    CLEAR_P_BIT,
+    DROP_BIT,
+    FORGE_BIT,
+    FORGE_P_BIT,
+    STRATEGIES,
+    adversary_ctx,
+    commander_orders,
+    sample_attacks_round,
+)
+from qba_tpu.backends import run_trial_local
+from qba_tpu.backends.jax_backend import run_trials, trial_keys
+from qba_tpu.config import QBAConfig
+from qba_tpu.rounds import run_trial
+
+P = 1e-4  # chi-square significance shared by every law test
+
+
+def _ctx_draws(cfg, key, round_idx=1, v_sent=None):
+    """(attack, rand_v) under ``cfg.strategy`` with a per-key ctx."""
+    if v_sent is None:
+        v_sent = jnp.zeros((cfg.n_lieutenants,), jnp.int32)
+    ctx = adversary_ctx(cfg, key, v_sent)
+    att, rv, _ = sample_attacks_round(cfg, key, round_idx, ctx)
+    return att, rv
+
+
+# ---- baseline pin ------------------------------------------------------
+
+# Golden outputs of the PRE-ZOO reference implementation (computed on
+# the commit introducing the strategy field; the reference path adds no
+# key-tree folds, so these must never move again).
+GOLD_5P = QBAConfig(n_parties=5, size_l=16, n_dishonest=2, trials=6, seed=2026)
+GOLD_5P_SUCCESS = [False, True, True, False, True, False]
+GOLD_5P_DECISIONS = [
+    [5, 0, 5, 0, 5], [6, 6, 6, 6, 6], [4, 4, 4, 4, 4],
+    [7, 3, 2, 2, 2], [1, 0, 0, 0, 0], [4, 2, 4, 2, 2],
+]
+GOLD_11P = QBAConfig(n_parties=11, size_l=8, n_dishonest=3, trials=4, seed=77)
+GOLD_11P_SUCCESS = [True, False, True, False]
+GOLD_11P_DECISIONS = [
+    [2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2],
+    [7, 0, 0, 1, 1, 0, 0, 0, 0, 0, 0],
+    [9, 9, 9, 9, 9, 9, 9, 9, 9, 9, 9],
+    [6, 15, 15, 15, 15, 15, 2, 2, 2, 2, 2],
+]
+
+
+class TestReferenceBaselinePin:
+    @pytest.mark.parametrize(
+        "cfg, success, decisions",
+        [
+            (GOLD_5P, GOLD_5P_SUCCESS, GOLD_5P_DECISIONS),
+            (GOLD_11P, GOLD_11P_SUCCESS, GOLD_11P_DECISIONS),
+        ],
+        ids=["5p", "11p"],
+    )
+    def test_reference_zero_noise_matches_golden(self, cfg, success, decisions):
+        assert cfg.strategy == "reference"
+        assert cfg.p_depolarize == 0.0 and cfg.p_measure_flip == 0.0
+        mc = run_trials(cfg, trial_keys(cfg))
+        assert [bool(x) for x in np.asarray(mc.trials.success)] == success
+        assert np.asarray(mc.trials.decisions).tolist() == decisions
+
+    @pytest.mark.slow
+    def test_golden_holds_on_every_round_engine(self):
+        keys = trial_keys(GOLD_5P)
+        for engine in ("xla", "pallas", "pallas_tiled", "pallas_fused"):
+            ecfg = dataclasses.replace(GOLD_5P, round_engine=engine)
+            mc = jax.jit(jax.vmap(lambda k, c=ecfg: run_trial(c, k)))(keys)
+            assert (
+                [bool(x) for x in np.asarray(mc.success)] == GOLD_5P_SUCCESS
+            ), engine
+            assert (
+                np.asarray(mc.decisions).tolist() == GOLD_5P_DECISIONS
+            ), engine
+
+
+# ---- distributional laws ----------------------------------------------
+
+
+class TestColludeLaw:
+    CFG = QBAConfig(n_parties=11, size_l=4, n_dishonest=3, strategy="collude")
+
+    def test_one_shared_target_per_trial(self):
+        for seed in range(8):
+            att, rv = _ctx_draws(self.CFG, jax.random.key(seed))
+            assert len(np.unique(np.asarray(rv))) == 1  # ONE value everywhere
+
+    def test_target_uniform_over_reference_range(self):
+        keys = jax.random.split(jax.random.key(0), 3000)
+        v0 = jnp.zeros((self.CFG.n_lieutenants,), jnp.int32)
+        targets = jax.vmap(
+            lambda k: adversary_ctx(self.CFG, k, v0).collude_target
+        )(keys)
+        obs = np.bincount(np.asarray(targets), minlength=self.CFG.n_parties + 1)
+        assert stats.chisquare(obs).pvalue > P
+
+    def test_action_stream_bit_identical_to_reference(self):
+        # Collusion only redirects the forged VALUE; the action bitmask
+        # must stay byte-for-byte the reference law (same _ATTACK_TAG
+        # stream), so flipping a study to collude perturbs nothing else.
+        ref = dataclasses.replace(self.CFG, strategy="reference")
+        for seed in range(4):
+            k = jax.random.key(seed)
+            att_c, _ = _ctx_draws(self.CFG, k)
+            att_r, _, _ = sample_attacks_round(ref, k)
+            np.testing.assert_array_equal(np.asarray(att_c), np.asarray(att_r))
+
+
+class TestAdaptiveLaw:
+    CFG = QBAConfig(n_parties=5, size_l=4, n_dishonest=2, strategy="adaptive")
+
+    def _bits(self, round_idx, n_keys=64):
+        v_sent = jnp.arange(self.CFG.n_lieutenants, dtype=jnp.int32) % self.CFG.w
+        keys = jax.random.split(jax.random.key(round_idx), n_keys)
+        att, rv = jax.vmap(
+            lambda k: _ctx_draws(self.CFG, k, round_idx, v_sent)
+        )(keys)
+        return np.asarray(att).ravel(), np.asarray(rv), v_sent
+
+    def test_early_rounds_drop_heavy(self):
+        # 2 * round <= n_rounds: drop 1/2, the other four outcomes 1/8.
+        assert 2 * 1 <= self.CFG.n_rounds
+        bits, _, _ = self._bits(round_idx=1)
+        obs = np.array([
+            (bits == b).sum()
+            for b in (0, DROP_BIT, FORGE_BIT, CLEAR_P_BIT, CLEAR_L_BIT)
+        ])
+        assert obs.sum() == bits.size
+        exp = bits.size * np.array([1 / 8, 1 / 2, 1 / 8, 1 / 8, 1 / 8])
+        assert stats.chisquare(obs, exp).pvalue > P
+
+    def test_late_rounds_forge_heavy(self):
+        last = self.CFG.n_rounds
+        assert 2 * last > self.CFG.n_rounds
+        bits, _, _ = self._bits(round_idx=last)
+        obs = np.array([
+            (bits == b).sum()
+            for b in (0, DROP_BIT, FORGE_BIT, CLEAR_P_BIT, CLEAR_L_BIT)
+        ])
+        exp = bits.size * np.array([1 / 8, 1 / 8, 1 / 2, 1 / 8, 1 / 8])
+        assert stats.chisquare(obs, exp).pvalue > P
+
+    def test_forged_value_never_received_value_and_in_domain(self):
+        w = self.CFG.w
+        _, rv, v_sent = self._bits(round_idx=self.CFG.n_rounds)
+        senders = np.arange(rv.shape[1]) // self.CFG.slots
+        v_recv = np.asarray(v_sent)[senders][None, :, None]
+        assert ((rv >= 0) & (rv < w)).all()
+        assert (rv != v_recv).all()
+        # offset = (rand_v - v_recv) mod w uniform over [1, w).
+        offs = ((rv - v_recv) % w).ravel()
+        obs = np.bincount(offs, minlength=w)
+        assert obs[0] == 0
+        assert stats.chisquare(obs[1:]).pvalue > P
+
+
+class TestSplitLaw:
+    CFG = QBAConfig(n_parties=5, size_l=4, n_dishonest=2, strategy="split")
+
+    def test_effective_bit_multinomial(self):
+        # action 0 -> FORGE_P (1/4); 1 -> FORGE_P+FORGE (1/4);
+        # 2 -> CLEAR_L (1/4); 3 -> drop w.p. 1/2 (1/8 drop, 1/8 clean).
+        keys = jax.random.split(jax.random.key(3), 64)
+        att = np.concatenate([
+            np.asarray(sample_attacks_round(self.CFG, k)[0]).ravel()
+            for k in keys
+        ])
+        support = (FORGE_P_BIT, FORGE_P_BIT | FORGE_BIT, CLEAR_L_BIT,
+                   DROP_BIT, 0)
+        obs = np.array([(att == b).sum() for b in support])
+        assert obs.sum() == att.size  # nothing outside the split support
+        exp = att.size * np.array([1 / 4, 1 / 4, 1 / 4, 1 / 8, 1 / 8])
+        assert stats.chisquare(obs, exp).pvalue > P
+
+    def test_p_is_inflated_never_cleared(self):
+        for seed in range(6):
+            att, _, _ = sample_attacks_round(self.CFG, jax.random.key(seed))
+            assert not bool(jnp.any(att & CLEAR_P_BIT))
+
+    def test_commander_equivocates_by_rank_parity(self):
+        cfg = QBAConfig(n_parties=11, size_l=4, strategy="split")
+        for seed in range(12):
+            v_sent, _ = commander_orders(
+                cfg, jax.random.key(seed), jnp.asarray(False)
+            )
+            vs = np.asarray(v_sent)  # lieutenants at ranks 2..n_parties
+            even, odd = vs[0::2], vs[1::2]  # rank parity partition
+            assert len(set(even)) == 1 and len(set(odd)) == 1
+            assert even[0] != odd[0]
+
+    def test_honest_commander_unaffected_by_strategy(self):
+        cfg = QBAConfig(n_parties=11, size_l=4, strategy="split")
+        ref = dataclasses.replace(cfg, strategy="reference")
+        for seed in range(4):
+            k = jax.random.key(seed)
+            vs_s, v_s = commander_orders(cfg, k, jnp.asarray(True))
+            vs_r, v_r = commander_orders(ref, k, jnp.asarray(True))
+            np.testing.assert_array_equal(np.asarray(vs_s), np.asarray(vs_r))
+            assert int(v_s) == int(v_r)
+
+
+# ---- cross-engine / cross-backend bit-identity -------------------------
+
+ZOO_CONFIGS = [
+    QBAConfig(n_parties=5, size_l=16, n_dishonest=2, trials=8, seed=21,
+              strategy="collude"),
+    QBAConfig(n_parties=5, size_l=16, n_dishonest=2, trials=8, seed=22,
+              strategy="adaptive"),
+    QBAConfig(n_parties=5, size_l=16, n_dishonest=2, trials=8, seed=23,
+              strategy="split"),
+]
+
+
+@pytest.mark.parametrize("cfg", ZOO_CONFIGS, ids=lambda c: c.strategy)
+def test_local_backend_agrees_per_trial(cfg):
+    # Message-level local backend vs vectorized jax engine: the same
+    # differential as tests/test_differential.py, per strategy.
+    keys = jax.random.split(jax.random.key(cfg.seed), cfg.trials)
+    mc = run_trials(cfg, keys)
+    for t in range(cfg.trials):
+        local = run_trial_local(cfg, keys[t])
+        assert mc.trials.decisions[t].tolist() == local["decisions"], t
+        assert bool(mc.trials.success[t]) == local["success"], t
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("strategy", ["collude", "adaptive", "split"])
+def test_round_engines_bit_identical_per_strategy(strategy):
+    cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=2, trials=4,
+                    seed=31, strategy=strategy)
+    keys = jax.random.split(jax.random.key(cfg.seed), cfg.trials)
+    outs = []
+    for engine in ("xla", "pallas", "pallas_tiled", "pallas_fused"):
+        ecfg = dataclasses.replace(cfg, round_engine=engine)
+        outs.append(jax.jit(jax.vmap(lambda k, c=ecfg: run_trial(c, k)))(keys))
+    base = outs[0]
+    for got in outs[1:]:
+        assert base.vi.tolist() == got.vi.tolist(), strategy
+        assert base.decisions.tolist() == got.decisions.tolist(), strategy
+        assert base.success.tolist() == got.success.tolist(), strategy
+        assert base.overflow.tolist() == got.overflow.tolist(), strategy
+
+
+def test_strategies_change_protocol_outcomes():
+    # Sanity on the POINT of the zoo: each non-reference strategy must
+    # actually shift per-trial outcomes for the same trial keys (the
+    # zoo is not a relabeling of the reference law).
+    cfg = QBAConfig(n_parties=5, size_l=8, n_dishonest=2, trials=64, seed=5)
+    ref = run_trials(cfg, trial_keys(cfg))
+    for strategy in ("collude", "adaptive", "split"):
+        got = run_trials(
+            dataclasses.replace(cfg, strategy=strategy), trial_keys(cfg)
+        )
+        assert (
+            got.trials.decisions.tolist() != ref.trials.decisions.tolist()
+        ), strategy
+
+
+# ---- loud validation ---------------------------------------------------
+
+
+class TestValidation:
+    def test_unknown_strategy_rejected(self):
+        with pytest.raises(ValueError, match="unknown strategy"):
+            QBAConfig(n_parties=5, size_l=8, n_dishonest=1, strategy="chaos")
+
+    @pytest.mark.parametrize("field", ["p_depolarize", "p_measure_flip"])
+    @pytest.mark.parametrize("value", [-0.1, 1.5])
+    def test_noise_probability_range_rejected(self, field, value):
+        with pytest.raises(ValueError, match=field):
+            QBAConfig(n_parties=5, size_l=8, n_dishonest=1, **{field: value})
+
+    def test_broadcast_scope_restricted_to_reference(self):
+        with pytest.raises(ValueError, match="broadcast"):
+            QBAConfig(n_parties=5, size_l=8, n_dishonest=1,
+                      strategy="collude", attack_scope="broadcast")
+
+    def test_stateful_strategies_demand_their_inputs(self):
+        cfg = QBAConfig(n_parties=5, size_l=8, n_dishonest=1,
+                        strategy="collude")
+        with pytest.raises(ValueError, match="ctx"):
+            sample_attacks_round(cfg, jax.random.key(0))
+        cfg = dataclasses.replace(cfg, strategy="adaptive")
+        with pytest.raises(ValueError, match="round_idx"):
+            sample_attacks_round(cfg, jax.random.key(0))
+
+    def test_forge_bound_outside_value_domain_rejected(self, monkeypatch):
+        # No built-in strategy can trip this (w >= n_parties + 1 by
+        # construction) — the guard exists for future strategies, so
+        # widen a bound artificially and demand the loud failure.
+        from qba_tpu.adversary import model
+
+        cfg = QBAConfig(n_parties=5, size_l=8, n_dishonest=1)
+        monkeypatch.setitem(
+            model.STRATEGY_FORGE_BOUND, "reference", lambda c: c.w + 1
+        )
+        with pytest.raises(ValueError, match="outside the value domain"):
+            sample_attacks_round(cfg, jax.random.key(0))
+
+    def test_strategy_tuple_is_the_config_contract(self):
+        assert set(STRATEGIES) == {"reference", "collude", "adaptive", "split"}
+        for s in STRATEGIES:
+            QBAConfig(n_parties=5, size_l=8, n_dishonest=1, strategy=s)
