@@ -39,7 +39,7 @@ BUCKETS = (
 )
 
 
-def make_stream(n_requests: int, trials: int):
+def make_stream(n_requests: int, trials: int, target: str | None = None):
     from qba_tpu.serve import EvalRequest
 
     return [
@@ -47,6 +47,7 @@ def make_stream(n_requests: int, trials: int):
             request_id=f"lg{i:04d}",
             trials=trials + (i % 3),  # varied sizes exercise chunk packing
             seed=17 * i + 1,
+            target=target,
             **BUCKETS[i % len(BUCKETS)],
         )
         for i in range(n_requests)
@@ -125,9 +126,20 @@ def main(argv=None):
     ap.add_argument("--cache-dir", default=None,
                     help="warm-start artifact directory")
     ap.add_argument("--timeout-s", type=float, default=600.0)
+    ap.add_argument("--target", default=None,
+                    help="precision target applied to every request "
+                    "(qba_tpu.stats.parse_target grammar, e.g. "
+                    "'decide vs 1/3 @ 95%%'); --trials becomes the "
+                    "budget ceiling and requests finish early once "
+                    "their stopping rule resolves")
+    ap.add_argument("--min-early-stop", type=int, default=0,
+                    help="fail unless at least this many targeted "
+                    "requests stopped before exhausting their budget "
+                    "(the CI stats job asserts the early-stop path "
+                    "actually exercised)")
     args = ap.parse_args(argv)
 
-    stream = make_stream(args.requests, args.trials)
+    stream = make_stream(args.requests, args.trials, target=args.target)
     if args.in_process:
         results, elapsed = run_in_process(args, stream)
     else:
@@ -156,7 +168,10 @@ def main(argv=None):
 
         want = [bool(x) for x in np.asarray(direct.trials.success)]
         got = by_id[req.request_id]["success"]
-        if got != want:
+        # Targeted requests may stop early; the served trials must then
+        # be a bit-identical *prefix* of the direct fixed-budget run
+        # (chunk keys are a pure function of seed + chunk index).
+        if got != want[: len(got)] or (args.target is None and len(got) != len(want)):
             raise SystemExit(f"bit-identity violation on {req.request_id}")
 
     # p50/p99 from the returned span data: latency_s IS each request's
@@ -176,6 +191,48 @@ def main(argv=None):
     print(f"latency p99:     {lat['p99_s'] * 1e3:.1f} ms")
     print(f"latency mean:    {lat['mean_s'] * 1e3:.1f} ms  "
           f"(min {lat['min_s'] * 1e3:.1f}, max {lat['max_s'] * 1e3:.1f})")
+
+    if args.target:
+        # Time-to-decision: for a targeted request the request span
+        # closes when its stopping rule resolves (or the budget runs
+        # out), so the same span durations ARE the decision latencies —
+        # summarize the decided subset separately from the full stream.
+        decided = [
+            r for r in results
+            if r.get("stop") and r["stop"]["reason"] != "budget_exhausted"
+        ]
+        # "Early" = decided with trials to spare in the budget.
+        early = [
+            r for r in decided
+            if r["n_trials"]
+            < next(q.trials for q in stream if q.request_id == r["request_id"])
+        ]
+        if decided:
+            dspans = [
+                types.SimpleNamespace(name="decision", dur=r["latency_s"])
+                for r in decided
+            ]
+            dlat = span_latency_summary(dspans, "decision")
+            saved = sum(
+                next(q.trials for q in stream
+                     if q.request_id == r["request_id"]) - r["n_trials"]
+                for r in decided
+            )
+            print(f"target:          {args.target!r}")
+            print(f"decided:         {len(decided)}/{len(results)} "
+                  f"({len(early)} early, {saved} budget trials saved)")
+            print(f"time-to-decision p50: {dlat['p50_s'] * 1e3:.1f} ms  "
+                  f"p99: {dlat['p99_s'] * 1e3:.1f} ms")
+        else:
+            print(f"target:          {args.target!r} (no request decided "
+                  "within budget)")
+        if len(early) < args.min_early_stop:
+            raise SystemExit(
+                f"only {len(early)} requests early-stopped "
+                f"(--min-early-stop {args.min_early_stop}): the "
+                "precision-target path was not exercised"
+            )
+
     print("manifests:       all valid; bit-identity spot check passed")
     return 0
 

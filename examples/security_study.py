@@ -41,7 +41,18 @@ def main() -> None:
         "--noise", default="0:0,0.02:0.01",
         help="comma list of p_depolarize:p_measure_flip pairs",
     )
+    ap.add_argument("--n-chunks", type=int, default=1,
+                    help="chunks per cell (per-cell budget ceiling in "
+                    "targeted mode)")
     ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--target", default=None,
+                    help="precision target (qba_tpu.stats grammar): the "
+                    "adaptive allocator spends chunks on the least-"
+                    "resolved cells first and each cell stops once its "
+                    "rule fires (docs/STATS.md)")
+    ap.add_argument("--budget-chunks", type=int, default=None,
+                    help="total chunk budget across all cells in "
+                    "targeted mode (default: n_chunks x n_cells)")
     ap.add_argument("--json", default=None, help="write the surface (with "
                     "per-cell manifests) as JSON")
     ap.add_argument("--plot", default=None, help="PNG of per-strategy "
@@ -73,21 +84,28 @@ def main() -> None:
         strategies=strategies,
         noise_points=noise_points,
         size_ls=size_ls,
-        n_chunks=1,
+        n_chunks=args.n_chunks,
         chunk_trials=trials,
         checkpoint_dir=args.checkpoint_dir,
+        target=args.target,
+        budget_chunks=args.budget_chunks,
     )
     for c in cells:
         plan = (c.manifest or {}).get("plan", {})
+        stop = ""
+        if c.result.stop is not None:
+            stop = f" stop={c.result.stop.reason}"
         print(
             f"strategy={c.strategy:9s} p={c.p_depolarize:.3f} "
             f"q={c.p_measure_flip:.3f} sizeL={c.size_l:4d}: "
             f"success_rate={c.result.success_rate:.4f} "
             f"({c.result.n_trials} trials, "
-            f"engine={plan.get('engine', '?')})"
+            f"engine={plan.get('engine', '?')}){stop}"
         )
 
     if args.json:
+        # Surface-with-error-bars: each cell's rate is the certified
+        # estimate object (rate/lo/hi, KI-8), never a bare float.
         payload = [
             {
                 "strategy": c.strategy,
@@ -95,7 +113,8 @@ def main() -> None:
                 "p_measure_flip": c.p_measure_flip,
                 "size_l": c.size_l,
                 "trials": c.result.n_trials,
-                "success_rate": c.result.success_rate,
+                "success_rate": c.result.stats_summary()["success_rate"],
+                "stop": c.result.stop.to_json() if c.result.stop else None,
                 "manifest": c.manifest,
             }
             for c in cells

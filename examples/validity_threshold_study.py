@@ -31,8 +31,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
-def run_point(cfg, total_trials: int, chunk: int):
-    """Accumulate success/honesty/decisions across chunked batches."""
+def run_point(cfg, total_trials: int, chunk: int, rule=None):
+    """Accumulate success/honesty/decisions across chunked batches.
+
+    With ``rule`` (a stopping rule from ``Target.make_rule()``) the
+    point runs in precision-targeted mode: chunks keep their fixed-
+    budget keys (so the targeted run is a bit-identical prefix of the
+    full one) but the loop exits as soon as the rule resolves on the
+    overall success rate.  Returns the trial arrays plus the
+    StopDecision (None in fixed-budget mode)."""
     import jax
 
     from qba_tpu.backends.jax_backend import fence, run_trials
@@ -40,21 +47,31 @@ def run_point(cfg, total_trials: int, chunk: int):
     succ, hon, dec, vc = [], [], [], []
     n_chunks = -(-total_trials // chunk)
     cfg_c = dataclasses.replace(cfg, trials=chunk)
+    stop = None
     for i in range(n_chunks):
         keys = jax.random.split(
             jax.random.key(cfg.seed * 1_000_003 + i), chunk
         )
         res = run_trials(cfg_c, keys)
         fence(res)
-        succ.append(np.asarray(res.trials.success))
+        s = np.asarray(res.trials.success)
+        succ.append(s)
         hon.append(np.asarray(res.trials.honest))
         dec.append(np.asarray(res.trials.decisions))
         vc.append(np.asarray(res.trials.v_comm))
+        if rule is not None:
+            rule.observe(int(s.sum()), int(s.size))
+            stop = rule.decision()
+            if stop is not None:
+                break
+    if rule is not None and stop is None:
+        stop = rule.exhausted()
     return (
         np.concatenate(succ),
         np.concatenate(hon),
         np.concatenate(dec),
         np.concatenate(vc),
+        stop,
     )
 
 
@@ -74,6 +91,15 @@ def main() -> None:
     ap.add_argument("--out", default="docs/assets")
     ap.add_argument("--quick", action="store_true",
                     help="tiny grid for CI/smoke (overrides the above)")
+    ap.add_argument(
+        "--target", default=None,
+        help="precision-targeted mode (qba_tpu.stats grammar, e.g. "
+        "'ci_width<=0.05 @ 95%%' or 'decide vs 1/3'): each grid point "
+        "stops as soon as its stopping rule resolves on the overall "
+        "success rate, with --trials as the budget ceiling; points "
+        "then carry an anytime-valid CI and a stop record "
+        "(docs/STATS.md)",
+    )
     args = ap.parse_args()
 
     from qba_tpu.compile_cache import enable_compile_cache
@@ -103,8 +129,13 @@ def main() -> None:
             # Chunk by pool footprint: sizeL=1000 at 10k trials would
             # blow the single-batch HBM ceiling (KI-2).
             chunk = min(trials, 2000 if L <= 256 else 500)
+            rule = None
+            if args.target:
+                from qba_tpu.stats import parse_target
+
+                rule = parse_target(args.target).make_rule()
             t0 = time.time()
-            succ, hon, dec, vc = run_point(cfg, trials, chunk)
+            succ, hon, dec, vc, stop = run_point(cfg, trials, chunk, rule)
             b = study_breakdown(succ, hon[:, 0])
             b["profile"] = decision_profile(dec, hon, vc, cfg.w)
             b.update(n_parties=n_p, n_dishonest=d, size_l=L,
@@ -112,26 +143,40 @@ def main() -> None:
                      p_depolarize=args.p_depolarize,
                      p_measure_flip=args.p_measure_flip,
                      trials=int(succ.size), seconds=round(time.time() - t0, 1))
+            if stop is not None:
+                # Error bars safe to read at the stopping time: the
+                # rule's own anytime-valid estimate, not the fixed-n
+                # Wilson bands the fixed-budget columns use.
+                b["stop"] = stop.to_json()
+                b["overall_anytime"] = rule.estimate().to_json()
             points.append(b)
             va, pr = b["validity"], b["profile"]
 
             def r(x, nd=4):  # a zero-honest-commander point has rate None
                 return "  n/a " if x["rate"] is None else f"{x['rate']:.{nd}f}"
 
+            tail = f"({va['n']} hc-trials, {b['seconds']}s)"
+            if stop is not None:
+                tail = (
+                    f"(stop={stop.reason} @ {stop.n_trials}/{trials} "
+                    f"trials, {b['seconds']}s)"
+                )
             print(
                 f"d={d} L={L:4d}: overall {r(b['overall'])}  "
                 f"validity {r(va)} [{va['lo']:.4f},{va['hi']:.4f}]  "
                 f"abort {r(pr['abort_all'], 3)} "
                 f"mixed {r(pr['mixed_valid_abort'], 3)} "
-                f"corrupt {r(pr['corrupted'], 3)} "
-                f"({va['n']} hc-trials, {b['seconds']}s)",
+                f"corrupt {r(pr['corrupted'], 3)} {tail}",
                 flush=True,
             )
 
     os.makedirs(args.out, exist_ok=True)
     json_path = os.path.join(args.out, "validity_study.json")
+    payload = {"n_parties": n_p, "points": points}
+    if args.target:
+        payload["target"] = args.target
     with open(json_path, "w") as f:
-        json.dump({"n_parties": n_p, "points": points}, f, indent=1)
+        json.dump(payload, f, indent=1)
     print("wrote", json_path)
 
     try:

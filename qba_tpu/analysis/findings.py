@@ -20,6 +20,9 @@ mechanizes (``docs/KNOWN_ISSUES.md``):
   a ``fenced`` telemetry span and without a ``qba-lint: sync-ok``
   annotation, or a violation of serve's double-buffer dispatch
   ordering (:mod:`qba_tpu.analysis.transfers`).
+* ``KI-8`` — an uncertified rate in a run manifest: a bare numeric
+  ``*_rate`` value with no accompanying confidence interval
+  (:mod:`qba_tpu.analysis.manifests`, docs/STATS.md).
 
 A *note* is an informational line the report carries alongside the
 findings (plan predictions, probe-counter reality checks) — notes
@@ -31,7 +34,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterable
 
-KI_TAGS = ("KI-1", "KI-2", "KI-3", "KI-5", "KI-6")
+KI_TAGS = ("KI-1", "KI-2", "KI-3", "KI-5", "KI-6", "KI-8")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -32,6 +32,7 @@ from typing import Sequence
 from qba_tpu.config import QBAConfig
 from qba_tpu.native import NativeUnavailableError
 from qba_tpu.obs.plots import PlottingUnavailableError
+from qba_tpu.stats.estimators import success_rate as _est_success_rate
 
 
 def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
@@ -250,6 +251,22 @@ def _parser() -> argparse.ArgumentParser:
         "DIR; per-chunk dispatch/readback spans nest under the sweep "
         "(docs/OBSERVABILITY.md)",
     )
+    sweep.add_argument(
+        "--target", metavar="SPEC", default=None,
+        help="precision target: run chunks until the stopping rule "
+        "resolves instead of the fixed --n-chunks budget.  SPEC is "
+        "'decide vs <p> [+-d] [@ NN%%]' (SPRT against threshold p, "
+        "fractions like 1/3 allowed) or 'ci_width<=<w> [@ NN%%]' "
+        "(anytime-valid CI width rule); --n-chunks becomes the budget "
+        "ceiling (docs/STATS.md)",
+    )
+    sweep.add_argument(
+        "--resume-force", action="store_true",
+        help="when the checkpoint's chunk_trials disagree with this "
+        "run's, discard it (with a QBACheckpointMismatch warning) and "
+        "re-chunk from scratch instead of erroring; a config "
+        "fingerprint mismatch is never forceable",
+    )
 
     lint = sub.add_parser(
         "lint",
@@ -280,6 +297,13 @@ def _parser() -> argparse.ArgumentParser:
         "host-sync discipline gate (jaxpr scan-carry/pallas alias "
         "chase + AST sweep of the hot modules + serve dispatch-order "
         "proof; docs/ANALYSIS.md)",
+    )
+    lint.add_argument(
+        "--manifests", action="append", default=None, metavar="GLOB",
+        help="also run the KI-8 manifest-CI audit over these run-"
+        "manifest JSON files (repeatable; globs allowed): every "
+        "*_rate/*_ratio value must be a certified estimate object "
+        "with lo/hi bounds, never a bare float (docs/STATS.md)",
     )
     lint.add_argument(
         "--findings-json", metavar="PATH", default=None,
@@ -485,7 +509,9 @@ def _run_impl(args: argparse.Namespace, cfg: QBAConfig, session, out) -> int:
                         overflow=np.asarray(r["overflow"]),
                     )
                     print(render_verdict(cfg, trial, index=i), file=out)
-            success_rate = successes / cfg.trials
+            # Single source of truth for empty-run semantics (nan on
+            # zero trials) — same helper sweep/serve report through.
+            success_rate = _est_success_rate(successes, cfg.trials)
         else:
             from qba_tpu.backends.jax_backend import fence, run_trials, trial_keys
 
@@ -781,6 +807,8 @@ def _cmd_sweep(args: argparse.Namespace, out) -> int:
             checkpoint=args.checkpoint,
             log=log,
             timers=timers,
+            target=args.target,
+            resume_force=args.resume_force,
         )
         # Wall time for throughput = dispatch + readback (the two phases
         # are disjoint: dispatch returns at async-enqueue, readback
@@ -790,6 +818,25 @@ def _cmd_sweep(args: argparse.Namespace, out) -> int:
             render_sweep(cfg, res.success_rate, res.n_trials, seconds),
             file=out,
         )
+        if res.stop is not None:
+            line = (
+                f"stop: {res.stop.reason} after {res.stop.n_trials} trials"
+            )
+            if res.stop.threshold is not None:
+                line += f" (threshold {res.stop.threshold:g})"
+            est = res.stop.estimate
+            if est is not None:
+                # The rule's own anytime-valid interval — safe to read
+                # at the data-dependent stopping time (docs/STATS.md).
+                line += (
+                    f"; {100 * est.confidence:g}% CI "
+                    f"[{est.lo:.4f}, {est.hi:.4f}]"
+                )
+            print(line, file=out)
+        if session is not None:
+            # Certified rates in the telemetry manifest (KI-8): the
+            # manifest states its own precision.
+            session.extra["stats"] = res.stats_summary()
         if res.any_overflow:
             print("(mailbox slot overflow occurred in some chunks)", file=out)
         if args.plot:
@@ -896,6 +943,10 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
     report = run_lint(
         configs=configs, engines=engines, effects=args.effects,
     )
+    if args.manifests:
+        from qba_tpu.analysis.manifests import check_manifest_files
+
+        report.extend(check_manifest_files(args.manifests))
     print(report.render(verbose=args.verbose), file=out)
     if args.findings_json:
         import dataclasses

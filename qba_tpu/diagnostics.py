@@ -53,6 +53,44 @@ class QBAProbeWarning(QBAWarning):
     verdict was deliberately not cached."""
 
 
+class QBACheckpointMismatch(QBAWarning, ValueError):
+    """A sweep checkpoint does not match the requested run.
+
+    Dual-natured by design: raised like the historical bare
+    ``ValueError`` (existing ``pytest.raises(ValueError, ...)`` pins
+    keep matching), but a ``QBAWarning`` family member so
+    ``--resume-force`` can *warn* with the same category when it
+    re-chunks instead of refusing.  Carries both fingerprints so
+    callers/tooling can diff exactly what disagreed.
+
+    ``kind`` is ``"config"`` (never forceable — the checkpointed trials
+    were drawn from a different program) or ``"chunk_trials"``
+    (forceable — same config, different chunking; re-running re-chunks
+    from scratch and overwrites).
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        # Optional so ``warnings.warn(msg, QBACheckpointMismatch)`` can
+        # instantiate the category from the message alone.
+        kind: str = "chunk_trials",
+        path: str = "",
+        checkpoint_fingerprint: Any = None,
+        requested_fingerprint: Any = None,
+    ):
+        super().__init__(message)
+        self.kind = kind
+        self.path = path
+        self.checkpoint_fingerprint = checkpoint_fingerprint
+        self.requested_fingerprint = requested_fingerprint
+
+    @property
+    def forceable(self) -> bool:
+        return self.kind == "chunk_trials"
+
+
 # Decision hooks: callables receiving the structured record of every
 # warn_and_record call.  A hook must never raise (it runs inside engine
 # resolution); exceptions are swallowed so telemetry can never change
@@ -113,7 +151,11 @@ def warn_and_record(
     """
     record = {
         "kind": (
-            "demotion" if issubclass(category, QBADemotionWarning) else "probe"
+            "demotion"
+            if issubclass(category, QBADemotionWarning)
+            else "checkpoint"
+            if issubclass(category, QBACheckpointMismatch)
+            else "probe"
         ),
         "category": category.__name__,
         "site": site,

@@ -17,21 +17,20 @@ validity is vacuous and agreement is the whole guarantee.
 
 from __future__ import annotations
 
-import math
-
 import numpy as np
+
+from qba_tpu.stats.estimators import wilson_ci_z
 
 
 def wilson_interval(k: int, n: int, z: float = 1.96) -> tuple[float, float]:
     """Wilson score interval for ``k`` successes in ``n`` Bernoulli
-    trials (default 95%).  ``n == 0`` returns the uninformative (0, 1)."""
-    if n == 0:
-        return (0.0, 1.0)
-    p = k / n
-    denom = 1.0 + z * z / n
-    center = (p + z * z / (2 * n)) / denom
-    half = (z / denom) * math.sqrt(p * (1 - p) / n + z * z / (4 * n * n))
-    return (max(0.0, center - half), min(1.0, center + half))
+    trials (default 95%).  ``n == 0`` returns the uninformative (0, 1).
+
+    Thin wrapper over :func:`qba_tpu.stats.estimators.wilson_ci_z` —
+    the statistics engine owns the formula now; this name stays for the
+    study scripts and their JSON consumers.
+    """
+    return wilson_ci_z(k, n, z)
 
 
 def _rate(k: int, n: int) -> dict:

@@ -73,6 +73,12 @@ class _Active:
     decisions: np.ndarray | None = None  # allocated at first readback
     filled: int = 0
     chunks: int = 0
+    # Precision-targeted requests: the parsed target and its live
+    # stopping rule.  Sound on the segment stream because the FIFO
+    # cursor fills each request's trials as a contiguous prefix — the
+    # rule sees exactly the trials [0, filled), in order.
+    target: Any = None  # qba_tpu.stats.Target | None
+    rule: Any = None  # live stopping rule | None
 
     @property
     def overdue(self) -> bool:
@@ -130,6 +136,14 @@ class QBAServer:
         if req.request_id in self._active:
             raise ValueError(f"request id already in flight: {req.request_id!r}")
         cfg = req.config()
+        target = rule = None
+        if req.target is not None:
+            from qba_tpu.stats import parse_target
+
+            # Parse errors surface here, at intake, as the same
+            # ValueError-to-error-result path a bad config takes.
+            target = parse_target(req.target)
+            rule = target.make_rule()
         import jax
 
         # Intake key derivation: a tiny CPU-resident key table
@@ -169,6 +183,8 @@ class QBAServer:
                 req.deadline_s if req.deadline_s is not None
                 else self.deadline_s
             ),
+            target=target,
+            rule=rule,
         )
 
     # ---- dispatch / drain --------------------------------------------
@@ -215,6 +231,17 @@ class QBAServer:
         self._expired += 1
         latency = float(ar.root_span.dur or 0.0)
         label = bucket_label(ar.bucket)
+        from qba_tpu.stats.estimators import rate_estimate
+
+        k_part = int(ar.success[: ar.filled].sum())
+        stats_block: dict[str, Any] = {
+            "success_rate": rate_estimate(k_part, ar.filled).to_json(),
+            "trials_requested": ar.cfg.trials,
+            "trials_completed": ar.filled,
+        }
+        if ar.target is not None:
+            stats_block["target"] = ar.target.to_json()
+            stats_block["stop"] = None  # the deadline fired, not the rule
         # The error result still carries the full validated manifest —
         # the caller learns which engine/plan the request WAS bound to
         # and how far it got, not just that it timed out.
@@ -233,6 +260,7 @@ class QBAServer:
                     "restored_plans": self.restored_plans,
                     "expired": True,
                     "trials_completed": ar.filled,
+                    "stats": stats_block,
                 },
             )
         )
@@ -247,6 +275,10 @@ class QBAServer:
         res.bucket = label
         res.chunks = ar.chunks
         res.manifest = manifest
+        if ar.rule is not None and ar.filled:
+            # Partial-progress estimate for a timed-out targeted
+            # request: anytime-valid over the prefix it did complete.
+            res.ci = ar.rule.estimate().to_json()
         return res
 
     def close(self) -> list[EvalResult]:
@@ -323,19 +355,59 @@ class QBAServer:
                 ar.overflow[dst] = overflow[src]
             ar.filled += seg.length
             ar.chunks += 1
+            if ar.rule is not None:
+                # The segment extended the request's contiguous prefix
+                # to [0, filled) — chunk counts feed the anytime-valid
+                # rule in trial order, so consulting it after every
+                # segment keeps the stated error rates.
+                ar.rule.observe(int(success[src].sum()), seg.length)
             if ar.filled == ar.cfg.trials:
                 done.append(self._finish(ar))
+            elif (
+                ar.rule is not None and (dec := ar.rule.decision()) is not None
+            ):
+                # Resolved early: cancel the still-queued trials and
+                # answer now with the partial prefix + stop decision
+                # (in-flight rows for this request drain to nowhere,
+                # same as the deadline path).
+                self.scheduler.cancel(ar.req.request_id)
+                done.append(self._finish(ar, stop=dec))
         return done
 
-    def _finish(self, ar: _Active) -> EvalResult:
+    def _finish(self, ar: _Active, stop=None) -> EvalResult:
+        """Close a request: complete (``filled == trials``) or resolved
+        early by its precision target (``stop`` from the rule).  The
+        result covers exactly the contiguous prefix ``[0, filled)``, so
+        a targeted result is bit-identical to the same prefix of the
+        untargeted run."""
         from qba_tpu.benchmark import engine_description
+        from qba_tpu.stats.estimators import rate_estimate
+        from qba_tpu.stats.estimators import success_rate as _success_rate
 
+        if ar.rule is not None and stop is None:
+            # Targeted request that filled its whole trial budget: the
+            # rule either fired exactly at the end or reports
+            # budget_exhausted with the CI actually achieved.
+            dec = ar.rule.decision()
+            stop = dec if dec is not None else ar.rule.exhausted()
         del self._active[ar.req.request_id]
         ar.root_ctx.__exit__(None, None, None)
         self._request_spans.append(ar.root_span)
         self._completed += 1
         latency = float(ar.root_span.dur or 0.0)
         label = bucket_label(ar.bucket)
+        n_done = ar.filled
+        k_done = int(ar.success[:n_done].sum())
+        # Every serve manifest carries a certified rate (KI-8): point
+        # estimate + CI, never a bare number.
+        stats_block: dict[str, Any] = {
+            "success_rate": rate_estimate(k_done, n_done).to_json(),
+            "trials_requested": ar.cfg.trials,
+            "trials_completed": n_done,
+        }
+        if ar.target is not None:
+            stats_block["target"] = ar.target.to_json()
+            stats_block["stop"] = stop.to_json() if stop is not None else None
         manifest = validate_manifest(
             collect_manifest(
                 ar.cfg,
@@ -349,6 +421,7 @@ class QBAServer:
                     "latency_s": latency,
                     "chunks": ar.chunks,
                     "restored_plans": self.restored_plans,
+                    "stats": stats_block,
                 },
             )
         )
@@ -357,19 +430,27 @@ class QBAServer:
         assert ar.decisions is not None
         return EvalResult(
             request_id=ar.req.request_id,
-            n_trials=ar.cfg.trials,
-            successes=int(ar.success.sum()),
-            success_rate=float(ar.success.mean()),
-            any_overflow=bool(ar.overflow.any()),
+            n_trials=n_done,
+            successes=k_done,
+            success_rate=_success_rate(k_done, n_done),
+            any_overflow=bool(ar.overflow[:n_done].any()),
             latency_s=latency,
             engine=engine_description(ar.cfg),
             bucket=label,
             chunks=ar.chunks,
-            success=[bool(x) for x in ar.success],
+            success=[bool(x) for x in ar.success[:n_done]],
             decisions=(
-                ar.decisions.tolist() if ar.req.return_decisions else None
+                ar.decisions[:n_done].tolist()
+                if ar.req.return_decisions
+                else None
             ),
             manifest=manifest,
+            stop=stop.to_json() if stop is not None else None,
+            ci=(
+                stop.estimate.to_json()
+                if stop is not None and stop.estimate is not None
+                else None
+            ),
         )
 
     def _write_telemetry(self, ar: _Active, manifest: dict) -> None:

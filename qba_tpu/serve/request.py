@@ -23,6 +23,7 @@ import json
 from typing import Any
 
 from qba_tpu.config import QBAConfig
+from qba_tpu.stats.estimators import success_rate as _success_rate
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +56,12 @@ class EvalRequest:
     # to the server's default.  An overdue request gets a structured
     # error EvalResult (with manifest) instead of wedging the stream.
     deadline_s: float | None = None
+    # Precision target (qba_tpu.stats.parse_target grammar, e.g.
+    # "decide vs 1/3 @ 95%" or "ci_width<=0.02"): "run until resolved
+    # or deadline".  ``trials`` becomes the budget ceiling; the server
+    # stops filling the request once its stopping rule fires and
+    # returns the partial prefix with the stop decision (docs/STATS.md).
+    target: str | None = None
     # Per-trial decisions are O(trials * n_parties) ints on the wire;
     # callers that only want the rate leave this off.
     return_decisions: bool = False
@@ -134,6 +141,11 @@ class EvalResult:
     decisions: list[list[int]] | None = None
     manifest: dict[str, Any] | None = None
     error: str | None = None
+    # Precision-targeted requests only: the StopDecision (as JSON) and
+    # the anytime-valid rate estimate at stop.  ``n_trials`` is then the
+    # trials actually executed (<= the requested budget).
+    stop: dict[str, Any] | None = None
+    ci: dict[str, Any] | None = None
 
     def to_json(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -154,7 +166,9 @@ class EvalResult:
             request_id=request_id,
             n_trials=0,
             successes=0,
-            success_rate=float("nan"),
+            # Uniform empty-result handling (stats satellite): nan on
+            # zero trials, from the single source of truth.
+            success_rate=_success_rate(0, 0),
             any_overflow=False,
             latency_s=0.0,
             engine="",

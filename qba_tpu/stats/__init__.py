@@ -1,0 +1,40 @@
+"""Sequential statistics + adaptive trial allocation (docs/STATS.md).
+
+The host-side statistics engine every Monte-Carlo path feeds:
+:mod:`~qba_tpu.stats.estimators` turns chunk counts into certified rates
+(point estimate + CI), :mod:`~qba_tpu.stats.sequential` provides
+anytime-valid stopping rules, :mod:`~qba_tpu.stats.targets` parses the
+shared ``target=`` grammar, and :mod:`~qba_tpu.stats.allocate` spends a
+shared chunk budget across a cell grid where the answer is least known.
+"""
+
+from qba_tpu.stats.allocate import AdaptiveAllocator
+from qba_tpu.stats.estimators import (
+    RateEstimate,
+    StreamingRate,
+    SweepEstimators,
+    clopper_pearson_ci,
+    rate_estimate,
+    round_histogram,
+    success_rate,
+    wilson_ci,
+)
+from qba_tpu.stats.sequential import SPRT, MixtureMartingaleCI, StopDecision
+from qba_tpu.stats.targets import Target, parse_target
+
+__all__ = [
+    "AdaptiveAllocator",
+    "MixtureMartingaleCI",
+    "RateEstimate",
+    "SPRT",
+    "StopDecision",
+    "StreamingRate",
+    "SweepEstimators",
+    "Target",
+    "clopper_pearson_ci",
+    "parse_target",
+    "rate_estimate",
+    "round_histogram",
+    "success_rate",
+    "wilson_ci",
+]

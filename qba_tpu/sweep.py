@@ -20,8 +20,13 @@ import jax
 import numpy as np
 
 from qba_tpu.config import QBAConfig
+from qba_tpu.diagnostics import QBACheckpointMismatch, warn_and_record
 from qba_tpu.obs.events import EventLog
 from qba_tpu.obs.timers import PhaseTimers
+from qba_tpu.stats.estimators import SweepEstimators
+from qba_tpu.stats.estimators import success_rate as _success_rate
+from qba_tpu.stats.sequential import StopDecision
+from qba_tpu.stats.targets import Target, parse_target
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,6 +49,11 @@ class SweepResult:
     cfg: QBAConfig
     chunks: tuple[ChunkResult, ...]
     resumed_chunks: int  # how many chunks came from the checkpoint
+    # Precision-targeted runs only (run_sweep(target=...)): why the run
+    # stopped, with the anytime-valid estimate at stop.  compare=False —
+    # the trial data is the identity; a targeted run that executed the
+    # same chunks as a fixed-budget run compares equal to it.
+    stop: StopDecision | None = dataclasses.field(default=None, compare=False)
 
     @property
     def n_trials(self) -> int:
@@ -55,11 +65,32 @@ class SweepResult:
 
     @property
     def success_rate(self) -> float:
-        return self.successes / self.n_trials if self.n_trials else float("nan")
+        # Single source of truth for the empty case (stats satellite):
+        # nan on zero trials, everywhere.
+        return _success_rate(self.successes, self.n_trials)
 
     @property
     def any_overflow(self) -> bool:
         return any(c.overflow for c in self.chunks)
+
+    def estimators(
+        self, method: str = "wilson", confidence: float = 0.95
+    ) -> SweepEstimators:
+        """The certified-rate view of this sweep (docs/STATS.md)."""
+        return SweepEstimators(
+            method=method, confidence=confidence
+        ).observe_all(self.chunks)
+
+    def stats_summary(
+        self, method: str = "wilson", confidence: float = 0.95
+    ) -> dict[str, Any]:
+        """Manifest-ready statistics block: every rate carries a CI, the
+        stop decision rides along on targeted runs."""
+        out = self.estimators(method=method, confidence=confidence).summary()
+        out["n_trials"] = self.n_trials
+        if self.stop is not None:
+            out["stop"] = self.stop.to_json()
+        return out
 
 
 def chunk_keys(cfg: QBAConfig, chunk: int, chunk_trials: int) -> jax.Array:
@@ -70,7 +101,12 @@ def chunk_keys(cfg: QBAConfig, chunk: int, chunk_trials: int) -> jax.Array:
 
 
 def _config_fingerprint(cfg: QBAConfig) -> dict[str, Any]:
-    return dataclasses.asdict(cfg)
+    # ``trials`` is chunk sizing, not part of the scientific question —
+    # the (forceable) chunk_trials check owns that disagreement, so the
+    # CLI's ``--trials`` change doesn't masquerade as a config mismatch.
+    d = dataclasses.asdict(cfg)
+    d.pop("trials", None)
+    return d
 
 
 def _atomic_write_json(path: str, payload: dict[str, Any]) -> None:
@@ -86,37 +122,79 @@ def _atomic_write_json(path: str, payload: dict[str, Any]) -> None:
         raise
 
 
-def load_checkpoint(path: str, cfg: QBAConfig, chunk_trials: int) -> list[ChunkResult]:
-    """Completed chunks from ``path``; [] if absent.  Raises on a config or
-    chunk-size mismatch (a checkpoint is only valid for the exact sweep)."""
+def load_checkpoint(
+    path: str, cfg: QBAConfig, chunk_trials: int, force: bool = False
+) -> list[ChunkResult]:
+    """Completed chunks from ``path``; [] if absent.
+
+    Raises :class:`~qba_tpu.diagnostics.QBACheckpointMismatch` (a
+    ``ValueError`` and a ``QBAWarning`` family member, carrying both
+    fingerprints) on a config or chunk-size mismatch — a checkpoint is
+    only valid for the exact sweep.  ``force=True`` (the CLI's
+    ``--resume-force``) downgrades the *chunk_trials* mismatch to a
+    warning and returns ``[]`` so the caller re-chunks from scratch
+    (the next save overwrites).  A *config* mismatch is never
+    forceable: those chunks were drawn from a different program.
+    """
     if not os.path.exists(path):
         return []
     with open(path) as f:
         payload = json.load(f)
-    if payload.get("config") != _config_fingerprint(cfg):
-        raise ValueError(
+    # Older checkpoints recorded ``trials`` inside the fingerprint;
+    # drop it from the stored side too so they stay resumable.
+    stored = dict(payload.get("config") or {})
+    stored.pop("trials", None)
+    if stored != _config_fingerprint(cfg):
+        raise QBACheckpointMismatch(
             f"checkpoint {path} was written for a different config: "
-            f"{payload.get('config')} != {_config_fingerprint(cfg)}"
+            f"{stored} != {_config_fingerprint(cfg)}",
+            kind="config",
+            path=path,
+            checkpoint_fingerprint=stored,
+            requested_fingerprint=_config_fingerprint(cfg),
         )
     if payload.get("chunk_trials") != chunk_trials:
-        raise ValueError(
+        err = QBACheckpointMismatch(
             f"checkpoint {path} used chunk_trials={payload.get('chunk_trials')}, "
-            f"requested {chunk_trials}"
+            f"requested {chunk_trials}",
+            kind="chunk_trials",
+            path=path,
+            checkpoint_fingerprint=payload.get("chunk_trials"),
+            requested_fingerprint=chunk_trials,
         )
+        if not force:
+            raise err
+        warn_and_record(
+            f"{err} — --resume-force: discarding the checkpoint and "
+            "re-chunking from scratch",
+            QBACheckpointMismatch,
+            site="sweep.load_checkpoint",
+            path=path,
+            checkpoint_chunk_trials=payload.get("chunk_trials"),
+            requested_chunk_trials=chunk_trials,
+        )
+        return []
     return [ChunkResult(**c) for c in payload["chunks"]]
 
 
 def save_checkpoint(
-    path: str, cfg: QBAConfig, chunk_trials: int, chunks: list[ChunkResult]
+    path: str,
+    cfg: QBAConfig,
+    chunk_trials: int,
+    chunks: list[ChunkResult],
+    stats: dict[str, Any] | None = None,
 ) -> None:
-    _atomic_write_json(
-        path,
-        {
-            "config": _config_fingerprint(cfg),
-            "chunk_trials": chunk_trials,
-            "chunks": [dataclasses.asdict(c) for c in chunks],
-        },
-    )
+    payload = {
+        "config": _config_fingerprint(cfg),
+        "chunk_trials": chunk_trials,
+        "chunks": [dataclasses.asdict(c) for c in chunks],
+    }
+    if stats is not None:
+        # Precision-targeted runs persist the target spec + running
+        # stop state alongside the chunks; load_checkpoint ignores the
+        # block (chunk data alone reconstructs the rule on replay).
+        payload["stats"] = stats
+    _atomic_write_json(path, payload)
 
 
 def _default_runner(chunk_trials: int, log: EventLog | None):
@@ -147,6 +225,149 @@ def _default_runner(chunk_trials: int, log: EventLog | None):
     return runner
 
 
+def run_chunk(
+    cfg: QBAConfig,
+    chunk: int,
+    chunk_trials: int,
+    runner,
+    timers: PhaseTimers,
+) -> ChunkResult:
+    """Execute ONE chunk synchronously: dispatch span, fenced readback
+    span, :class:`ChunkResult` out.
+
+    The sequential paths (``target=`` sweeps, the surface allocator)
+    use this instead of the double-buffered pipeline: a stopping rule
+    must see chunk k's counts before deciding whether chunk k+1 runs at
+    all, so overlap would execute work the rule may cancel.  That
+    serialization is the documented cost of precision targeting
+    (docs/STATS.md); the readback is still fenced so the KI-6 telemetry
+    attributes the stall to the device.
+    """
+    keys = chunk_keys(cfg, chunk, chunk_trials)
+    t0 = timers.total("dispatch")
+    with timers.time("dispatch", chunk=chunk):
+        res = runner(cfg, keys)
+    dispatch_s = timers.total("dispatch") - t0
+    t1 = timers.total("readback")
+    with timers.time("readback", chunk=chunk) as sp:
+        successes = int(np.sum(np.asarray(res.success)))
+        overflow = bool(np.any(np.asarray(res.overflow)))
+        # The np.asarray reads ARE this chunk's host readback barrier.
+        sp.fenced = True
+    return ChunkResult(
+        chunk=chunk,
+        trials=chunk_trials,
+        successes=successes,
+        overflow=overflow,
+        dispatch_s=dispatch_s,
+        readback_s=timers.total("readback") - t1,
+    )
+
+
+def _replay_prefix(
+    loaded: list[ChunkResult], rule, max_chunks: int
+) -> tuple[list[ChunkResult], StopDecision | None]:
+    """Feed checkpointed chunks to a fresh stopping rule in chunk order.
+
+    Only the contiguous prefix starting at chunk 0 counts: the rule's
+    stop point must be a pure function of the canonical chunk order, so
+    a resumed targeted run replays exactly the chunks an uninterrupted
+    run would have executed, in the same order, and lands in the same
+    rule state.  Replay stops at the first decision — trailing
+    checkpointed chunks stay in the file but not in the result,
+    mirroring where an uninterrupted run would have stopped.
+    """
+    by_index = {c.chunk: c for c in loaded}
+    replayed: list[ChunkResult] = []
+    for i in range(max_chunks):
+        c = by_index.get(i)
+        if c is None:
+            break
+        rule.observe(c.successes, c.trials)
+        replayed.append(c)
+        dec = rule.decision()
+        if dec is not None:
+            return replayed, dec
+    return replayed, None
+
+
+def _run_sweep_targeted(
+    cfg: QBAConfig,
+    target: Target,
+    n_chunks: int,
+    chunk_trials: int,
+    checkpoint: str | None,
+    log: EventLog | None,
+    timers: PhaseTimers,
+    runner,
+    resume_force: bool,
+) -> SweepResult:
+    """The ``target=`` path of :func:`run_sweep`: chunks run one at a
+    time through ``target``'s stopping rule until it fires or the
+    ``n_chunks`` budget is exhausted.  Chunk k's keys are the same pure
+    function of ``(seed, k)`` as in the fixed-budget path, so the
+    executed chunks are bit-identical to a fixed-budget run's prefix —
+    the stopping rule only chooses WHERE the prefix ends."""
+    rule = target.make_rule()
+    loaded = (
+        load_checkpoint(checkpoint, cfg, chunk_trials, force=resume_force)
+        if checkpoint
+        else []
+    )
+    chunks, decision = _replay_prefix(loaded, rule, n_chunks)
+    resumed = len(chunks)
+    extra = [c for c in loaded if c.chunk >= len(chunks)]
+    if log and resumed:
+        log.info(
+            "sweep",
+            "resumed targeted run from checkpoint",
+            chunks=resumed,
+            path=checkpoint,
+        )
+
+    next_chunk = len(chunks)
+    while decision is None and next_chunk < n_chunks:
+        if runner is None:
+            runner = _default_runner(chunk_trials, log)
+        cr = run_chunk(cfg, next_chunk, chunk_trials, runner, timers)
+        chunks.append(cr)
+        rule.observe(cr.successes, cr.trials)
+        decision = rule.decision()
+        if checkpoint:
+            save_checkpoint(
+                checkpoint,
+                cfg,
+                chunk_trials,
+                chunks + extra,
+                stats={
+                    "target": target.to_json(),
+                    "stop": decision.to_json() if decision else None,
+                },
+            )
+        if log:
+            log.info(
+                "sweep",
+                "chunk done",
+                chunk=cr.chunk,
+                successes=cr.successes,
+                trials=cr.trials,
+                decided=decision is not None,
+            )
+        next_chunk += 1
+
+    stop = decision if decision is not None else rule.exhausted()
+    if log:
+        log.info(
+            "sweep",
+            "targeted sweep stopped",
+            reason=stop.reason,
+            n_trials=stop.n_trials,
+        )
+    return SweepResult(
+        cfg=cfg, chunks=tuple(chunks), resumed_chunks=resumed, stop=stop
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class SurfaceCell:
     """One (strategy × noise × size_l) grid point of an adversary
@@ -161,36 +382,17 @@ class SurfaceCell:
     manifest: dict[str, Any] | None = None
 
 
-def run_surface(
+def _surface_grid(
     cfg: QBAConfig,
-    strategies: tuple[str, ...] | list[str],
-    noise_points: list[tuple[float, float]],
-    size_ls: list[int],
-    n_chunks: int = 1,
-    chunk_trials: int | None = None,
-    checkpoint_dir: str | None = None,
-    log: EventLog | None = None,
-    runner=None,
-    with_manifest: bool = True,
-) -> list[SurfaceCell]:
-    """The (strategy × noise × sizeL) adversary surface as ONE sharded
-    Monte-Carlo: every cell is a :func:`run_sweep` over the same runner
-    (dp-sharded over all visible devices when several are up — the
-    ``parallel.montecarlo`` path), so the whole grid shares key-tree
-    discipline, checkpoint format and placement independence.
-
-    ``noise_points`` are ``(p_depolarize, p_measure_flip)`` pairs.  With
-    ``checkpoint_dir``, each cell checkpoints to its own file (named by
-    the cell coordinates) and a re-run resumes cell-by-cell.  With
-    ``with_manifest``, each cell carries the dispatch-decision manifest
-    collected around its own run — per-cell kernel attribution, since
-    strategy changes the traced round program (forge-P is statically
-    gated) and size_l changes the block plan.
-    """
-    from qba_tpu.diagnostics import record_decisions
-    from qba_tpu.obs.manifest import collect_manifest
-
-    cells: list[SurfaceCell] = []
+    strategies,
+    noise_points,
+    size_ls,
+    checkpoint_dir: str | None,
+) -> list[tuple[str, float, float, int, QBAConfig, str | None]]:
+    """The flattened (strategy × noise × sizeL) cell list with per-cell
+    configs and checkpoint paths — shared by both surface paths so the
+    uniform and targeted runs agree on cell identity and order."""
+    grid = []
     for strat in strategies:
         for p_dep, p_mf in noise_points:
             for size_l in size_ls:
@@ -208,42 +410,250 @@ def run_surface(
                         checkpoint_dir,
                         f"surface_{strat}_p{p_dep}_q{p_mf}_L{size_l}.json",
                     )
-                with record_decisions() as decisions:
-                    res = run_sweep(
-                        cfg_cell,
-                        n_chunks=n_chunks,
-                        chunk_trials=chunk_trials,
-                        checkpoint=ckpt,
-                        log=log,
-                        runner=runner,
-                    )
-                manifest = (
-                    collect_manifest(
-                        cfg_cell, command="surface", decisions=decisions
-                    )
-                    if with_manifest
-                    else None
-                )
-                cells.append(
-                    SurfaceCell(
-                        strategy=strat,
-                        p_depolarize=p_dep,
-                        p_measure_flip=p_mf,
-                        size_l=size_l,
-                        result=res,
-                        manifest=manifest,
-                    )
-                )
-                if log:
-                    log.info(
-                        "surface",
-                        "cell done",
-                        strategy=strat,
-                        p_depolarize=p_dep,
-                        p_measure_flip=p_mf,
-                        size_l=size_l,
-                        success_rate=res.success_rate,
-                    )
+                grid.append((strat, p_dep, p_mf, size_l, cfg_cell, ckpt))
+    return grid
+
+
+def _run_surface_targeted(
+    cfg: QBAConfig,
+    strategies,
+    noise_points,
+    size_ls,
+    target: Target,
+    budget_chunks: int,
+    chunk_trials: int,
+    checkpoint_dir: str | None,
+    log: EventLog | None,
+    runner,
+    with_manifest: bool,
+    resume_force: bool,
+) -> list[SurfaceCell]:
+    """The ``target=`` path of :func:`run_surface`: one shared chunk
+    budget spent across the grid by the adaptive allocator
+    (:class:`~qba_tpu.stats.AdaptiveAllocator`) — cells whose CI still
+    straddles the decision boundary get chunks first, resolved cells
+    stop consuming budget.  Each executed chunk is the same pure
+    function of (cell config seed, chunk index) as in the uniform path,
+    so per-cell results are bit-identical to a uniform run's prefix;
+    only the per-cell chunk *counts* differ."""
+    from qba_tpu.diagnostics import record_decisions
+    from qba_tpu.obs.manifest import collect_manifest
+    from qba_tpu.stats.allocate import AdaptiveAllocator
+
+    grid = _surface_grid(cfg, strategies, noise_points, size_ls, checkpoint_dir)
+    labels = [
+        f"{strat}_p{p_dep}_q{p_mf}_L{size_l}"
+        for strat, p_dep, p_mf, size_l, _, _ in grid
+    ]
+    alloc = AdaptiveAllocator(labels, target, budget_chunks)
+    timers = PhaseTimers()
+    cell_chunks: list[list[ChunkResult]] = [[] for _ in grid]
+    cell_decisions: list[list[dict]] = [[] for _ in grid]
+    cell_resumed = [0] * len(grid)
+
+    # Resume: replay each cell's checkpointed contiguous prefix through
+    # the allocator in cell-index order, chunk order within a cell —
+    # the rule state after replay equals the state the interrupted run
+    # stopped in (counts are order-exchangeable; docs/STATS.md).
+    for idx, (_, _, _, _, cfg_cell, ckpt) in enumerate(grid):
+        if not ckpt:
+            continue
+        loaded = load_checkpoint(ckpt, cfg_cell, chunk_trials, force=resume_force)
+        by_index = {c.chunk: c for c in loaded}
+        i = 0
+        while i in by_index and alloc.cells[idx].decision is None:
+            c = by_index[i]
+            cell_chunks[idx].append(c)
+            alloc.preload(idx, c.successes, c.trials)
+            i += 1
+        cell_resumed[idx] = len(cell_chunks[idx])
+        if log and cell_resumed[idx]:
+            log.info(
+                "surface",
+                "cell resumed from checkpoint",
+                cell=labels[idx],
+                chunks=cell_resumed[idx],
+            )
+
+    while (idx := alloc.next_cell()) is not None:
+        strat, p_dep, p_mf, size_l, cfg_cell, ckpt = grid[idx]
+        if runner is None:
+            runner = _default_runner(chunk_trials, log)
+        chunk_index = len(cell_chunks[idx])
+        with record_decisions() as decs:
+            cr = run_chunk(cfg_cell, chunk_index, chunk_trials, runner, timers)
+        cell_decisions[idx].extend(decs)
+        cell_chunks[idx].append(cr)
+        dec = alloc.record(idx, cr.successes, cr.trials)
+        if ckpt:
+            save_checkpoint(
+                ckpt,
+                cfg_cell,
+                chunk_trials,
+                cell_chunks[idx],
+                stats={
+                    "target": target.to_json(),
+                    "stop": dec.to_json() if dec else None,
+                },
+            )
+        if log:
+            log.info(
+                "surface",
+                "allocated chunk done",
+                cell=labels[idx],
+                chunk=chunk_index,
+                successes=cr.successes,
+                decided=dec is not None,
+            )
+
+    alloc.finish()
+    alloc_summary = alloc.summary()
+    decisions = alloc.decisions()
+    cells: list[SurfaceCell] = []
+    for idx, (strat, p_dep, p_mf, size_l, cfg_cell, _) in enumerate(grid):
+        res = SweepResult(
+            cfg=cfg_cell,
+            chunks=tuple(cell_chunks[idx]),
+            resumed_chunks=cell_resumed[idx],
+            stop=decisions[idx],
+        )
+        manifest = None
+        if with_manifest:
+            stats_block = res.stats_summary(confidence=target.confidence)
+            stats_block["target"] = target.to_json()
+            stats_block["allocator"] = alloc_summary
+            manifest = collect_manifest(
+                cfg_cell,
+                command="surface",
+                decisions=cell_decisions[idx],
+                extra={"stats": stats_block},
+            )
+        cells.append(
+            SurfaceCell(
+                strategy=strat,
+                p_depolarize=p_dep,
+                p_measure_flip=p_mf,
+                size_l=size_l,
+                result=res,
+                manifest=manifest,
+            )
+        )
+        if log:
+            log.info(
+                "surface",
+                "cell resolved",
+                cell=labels[idx],
+                reason=decisions[idx].reason,
+                n_trials=res.n_trials,
+            )
+    return cells
+
+
+def run_surface(
+    cfg: QBAConfig,
+    strategies: tuple[str, ...] | list[str],
+    noise_points: list[tuple[float, float]],
+    size_ls: list[int],
+    n_chunks: int = 1,
+    chunk_trials: int | None = None,
+    checkpoint_dir: str | None = None,
+    log: EventLog | None = None,
+    runner=None,
+    with_manifest: bool = True,
+    target: Target | str | None = None,
+    budget_chunks: int | None = None,
+    resume_force: bool = False,
+) -> list[SurfaceCell]:
+    """The (strategy × noise × sizeL) adversary surface as ONE sharded
+    Monte-Carlo: every cell is a :func:`run_sweep` over the same runner
+    (dp-sharded over all visible devices when several are up — the
+    ``parallel.montecarlo`` path), so the whole grid shares key-tree
+    discipline, checkpoint format and placement independence.
+
+    ``noise_points`` are ``(p_depolarize, p_measure_flip)`` pairs.  With
+    ``checkpoint_dir``, each cell checkpoints to its own file (named by
+    the cell coordinates) and a re-run resumes cell-by-cell.  With
+    ``with_manifest``, each cell carries the dispatch-decision manifest
+    collected around its own run — per-cell kernel attribution, since
+    strategy changes the traced round program (forge-P is statically
+    gated) and size_l changes the block plan.  Every cell manifest also
+    carries a ``stats`` block with the cell's certified success rate
+    (point estimate + CI; docs/STATS.md).
+
+    ``target`` switches to the precision-targeted path: the adaptive
+    allocator spends one shared chunk budget (``budget_chunks``,
+    default ``n_chunks × n_cells`` — the uniform run's total) across
+    the grid, largest-uncertainty-first, until every cell's stopping
+    rule resolves or the budget runs out.  ``resume_force`` forwards to
+    :func:`load_checkpoint`.
+    """
+    from qba_tpu.diagnostics import record_decisions
+    from qba_tpu.obs.manifest import collect_manifest
+
+    if chunk_trials is None:
+        chunk_trials = cfg.trials
+    if target is not None:
+        if isinstance(target, str):
+            target = parse_target(target)
+        n_cells = len(strategies) * len(noise_points) * len(size_ls)
+        return _run_surface_targeted(
+            cfg,
+            strategies,
+            noise_points,
+            size_ls,
+            target,
+            budget_chunks if budget_chunks is not None else n_chunks * n_cells,
+            chunk_trials,
+            checkpoint_dir,
+            log,
+            runner,
+            with_manifest,
+            resume_force,
+        )
+
+    cells: list[SurfaceCell] = []
+    grid = _surface_grid(cfg, strategies, noise_points, size_ls, checkpoint_dir)
+    for strat, p_dep, p_mf, size_l, cfg_cell, ckpt in grid:
+        with record_decisions() as decisions:
+            res = run_sweep(
+                cfg_cell,
+                n_chunks=n_chunks,
+                chunk_trials=chunk_trials,
+                checkpoint=ckpt,
+                log=log,
+                runner=runner,
+                resume_force=resume_force,
+            )
+        manifest = (
+            collect_manifest(
+                cfg_cell,
+                command="surface",
+                decisions=decisions,
+                extra={"stats": res.stats_summary()},
+            )
+            if with_manifest
+            else None
+        )
+        cells.append(
+            SurfaceCell(
+                strategy=strat,
+                p_depolarize=p_dep,
+                p_measure_flip=p_mf,
+                size_l=size_l,
+                result=res,
+                manifest=manifest,
+            )
+        )
+        if log:
+            log.info(
+                "surface",
+                "cell done",
+                strategy=strat,
+                p_depolarize=p_dep,
+                p_measure_flip=p_mf,
+                size_l=size_l,
+                success_rate=res.success_rate,
+            )
     return cells
 
 
@@ -255,6 +665,8 @@ def run_sweep(
     log: EventLog | None = None,
     timers: PhaseTimers | None = None,
     runner=None,
+    target: Target | str | None = None,
+    resume_force: bool = False,
 ) -> SweepResult:
     """Run ``n_chunks`` batches of ``chunk_trials`` trials each.
 
@@ -267,6 +679,16 @@ def run_sweep(
     and skipped on re-run.  Results are placement-independent
     (tests/test_parallel.py), so resuming on different hardware
     reproduces the same sweep.
+
+    ``target`` (a :class:`~qba_tpu.stats.Target` or its string form,
+    e.g. ``"decide vs 1/3 @ 95%"`` / ``"ci_width<=0.002"``) switches to
+    the precision-targeted path: chunks run one at a time through the
+    target's anytime-valid stopping rule and the sweep stops as soon as
+    the rule fires — ``n_chunks`` becomes the budget *ceiling*, and
+    ``SweepResult.stop`` records the decision.  Executed chunks are
+    bit-identical to the fixed-budget run's prefix (docs/STATS.md).
+    ``resume_force`` forwards to :func:`load_checkpoint` (re-chunk
+    instead of refusing on a chunk_trials mismatch).
     """
     if chunk_trials is None:
         chunk_trials = cfg.trials
@@ -284,7 +706,26 @@ def run_sweep(
 
         enable_compile_cache(xla_cache_dir())
 
-    loaded = load_checkpoint(checkpoint, cfg, chunk_trials) if checkpoint else []
+    if target is not None:
+        if isinstance(target, str):
+            target = parse_target(target)
+        return _run_sweep_targeted(
+            cfg,
+            target,
+            n_chunks,
+            chunk_trials,
+            checkpoint,
+            log,
+            timers or PhaseTimers(),
+            runner,
+            resume_force,
+        )
+
+    loaded = (
+        load_checkpoint(checkpoint, cfg, chunk_trials, force=resume_force)
+        if checkpoint
+        else []
+    )
     # A checkpoint may hold more chunks than this invocation asks for;
     # aggregate only the requested range (the file keeps the full set).
     chunks = [c for c in loaded if c.chunk < n_chunks]

@@ -172,6 +172,46 @@ class TestCLI:
         assert main(args, out=out2) == 0
         assert "resumed from checkpoint" in out2.getvalue()
 
+    def test_sweep_target_prints_stop_line(self):
+        # --target turns --n-chunks into a ceiling: the honest config
+        # decides vs 1/3 within the first chunk, and the CLI reports the
+        # typed stop with its anytime-valid interval.
+        out = io.StringIO()
+        rc = main(
+            ["sweep", "--n-parties", "3", "--size-l", "8", "--n-dishonest",
+             "0", "--trials", "16", "--n-chunks", "8",
+             "--target", "decide vs 1/3 @ 95%"],
+            out=out,
+        )
+        text = out.getvalue()
+        assert rc == 0
+        assert "stop: decided_above after" in text
+        assert "95% CI [" in text
+        assert "trials: 16" in text  # 1 of the 8 budgeted chunks ran
+
+    def test_sweep_resume_force_recovers_chunk_trials_mismatch(self, tmp_path):
+        ckpt = str(tmp_path / "c.json")
+        base = ["sweep", "--n-parties", "3", "--size-l", "4",
+                "--n-chunks", "2", "--checkpoint", ckpt]
+        assert main(base + ["--trials", "4"], out=io.StringIO()) == 0
+        # chunk_trials disagreement without the escape hatch: clean rc-2
+        # (QBACheckpointMismatch is a ValueError).
+        assert main(base + ["--trials", "8"], out=io.StringIO()) == 2
+        # --resume-force discards the checkpoint with a recorded warning
+        # and re-chunks from scratch.
+        out = io.StringIO()
+        with pytest.warns(Warning, match="resume-force"):
+            rc = main(base + ["--trials", "8", "--resume-force"], out=out)
+        assert rc == 0
+        assert "trials: 16" in out.getvalue()
+        # A config mismatch is never forceable — those chunks answer a
+        # different question.
+        rc = main(
+            base + ["--trials", "8", "--n-dishonest", "1", "--resume-force"],
+            out=io.StringIO(),
+        )
+        assert rc == 2
+
     def test_invalid_config_clean_error(self):
         rc = main(
             ["run", "--n-parties", "3", "--size-l", "8", "--n-dishonest", "9"],

@@ -526,3 +526,97 @@ def test_span_latency_summary_percentiles():
     assert span_latency_summary([], "request") == {
         "name": "request", "count": 0,
     }
+
+
+# ---- precision targets (docs/STATS.md) ---------------------------------
+
+
+def test_targeted_request_finishes_early_with_prefix_identity():
+    # One batch mixes a targeted and an untargeted request on the same
+    # config: the targeted one must stop early with a certified anytime
+    # CI, and its executed prefix must be bit-identical to the matching
+    # slice of the untargeted (full-budget) run.
+    server = QBAServer(chunk_trials=8)
+    stream = [
+        _req("tgt", trials=64, seed=3, target="decide vs 1/3 @ 95%"),
+        _req("full", trials=64, seed=3),
+    ]
+    by_id = {r.request_id: r for r in serve_batch(server, stream)}
+    tgt, full = by_id["tgt"], by_id["full"]
+    assert tgt.error is None and full.error is None
+    # Untargeted requests are untouched by the stats machinery.
+    assert full.stop is None and full.ci is None
+    assert full.n_trials == 64
+    # The targeted request resolved before its budget.
+    assert tgt.stop is not None
+    assert tgt.stop["reason"] == "decided_above"
+    assert tgt.stop["threshold"] == pytest.approx(1 / 3)
+    assert tgt.n_trials == tgt.stop["n_trials"] < 64
+    assert len(tgt.success) == tgt.n_trials
+    # The estimate at the stopping time is anytime-valid and excludes
+    # the threshold.
+    assert tgt.ci["method"] == "mixture_martingale"
+    assert tgt.ci["lo"] > 1 / 3
+    # Prefix bit-identity: vs the served full-budget twin AND a direct
+    # run of the same config (same seed -> same chunk keys).
+    assert tgt.success == full.success[: tgt.n_trials]
+    direct = run_trials(stream[0].config(), trial_keys(stream[0].config()))
+    assert tgt.success == [
+        bool(x) for x in np.asarray(direct.trials.success)[: tgt.n_trials]
+    ]
+    # Manifest: schema-valid, stats block pins target + stop + counts.
+    validate_manifest(tgt.manifest)
+    stats = tgt.manifest["stats"]
+    assert stats["target"]["spec"] == "decide vs 1/3 @ 95%"
+    assert stats["stop"]["reason"] == "decided_above"
+    assert stats["trials_completed"] == tgt.n_trials
+    assert stats["trials_requested"] == 64
+    assert stats["success_rate"]["n"] == tgt.n_trials
+
+
+def test_targeted_budget_exhausted_reports_partial_interval():
+    # An unreachable width target inside the trial budget is an honest
+    # non-answer: budget_exhausted, full prefix executed, and the (wide)
+    # certified interval still attached.
+    server = QBAServer(chunk_trials=4)
+    [res] = serve_batch(
+        server, [_req("tight", trials=8, seed=1, target="ci_width<=0.01")]
+    )
+    assert res.error is None
+    assert res.stop["reason"] == "budget_exhausted"
+    assert res.n_trials == 8 and len(res.success) == 8
+    assert res.ci["method"] == "mixture_martingale"
+    assert res.ci["hi"] - res.ci["lo"] > 0.01
+    validate_manifest(res.manifest)
+    assert res.manifest["stats"]["stop"]["reason"] == "budget_exhausted"
+
+
+def test_invalid_target_becomes_error_result():
+    # Target parse errors take the same intake path as a bad config:
+    # a structured error result, and the stream keeps flowing.
+    server = QBAServer(chunk_trials=4)
+    results = serve_batch(
+        server,
+        [_req("bad", trials=4, target="decide maybe"), _req("ok", trials=4)],
+    )
+    by_id = {r.request_id: r for r in results}
+    assert by_id["bad"].error and "unrecognized target" in by_id["bad"].error
+    assert by_id["ok"].error is None and by_id["ok"].n_trials == 4
+
+
+def test_targeted_deadline_expiry_reports_rule_silent():
+    import time
+
+    # The deadline fired, not the rule: the expired manifest carries the
+    # target but stop is null, distinguishing "timed out" from "decided".
+    server = QBAServer(chunk_trials=4, deadline_s=0.01)
+    server.submit(_req("dl", trials=4, target="decide vs 1/3"))
+    time.sleep(0.05)
+    results = server.pump() + server.flush()
+    [res] = [r for r in results if r.request_id == "dl"]
+    assert res.error and "deadline exceeded" in res.error
+    stats = res.manifest["stats"]
+    assert stats["target"]["spec"] == "decide vs 1/3"
+    assert stats["stop"] is None
+    # No trials completed, so no partial interval either.
+    assert res.stop is None and res.ci is None

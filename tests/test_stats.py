@@ -1,0 +1,520 @@
+"""Sequential-statistics subsystem tests (docs/STATS.md).
+
+Five contracts:
+
+* **Exactness** — Clopper–Pearson endpoints invert the closed-form
+  binomial tails (computed here from ``math.comb``, independently of
+  the stdlib incomplete-beta the implementation uses), and both
+  interval families hit their nominal coverage at small n where it is
+  computable exactly.
+* **Error control** — the SPRT's realized wrong-decision rate under a
+  fixed-seed simulation stays within its designed alpha/beta.
+* **Determinism** — the adaptive allocator is a pure function of the
+  observed counts (priority-then-index, no RNG): adaptive and uniform
+  schedules yield bit-identical per-chunk results, only the order and
+  the amount of work differ.
+* **Prefix identity** — a precision-targeted ``run_sweep`` executes a
+  bit-identical prefix of the fixed-budget run, resumes into the same
+  state, and reports a typed, anytime-valid stop decision.
+* **KI-8** — the manifest-CI lint flags bare rates and passes the
+  manifests this repo actually produces.
+"""
+
+import dataclasses
+import json
+import math
+import types
+
+import numpy as np
+import pytest
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.diagnostics import QBACheckpointMismatch
+from qba_tpu.stats import (
+    AdaptiveAllocator,
+    MixtureMartingaleCI,
+    SPRT,
+    StopDecision,
+    clopper_pearson_ci,
+    parse_target,
+    rate_estimate,
+    round_histogram,
+    success_rate,
+    wilson_ci,
+)
+from qba_tpu.stats.estimators import SweepEstimators
+from qba_tpu.sweep import load_checkpoint, run_sweep, run_surface, save_checkpoint
+
+
+def _binom_pmf(n, p, k):
+    return math.comb(n, k) * p**k * (1.0 - p) ** (n - k)
+
+
+def _tail_ge(n, p, k):
+    return sum(_binom_pmf(n, p, j) for j in range(k, n + 1))
+
+
+def _tail_le(n, p, k):
+    return sum(_binom_pmf(n, p, j) for j in range(0, k + 1))
+
+
+class TestEstimators:
+    def test_success_rate_nan_on_zero_trials(self):
+        assert math.isnan(success_rate(0, 0))
+        assert success_rate(3, 4) == 0.75
+
+    def test_vacuous_intervals_at_n_zero(self):
+        assert wilson_ci(0, 0) == (0.0, 1.0)
+        assert clopper_pearson_ci(0, 0) == (0.0, 1.0)
+        est = rate_estimate(0, 0)
+        assert est.to_json()["rate"] is None
+        assert (est.lo, est.hi) == (0.0, 1.0)
+
+    @pytest.mark.parametrize("k,n", [(1, 7), (7, 20), (3, 11), (19, 20)])
+    def test_clopper_pearson_inverts_exact_binomial_tails(self, k, n):
+        # The defining property, checked against math.comb sums (an
+        # implementation-independent oracle for the beta identities):
+        # at lo, P[X >= k] = alpha/2; at hi, P[X <= k] = alpha/2.
+        lo, hi = clopper_pearson_ci(k, n, confidence=0.95)
+        assert _tail_ge(n, lo, k) == pytest.approx(0.025, abs=1e-9)
+        assert _tail_le(n, hi, k) == pytest.approx(0.025, abs=1e-9)
+
+    def test_clopper_pearson_endpoint_cases(self):
+        lo0, _ = clopper_pearson_ci(0, 9)
+        _, hi9 = clopper_pearson_ci(9, 9)
+        assert lo0 == 0.0 and hi9 == 1.0
+
+    @pytest.mark.parametrize("p", [0.1, 0.37, 0.5, 0.9])
+    def test_small_n_coverage_exact(self, p):
+        # Exact coverage at n=12 by enumerating all 13 outcomes: CP is
+        # >= nominal by construction; Wilson is allowed its documented
+        # small-n dip but must stay close.
+        n = 12
+        cov_cp = sum(
+            _binom_pmf(n, p, k)
+            for k in range(n + 1)
+            if clopper_pearson_ci(k, n)[0] <= p <= clopper_pearson_ci(k, n)[1]
+        )
+        cov_w = sum(
+            _binom_pmf(n, p, k)
+            for k in range(n + 1)
+            if wilson_ci(k, n)[0] <= p <= wilson_ci(k, n)[1]
+        )
+        assert cov_cp >= 0.95
+        assert cov_w >= 0.90
+
+    def test_interval_validation(self):
+        with pytest.raises(ValueError, match="0 <= k <= n"):
+            wilson_ci(5, 4)
+        with pytest.raises(ValueError, match="0 <= k <= n"):
+            clopper_pearson_ci(-1, 4)
+        with pytest.raises(ValueError, match="confidence"):
+            wilson_ci(1, 4, confidence=1.5)
+        with pytest.raises(ValueError, match="unknown CI method"):
+            rate_estimate(1, 4, method="bayes")
+
+    def test_sweep_estimators_overflow_is_per_chunk(self):
+        chunks = [
+            types.SimpleNamespace(trials=8, successes=6, overflow=False),
+            types.SimpleNamespace(trials=8, successes=7, overflow=True),
+        ]
+        s = SweepEstimators().observe_all(chunks).summary()
+        assert s["success_rate"]["k"] == 13
+        assert s["success_rate"]["n"] == 16
+        assert s["overflow_chunk_rate"]["k"] == 1
+        assert s["overflow_chunk_rate"]["n"] == 2  # chunks, not trials
+        # Manifest shape: every rate is a certified estimate.
+        for key in ("success_rate", "overflow_chunk_rate"):
+            assert {"lo", "hi", "method", "confidence"} <= set(s[key])
+
+    def test_round_histogram_bins_and_total(self):
+        bins = round_histogram([0, 0, 1, 3], n_rounds=3)
+        assert [b["round"] for b in bins] == [0, 1, 2, 3]
+        assert [b["k"] for b in bins] == [2, 1, 0, 1]
+        assert all(b["n"] == 4 and "lo" in b and "hi" in b for b in bins)
+        # Pre-counted mapping form agrees.
+        from_map = round_histogram({0: 2, 1: 1, 3: 1}, n_rounds=3)
+        assert from_map == bins
+
+
+class TestSequentialRules:
+    def test_stop_decision_rejects_unknown_reason(self):
+        with pytest.raises(ValueError, match="unknown stop reason"):
+            StopDecision(reason="vibes", n_trials=1, bound=0.0)
+
+    def test_sprt_decides_fast_away_from_threshold(self):
+        up = SPRT(threshold=1 / 3)
+        up.observe(30, 32)
+        dec = up.decision()
+        assert dec is not None and dec.reason == "decided_above"
+        assert dec.threshold == pytest.approx(1 / 3)
+        assert dec.estimate.method == "mixture_martingale"
+        down = SPRT(threshold=1 / 3)
+        down.observe(0, 32)
+        assert down.decision().reason == "decided_below"
+
+    def test_sprt_chunk_aggregation_is_exact(self):
+        # The LLR is linear in the success count: one observe(12, 40)
+        # must equal four observe(3, 10).
+        whole, parts = SPRT(threshold=0.5), SPRT(threshold=0.5)
+        whole.observe(12, 40)
+        for _ in range(4):
+            parts.observe(3, 10)
+        assert whole.llr == pytest.approx(parts.llr)
+
+    def test_sprt_error_rate_under_simulation(self):
+        # Fixed-seed simulation at the H1 boundary p = threshold+delta:
+        # the fraction of runs that wrongly accept H0 is bounded by
+        # beta's design value (0.05 here; the assertion allows the
+        # simulation slack of 200 runs, and the seed makes it exact).
+        rng = np.random.default_rng(20260805)
+        threshold, delta = 0.5, 0.05
+        wrong = undecided = 0
+        for _ in range(200):
+            sprt = SPRT(threshold=threshold, delta=delta)
+            for _chunk in range(400):
+                k = rng.binomial(16, threshold + delta)
+                sprt.observe(int(k), 16)
+                dec = sprt.decision()
+                if dec is not None:
+                    wrong += dec.reason == "decided_below"
+                    break
+            else:
+                undecided += 1
+        assert undecided == 0  # budget was ample
+        assert wrong / 200 <= 0.06
+
+    def test_martingale_ci_is_anytime_valid_on_fixed_seed(self):
+        # One fixed-seed sample path at p=0.4: the running interval must
+        # contain the truth at EVERY checkpoint (that is the sequence's
+        # whole point), and the width must shrink.
+        rng = np.random.default_rng(7)
+        ci = MixtureMartingaleCI(confidence=0.95)
+        widths = []
+        for _ in range(50):
+            ci.observe(int(rng.binomial(32, 0.4)), 32)
+            lo, hi = ci.interval()
+            assert lo <= 0.4 <= hi
+            widths.append(hi - lo)
+        assert widths[-1] < widths[0] / 3
+
+    def test_martingale_width_rule_fires(self):
+        ci = MixtureMartingaleCI(confidence=0.95, target_width=0.2)
+        ci.observe(240, 480)
+        dec = ci.decision()
+        assert dec is not None and dec.reason == "ci_width"
+        assert dec.bound <= 0.2
+        assert dec.estimate.lo <= 0.5 <= dec.estimate.hi
+
+    def test_exhausted_reports_partial_interval(self):
+        ci = MixtureMartingaleCI(confidence=0.95, target_width=0.001)
+        ci.observe(3, 8)
+        assert ci.decision() is None
+        dec = ci.exhausted()
+        assert dec.reason == "budget_exhausted"
+        assert dec.n_trials == 8
+        assert dec.estimate.width == pytest.approx(dec.bound)
+
+
+class TestTargetGrammar:
+    def test_decide_with_fraction_and_defaults(self):
+        t = parse_target("decide vs 1/3")
+        assert t.kind == "decide"
+        assert t.threshold == pytest.approx(1 / 3)
+        assert t.confidence == 0.95 and t.delta == 0.05
+        assert isinstance(t.make_rule(), SPRT)
+
+    def test_decide_with_delta_and_confidence(self):
+        t = parse_target("decide vs 0.5 +-0.1 @ 99%")
+        assert (t.threshold, t.delta, t.confidence) == (0.5, 0.1, 0.99)
+
+    def test_ci_width_target(self):
+        t = parse_target("ci_width<=0.02 @ 90%")
+        assert t.kind == "ci_width"
+        assert (t.width, t.confidence) == (0.02, 0.90)
+        rule = t.make_rule()
+        assert isinstance(rule, MixtureMartingaleCI)
+        assert rule.target_width == 0.02
+
+    @pytest.mark.parametrize("bad", [
+        "decide vs 2", "decide vs 1/0", "ci_width<=0", "decide 1/3",
+        "ci_width<=0.1 @ 200%", "run until done",
+    ])
+    def test_rejects_malformed_specs(self, bad):
+        with pytest.raises(ValueError):
+            parse_target(bad)
+
+    def test_round_trips_spec_in_json(self):
+        t = parse_target("decide vs 1/3 @ 95%")
+        assert t.to_json()["spec"] == "decide vs 1/3 @ 95%"
+
+
+class TestAdaptiveAllocator:
+    def test_bootstrap_then_uncertainty_order(self):
+        target = parse_target("ci_width<=0.05")
+        alloc = AdaptiveAllocator(["a", "b", "c"], target, budget_chunks=10)
+        # Every cell gets one chunk before any cell gets two; b comes
+        # back maximally uncertain, a and c nearly resolved.
+        first = []
+        for k, n in [(0, 400), (8, 16), (400, 400)]:
+            cell = alloc.next_cell()
+            first.append(cell)
+            alloc.record(cell, k, n)
+        assert first == [0, 1, 2]
+        assert alloc.next_cell() == 1
+        assert [t["reason"] for t in alloc.trace[:3]] == ["bootstrap"] * 3
+
+    def test_decide_target_prioritizes_straddling_cells(self):
+        target = parse_target("decide vs 1/3")
+        alloc = AdaptiveAllocator(["low", "near"], target, budget_chunks=10)
+        alloc.next_cell(), alloc.record(0, 1, 64)   # far below 1/3
+        alloc.next_cell(), alloc.record(1, 6, 16)   # CI straddles 1/3
+        nxt = alloc.next_cell()
+        assert nxt == 1
+        assert alloc.trace[-1]["reason"] == "straddling"
+
+    def test_budget_exhaustion_and_finish(self):
+        target = parse_target("ci_width<=0.0001")
+        alloc = AdaptiveAllocator(["a"], target, budget_chunks=2)
+        for _ in range(2):
+            idx = alloc.next_cell()
+            alloc.record(idx, 4, 8)
+        assert alloc.next_cell() is None
+        alloc.finish()
+        (dec,) = alloc.decisions()
+        assert dec.reason == "budget_exhausted"
+        s = alloc.summary()
+        assert s["spent_chunks"] == 2 and s["budget_chunks"] == 2
+        assert s["cells"][0]["decision"]["reason"] == "budget_exhausted"
+
+    def test_deterministic_replay(self):
+        # Same counts in => same schedule and trace out; no RNG anywhere.
+        target = parse_target("decide vs 1/3")
+        # Bootstrap gives 0 then 1; afterwards cell 0 (counts near 1/3)
+        # stays in the straddling tier and keeps winning.
+        counts = [(0, 3, 8), (1, 7, 8), (0, 2, 8), (0, 2, 8)]
+
+        def drive():
+            alloc = AdaptiveAllocator(["x", "y"], target, budget_chunks=4)
+            for want_cell, k, n in counts:
+                got = alloc.next_cell()
+                assert got == want_cell
+                alloc.record(got, k, n)
+            return alloc.trace
+
+        assert drive() == drive()
+
+    def test_preload_traces_resume(self):
+        target = parse_target("ci_width<=0.5")
+        alloc = AdaptiveAllocator(["a", "b"], target, budget_chunks=4)
+        alloc.preload(0, 4, 8)
+        assert alloc.trace[0]["reason"] == "resume"
+        assert alloc.spent_chunks == 1
+
+    def test_validation(self):
+        target = parse_target("decide vs 1/3")
+        with pytest.raises(ValueError, match="at least one cell"):
+            AdaptiveAllocator([], target, budget_chunks=1)
+        with pytest.raises(ValueError, match="budget_chunks"):
+            AdaptiveAllocator(["a"], target, budget_chunks=0)
+
+
+def _coin_runner(p=0.75):
+    """Cheap deterministic fake runner: success bits drawn from the
+    chunk's own key tree (same keys => same bits, like the real
+    engines), overflow never."""
+    import jax
+
+    def runner(cfg, keys):
+        bits = jax.random.bernoulli(keys[0], p, (keys.shape[0],))
+        return types.SimpleNamespace(
+            success=np.asarray(bits),
+            overflow=np.zeros(keys.shape[0], dtype=bool),
+        )
+
+    return runner
+
+
+class TestTargetedSweep:
+    def test_targeted_run_is_bit_identical_prefix_of_fixed(self):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=1, trials=16, seed=5)
+        fixed = run_sweep(cfg, n_chunks=8, chunk_trials=16,
+                          runner=_coin_runner())
+        tgt = run_sweep(cfg, n_chunks=8, chunk_trials=16,
+                        runner=_coin_runner(),
+                        target="decide vs 1/3 @ 95%")
+        assert tgt.stop is not None and tgt.stop.decided
+        assert len(tgt.chunks) < len(fixed.chunks)  # strictly fewer trials
+        assert tgt.chunks == fixed.chunks[: len(tgt.chunks)]
+        # The anytime CI at stop excludes the threshold.
+        est = tgt.stop.estimate
+        assert est.lo > 1 / 3
+
+    def test_budget_exhausted_is_an_honest_answer(self):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=1, trials=8, seed=5)
+        res = run_sweep(cfg, n_chunks=2, chunk_trials=8,
+                        runner=_coin_runner(),
+                        target="ci_width<=0.0001")
+        assert res.stop.reason == "budget_exhausted"
+        assert res.n_trials == 16
+        summary = res.stats_summary()
+        assert summary["stop"]["reason"] == "budget_exhausted"
+        assert summary["success_rate"]["n"] == 16
+
+    def test_targeted_resume_lands_in_identical_state(self, tmp_path):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=1, trials=16, seed=5)
+        ckpt = str(tmp_path / "t.json")
+        solo = run_sweep(cfg, n_chunks=12, chunk_trials=4,
+                         runner=_coin_runner(),
+                         target="decide vs 1/3 @ 95%")
+        assert solo.stop.decided and len(solo.chunks) > 1
+        # Interrupted run: budget of 1 chunk, then resume with the full
+        # budget — same chunks, same stop as the uninterrupted run.
+        part = run_sweep(cfg, n_chunks=1, chunk_trials=4,
+                         runner=_coin_runner(), checkpoint=ckpt,
+                         target="decide vs 1/3 @ 95%")
+        assert part.stop.reason == "budget_exhausted"
+        res = run_sweep(cfg, n_chunks=12, chunk_trials=4,
+                        runner=_coin_runner(), checkpoint=ckpt,
+                        target="decide vs 1/3 @ 95%")
+        assert res.resumed_chunks == 1
+        assert res.chunks == solo.chunks
+        assert res.stop.reason == solo.stop.reason
+        assert res.stop.n_trials == solo.stop.n_trials
+        # The checkpoint carries the target + stop stats block.
+        payload = json.loads((tmp_path / "t.json").read_text())
+        assert payload["stats"]["target"]["spec"] == "decide vs 1/3 @ 95%"
+
+    def test_checkpoint_mismatch_is_typed_and_forceable(self, tmp_path):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=0, trials=4, seed=2)
+        ckpt = str(tmp_path / "c.json")
+        run_sweep(cfg, n_chunks=1, chunk_trials=4, runner=_coin_runner(),
+                  checkpoint=ckpt)
+        with pytest.raises(QBACheckpointMismatch) as ei:
+            load_checkpoint(ckpt, cfg, 8)
+        err = ei.value
+        assert isinstance(err, ValueError)  # existing pins keep working
+        assert err.kind == "chunk_trials" and err.forceable
+        assert (err.checkpoint_fingerprint, err.requested_fingerprint) == (4, 8)
+        # --resume-force: warn, discard, re-chunk.
+        with pytest.warns(QBACheckpointMismatch, match="resume-force"):
+            assert load_checkpoint(ckpt, cfg, 8, force=True) == []
+
+    def test_config_mismatch_never_forceable(self, tmp_path):
+        cfg = QBAConfig(n_parties=3, size_l=8, n_dishonest=0, trials=4)
+        ckpt = str(tmp_path / "c.json")
+        run_sweep(cfg, n_chunks=1, chunk_trials=4, runner=_coin_runner(),
+                  checkpoint=ckpt)
+        other = dataclasses.replace(cfg, n_dishonest=1)
+        with pytest.raises(QBACheckpointMismatch) as ei:
+            load_checkpoint(ckpt, other, 4, force=True)
+        assert ei.value.kind == "config" and not ei.value.forceable
+
+
+class TestTargetedSurface:
+    def test_adaptive_vs_uniform_differential(self, tmp_path):
+        # Two cells with very different uncertainty: adaptive allocation
+        # runs DIFFERENT chunk counts per cell, but every chunk it does
+        # run is bit-identical to the uniform sweep's chunk of the same
+        # index (keys are a pure function of (seed, chunk), so the
+        # schedule can never change the data).
+        cfg = QBAConfig(n_parties=3, size_l=4, n_dishonest=1, trials=16,
+                        seed=11)
+
+        def runner(cfg, keys):
+            # Easy cell at size_l=4 (rate ~0.97), hard cell at size_l=8
+            # (rate ~0.5, wide CI forever).
+            return _coin_runner(0.97 if cfg.size_l == 4 else 0.5)(cfg, keys)
+
+        kw = dict(
+            strategies=["reference"], noise_points=[(0.0, 0.0)],
+            size_ls=[4, 8], chunk_trials=16, runner=runner,
+            with_manifest=False,
+        )
+        uniform = run_surface(cfg, n_chunks=8, **kw)
+        adaptive = run_surface(
+            cfg, n_chunks=8, target="ci_width<=0.3 @ 95%",
+            budget_chunks=8, **kw,
+        )
+        by_l = {c.size_l: c for c in adaptive}
+        uni_by_l = {c.size_l: c for c in uniform}
+        # The allocator spent more of the shared budget on the hard cell.
+        assert len(by_l[8].result.chunks) > len(by_l[4].result.chunks)
+        # Bit-identical chunk results wherever both schedules ran.
+        for L in (4, 8):
+            got = by_l[L].result.chunks
+            assert got == uni_by_l[L].result.chunks[: len(got)]
+        assert by_l[4].result.stop.reason == "ci_width"
+
+    def test_surface_manifest_carries_stats_and_allocator(self, tmp_path):
+        cfg = QBAConfig(n_parties=3, size_l=4, n_dishonest=1, trials=16,
+                        seed=3)
+        cells = run_surface(
+            cfg, strategies=["reference"], noise_points=[(0.0, 0.0)],
+            size_ls=[4], n_chunks=2, chunk_trials=16,
+            runner=_coin_runner(), target="decide vs 1/3 @ 95%",
+        )
+        (cell,) = cells
+        stats = cell.manifest["stats"]
+        assert stats["target"]["spec"] == "decide vs 1/3 @ 95%"
+        assert stats["stop"]["reason"] in (
+            "decided_above", "decided_below", "budget_exhausted",
+        )
+        assert {"lo", "hi"} <= set(stats["success_rate"])
+        alloc = stats["allocator"]
+        assert alloc["spent_chunks"] <= alloc["budget_chunks"]
+        assert alloc["trace"][0]["reason"] == "bootstrap"
+        from qba_tpu.obs.manifest import validate_manifest
+
+        validate_manifest(cell.manifest)
+
+
+class TestManifestLint:
+    def test_bare_rate_is_flagged_certified_is_not(self):
+        from qba_tpu.analysis.manifests import check_manifest
+
+        bad = {
+            "success_rate": 0.9,
+            "nested": [{"drop_ratio": 1}],
+            "ok_rate": {"rate": 0.5, "lo": 0.4, "hi": 0.6},
+            "p_depolarize": 0.05,     # config input, not a measurement
+            "enable_rate": True,      # bool is not a numeric rate
+        }
+        report = check_manifest(bad, label="fixture")
+        flagged = {f.where for f in report.findings}
+        assert flagged == {"success_rate", "nested[0].drop_ratio"}
+        assert all(f.ki == "KI-8" for f in report.findings)
+
+    def test_certified_estimate_fields_not_descended(self):
+        from qba_tpu.analysis.manifests import check_manifest
+
+        ok = {"stats": {"success_rate": {
+            "rate": None, "lo": 0.0, "hi": 1.0, "method": "wilson",
+        }}}
+        assert check_manifest(ok).ok
+
+    def test_missing_file_is_a_finding(self, tmp_path):
+        from qba_tpu.analysis.manifests import check_manifest_files
+
+        report = check_manifest_files([str(tmp_path / "nope.json")])
+        assert not report.ok
+        assert "does not exist" in report.findings[0].message
+
+    def test_produced_sweep_manifest_is_clean(self, tmp_path):
+        # The repo's own telemetry output must pass its own gate.
+        import io
+
+        from qba_tpu.analysis.manifests import check_manifest_files
+        from qba_tpu.cli import main
+
+        tel = str(tmp_path / "tel")
+        rc = main(
+            ["sweep", "--n-parties", "3", "--size-l", "4", "--trials", "8",
+             "--n-chunks", "2", "--target", "decide vs 1/3",
+             "--telemetry", tel],
+            out=io.StringIO(),
+        )
+        assert rc == 0
+        report = check_manifest_files([tel + "/run_manifest.json"])
+        assert report.ok, report.render()
+        assert report.stats["manifests_checked"] == 1
