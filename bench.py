@@ -128,6 +128,88 @@ def _measure_device(
     }
 
 
+def _mega_vs_fused(quick: bool) -> list[dict]:
+    """Round-8 launch-overhead decomposition: the same Monte-Carlo
+    batch timed under ``round_engine="pallas_mega"`` (one launch per
+    trial) and ``"pallas_fused"`` (one launch per round), same keys,
+    same trial count.  Because the two engines are bit-identical (the
+    megakernel equivalence tests), the wall-time gap divided by the
+    launch-count gap is a direct per-launch fixed-overhead estimate —
+    ``fixed_overhead_share`` is the fraction of the fused engine's
+    time that the in-kernel round loop eliminates.
+
+    Config points: the headline shape (11p/L64), a launch-bound shape
+    (33p/L8: 11 rounds of tiny kernels — overhead-dominated), and the
+    north-star (33p/L64) gated to TPU (``QBA_BENCH_NS=1`` overrides)
+    because the megakernel honestly demotes there by VMEM estimate and
+    off-TPU both engines run minutes-slow in interpret mode.
+
+    Standing caveat (docs/PERF.md): off-TPU these numbers come from the
+    Pallas interpreter on CPU — valid for RELATIVE overhead share with
+    the same CPU-fenced methodology, not absolute throughput."""
+    import dataclasses
+
+    import jax
+
+    from qba_tpu.config import QBAConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    points = [
+        ("n11_l64_d3", dict(n_parties=11, size_l=64, n_dishonest=3)),
+        # Launch-bound: 5 rounds of small kernels, megakernel live.
+        ("n17_l16_d4", dict(n_parties=17, size_l=16, n_dishonest=4)),
+        # 11 rounds but the per-round working set alone crowds the
+        # 64 MiB mega budget — the row records the honest demotion.
+        ("n33_l8_d10", dict(n_parties=33, size_l=8, n_dishonest=10)),
+    ]
+    if on_tpu or os.environ.get("QBA_BENCH_NS") == "1":
+        points.append(
+            ("northstar_n33_l64_d10",
+             dict(n_parties=33, size_l=64, n_dishonest=10)),
+        )
+    trials = 4 if quick else (64 if on_tpu else 16)
+    reps = 2 if quick else 4
+    rows = []
+    for label, kw in points:
+        row: dict = {"config": label, "trials": trials}
+        try:
+            from qba_tpu.benchmark import engine_description, kernel_plan
+
+            per = {}
+            for eng in ("pallas_mega", "pallas_fused"):
+                cfg = QBAConfig(**kw, trials=trials, seed=0)
+                cfg = dataclasses.replace(cfg, round_engine=eng)
+                times, n_run = _measure_jax(cfg, reps=reps)
+                plan = kernel_plan(cfg)
+                per[eng] = {
+                    "median_seconds": round(statistics.median(times), 4),
+                    "rep_seconds": [round(t, 4) for t in times],
+                    "engine": engine_description(cfg),
+                    "launches_per_trial": plan["launches_per_trial"],
+                }
+                row[eng] = per[eng]
+            t_m = per["pallas_mega"]["median_seconds"]
+            t_f = per["pallas_fused"]["median_seconds"]
+            l_m = per["pallas_mega"]["launches_per_trial"]
+            l_f = per["pallas_fused"]["launches_per_trial"]
+            if None not in (l_m, l_f) and l_f > l_m and t_f > 0:
+                row["per_launch_overhead_s"] = round(
+                    max(t_f - t_m, 0.0) / (trials * (l_f - l_m)), 6
+                )
+                row["fixed_overhead_share"] = round(
+                    max(1.0 - t_m / t_f, 0.0), 4
+                )
+            row["methodology"] = (
+                "cpu-fenced interpret-mode (relative share only)"
+                if not on_tpu else "tpu, fence-at-end"
+            )
+        except Exception as e:  # comparison must never sink the gate
+            row["error"] = repr(e)[:300]
+        rows.append(row)
+        print(f"mega_vs_fused {label}: {row}", file=sys.stderr)
+    return rows
+
+
 def main() -> None:
     from qba_tpu.compile_cache import enable_compile_cache
     from qba_tpu.config import QBAConfig
@@ -285,6 +367,16 @@ def main() -> None:
         print(f"resource_gen measurement failed: {e!r}", file=sys.stderr)
         resource_gen = {"error": repr(e)[:300]}
 
+    # Round-8 launch-overhead decomposition (pallas_mega vs
+    # pallas_fused, bit-identical engines, same keys) — the BENCH_r06
+    # evidence that the in-kernel round loop removes the per-round
+    # fixed launch overhead.
+    try:
+        mega_vs_fused = _mega_vs_fused(quick)
+    except Exception as e:  # comparison must never sink the gate
+        print(f"mega_vs_fused measurement failed: {e!r}", file=sys.stderr)
+        mega_vs_fused = None
+
     # Headline: the device-side median when available (slope method, no
     # tunnel fetch in the number — VERDICT r4 item 4 made the median the
     # gate); wall best-of/median stay in the JSON for continuity with
@@ -348,6 +440,7 @@ def main() -> None:
         **(device or {}),
         "northstar": northstar,
         "resource_gen": resource_gen,
+        "mega_vs_fused": mega_vs_fused,
         "manifest": manifest,
     }
     print(json.dumps(out, default=str))

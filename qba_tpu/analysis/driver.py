@@ -54,7 +54,8 @@ LINT_MATRIX = (
 )
 
 ENGINE_CHOICES = (
-    "xla", "pallas", "pallas_tiled", "pallas_fused", "spmd", "gf2",
+    "xla", "pallas", "pallas_tiled", "pallas_fused", "pallas_mega",
+    "spmd", "gf2",
 )
 
 
@@ -118,9 +119,11 @@ def _lint_config(
         report.extend(check_gf2_memory(cfg))
     if effects:
         from qba_tpu.analysis.effects import check_effects
+        from qba_tpu.analysis.launches import check_launches
         from qba_tpu.analysis.transfers import check_jaxpr_transfers
 
         report.extend(check_effects(cfg, paths, engine_set))
+        report.extend(check_launches(cfg, engine_set))
         report.extend(check_jaxpr_transfers(paths))
     return report
 

@@ -59,6 +59,10 @@ from qba_tpu.config import QBAConfig
 DONATE_ALLOW_MARKER = "qba-lint: donate-ok"
 
 #: Engines whose ``run_trial`` round scans the carry audit traces.
+#: ``pallas_mega`` is deliberately NOT here: its round loop runs
+#: inside the kernel, so there is no scan to audit — :func:`_audit_mega`
+#: instead PROVES the scan is gone (exactly one ``pallas_call``, zero
+#: kernel-launching scans in the whole trial jaxpr).
 SCAN_ENGINES = ("xla", "pallas", "pallas_tiled", "pallas_fused")
 
 #: Shape/dtype-preserving primitives the carry chase looks through —
@@ -453,6 +457,88 @@ def _audit_engine_scans(cfg, engines, report, stats) -> None:
             )
 
 
+def _audit_mega(cfg, report, stats) -> None:
+    """KI-5 for the scan-free megakernel engine: the donation story of
+    ``pallas_mega`` is that there are NO round-scan carries at all —
+    vi/pool state lives in VMEM scratch inside one launch.  The audit
+    must prove that claim from the jaxpr, not silently skip a scan it
+    cannot find: trace ``run_trial`` with the engine forced and assert
+    (a) zero ``lax.scan``s whose body launches a kernel, and (b)
+    exactly ONE ``pallas_call`` in the whole trial.  A recorded
+    demotion (no plan / counters requested) is noted — the demoted
+    path is one of the :data:`SCAN_ENGINES` and gets the ordinary
+    carry audit on its own trace."""
+    import jax  # noqa: F401  (trace_trial_scan uses it)
+
+    from qba_tpu.diagnostics import QBADemotionWarning
+
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            closed = trace_trial_scan(cfg, "pallas_mega")
+    except Exception as exc:
+        report.findings.append(Finding(
+            ki="KI-5", check="mega-one-launch", path="pallas_mega/run_trial",
+            message=(
+                f"megakernel trial trace failed ({type(exc).__name__}: "
+                f"{exc}) — neither the one-launch proof nor a recorded "
+                "demotion exists for this config"
+            ),
+        ))
+        return
+    demotions = [
+        w for w in caught if issubclass(w.category, QBADemotionWarning)
+    ]
+    if demotions:
+        report.notes.append(
+            "effects/pallas_mega: recorded demotion at this config "
+            f"({demotions[0].message}) — the demoted engine's scan is "
+            "audited under its own trace"
+        )
+        stats["mega_demotions_recorded"] += 1
+        return
+    kernel_scans = sum(
+        1 for s in _find_scans(closed.jaxpr)
+        if _contains_pallas(
+            s.params["jaxpr"].jaxpr
+            if hasattr(s.params["jaxpr"], "jaxpr")
+            else s.params["jaxpr"]
+        )
+    )
+    launches = sum(
+        1 for e in iter_eqns(closed.jaxpr)
+        if e.primitive.name == "pallas_call"
+    )
+    stats["mega_launches_counted"] = launches
+    if kernel_scans:
+        report.findings.append(Finding(
+            ki="KI-5", check="mega-one-launch",
+            path="pallas_mega/run_trial",
+            message=(
+                f"megakernel trial still contains {kernel_scans} "
+                "kernel-launching scan(s): the round loop has NOT moved "
+                "in-kernel, and its carries escape the donation audit "
+                "(SCAN_ENGINES does not trace pallas_mega)"
+            ),
+        ))
+    if launches != 1:
+        report.findings.append(Finding(
+            ki="KI-5", check="mega-one-launch",
+            path="pallas_mega/run_trial",
+            message=(
+                f"megakernel trial launches {launches} pallas_call(s), "
+                "expected exactly 1 — the one-launch-per-trial contract "
+                "(docs/PERF.md round 8) is broken"
+            ),
+        ))
+    if not kernel_scans and launches == 1:
+        report.notes.append(
+            "effects/pallas_mega: round scan PROVEN eliminated — "
+            "1 pallas_call, 0 kernel-launching scans in the full trial "
+            "jaxpr (no host carries exist to donate)"
+        )
+
+
 # ---------------------------------------------------------------------------
 # Top-level jit donation audit (AST).
 
@@ -589,6 +675,7 @@ def check_effects(cfg: QBAConfig, paths, engines) -> Report:
         "passthrough_carries": 0,
         "xla_carries": 0,
         "scans_without_kernels": 0,
+        "mega_demotions_recorded": 0,
     }
     kernel_free_paths = []
     for p in paths:
@@ -604,5 +691,7 @@ def check_effects(cfg: QBAConfig, paths, engines) -> Report:
             f"reuse): {', '.join(sorted(kernel_free_paths))}"
         )
     _audit_engine_scans(cfg, set(engines), report, stats)
+    if "pallas_mega" in set(engines):
+        _audit_mega(cfg, report, stats)
     report.stats.update(stats)
     return report

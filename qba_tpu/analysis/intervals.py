@@ -486,7 +486,10 @@ class IntervalInterpreter:
 
     def _prim_div(self, eqn, env):
         a, b = (self._read(env, v) for v in eqn.invars)
-        if b.bounded and (b.lo > 0 or b.hi < 0):
+        # An unbounded dividend stays unbounded (scan-widened carries
+        # inside the megakernel's in-kernel round loop reach here);
+        # flooring an infinite corner would raise.
+        if a.bounded and b.bounded and (b.lo > 0 or b.hi < 0):
             corners = [a.lo / b.lo, a.lo / b.hi, a.hi / b.lo, a.hi / b.hi]
             is_int = np.issubdtype(eqn.outvars[0].aval.dtype, np.integer)
             if is_int:

@@ -1,0 +1,153 @@
+"""Launch-count accounting: ``pallas_call`` launches per trial.
+
+PERF.md's overhead attribution and the megakernel's one-launch
+contract both rest on a number nobody computed generically until now:
+how many kernel launches one trial dispatches on a given engine.  The
+one-``pallas_call`` jaxpr assertion in tests/test_round_kernel_fused.py
+hard-coded it for the fused engine; this module generalizes that into
+:func:`launches_per_trial` (a static count over the full ``run_trial``
+jaxpr, scan trip counts multiplied through) and a ``qba-tpu lint``
+check that PINS each engine to its launch model:
+
+========================  =======================================
+engine                    launches per trial
+========================  =======================================
+``xla``                   0 (no kernels)
+``pallas``                ``n_rounds`` (one monolithic call/round)
+``pallas_tiled``          ``2 * n_rounds`` (verdict + rebuild)
+``pallas_fused``          ``n_rounds`` (one fused call/round)
+``pallas_mega``           1 (decode + all rounds + decision reduce)
+========================  =======================================
+
+A drift in these counts is a perf regression the runtime would never
+surface (everything stays bit-identical), so the pin is a lint
+finding, tagged KI-5 with the donation/launch-discipline family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+
+from qba_tpu.analysis.findings import Finding, Report
+from qba_tpu.config import QBAConfig
+
+#: Engine -> expected launches per trial, as a function of the config.
+LAUNCH_MODEL = {
+    "xla": lambda cfg: 0,
+    "pallas": lambda cfg: cfg.n_rounds,
+    "pallas_tiled": lambda cfg: 2 * cfg.n_rounds,
+    "pallas_fused": lambda cfg: cfg.n_rounds,
+    "pallas_mega": lambda cfg: 1,
+}
+
+
+def count_pallas_launches(jaxpr) -> int:
+    """Total ``pallas_call`` launches one evaluation of ``jaxpr``
+    performs: scans multiply their body's count by the trip count,
+    ``cond`` takes the max over branches, other sub-jaxprs add up.
+    Kernel bodies are not descended into (a kernel cannot launch a
+    kernel)."""
+    from qba_tpu.analysis.effects import _as_jaxprs
+
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "pallas_call":
+            total += 1
+            continue
+        subs = [
+            count_pallas_launches(s)
+            for p in eqn.params.values()
+            for s in _as_jaxprs(p)
+        ]
+        if not subs:
+            continue
+        if name == "scan":
+            total += eqn.params.get("length", 1) * sum(subs)
+        elif name == "cond":
+            total += max(subs)
+        else:
+            total += sum(subs)
+    return total
+
+
+def _trace_trial(cfg: QBAConfig, engine: str | None):
+    import jax
+
+    from qba_tpu.rounds.engine import run_trial
+
+    ecfg = (
+        dataclasses.replace(cfg, round_engine=engine)
+        if engine is not None
+        else cfg
+    )
+    key = jax.random.key(0)
+    return jax.make_jaxpr(lambda k: run_trial(ecfg, k))(key)
+
+
+def launches_per_trial(cfg: QBAConfig, engine: str | None = None) -> int:
+    """Kernel launches one trial dispatches, from the full
+    ``run_trial`` jaxpr with the round engine forced to ``engine``
+    (None = the config's own resolution, demotions and all)."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        closed = _trace_trial(cfg, engine)
+    return count_pallas_launches(closed.jaxpr)
+
+
+def check_launches(cfg: QBAConfig, engines) -> Report:
+    """Pin every requested engine's per-trial launch count to
+    :data:`LAUNCH_MODEL`.  An engine that records a
+    :class:`~qba_tpu.diagnostics.QBADemotionWarning` during the trace
+    is noted, not pinned — the demoted engine is pinned under its own
+    entry."""
+    from qba_tpu.diagnostics import QBADemotionWarning
+
+    report = Report()
+    checked = 0
+    for engine in LAUNCH_MODEL:
+        if engine not in engines:
+            continue
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                closed = _trace_trial(cfg, engine)
+        except Exception as exc:
+            report.notes.append(
+                f"launches/{engine}: trace failed, pin skipped "
+                f"({type(exc).__name__}: {exc})"
+            )
+            continue
+        count = count_pallas_launches(closed.jaxpr)
+        if any(
+            issubclass(w.category, QBADemotionWarning) for w in caught
+        ):
+            report.notes.append(
+                f"launches/{engine}: demotion recorded during trace — "
+                f"launch pin skipped (counted {count} on the demoted "
+                "path)"
+            )
+            continue
+        checked += 1
+        expect = LAUNCH_MODEL[engine](cfg)
+        if count != expect:
+            report.findings.append(Finding(
+                ki="KI-5", check="launches-per-trial",
+                path=f"{engine}/run_trial",
+                message=(
+                    f"{count} pallas_call launch(es) per trial, the "
+                    f"engine's launch model says {expect} — either the "
+                    "dispatch grew an extra launch (perf regression "
+                    "the runtime never surfaces) or the model in "
+                    "analysis/launches.py needs a conscious update"
+                ),
+            ))
+        else:
+            report.notes.append(
+                f"launches/{engine}: {count} launch(es) per trial "
+                "(= model)"
+            )
+    report.stats["launch_engines_checked"] = checked
+    return report

@@ -223,6 +223,27 @@ def _audit_plans(cfg: QBAConfig, n_recv: int | None, report: Report,
         resolve_fused_block(cfg, n_recv=n_recv, trial_pack=pack),
         "demotes to the two-kernel tiled path on TPU",
     )
+    if n_recv is None:
+        # The trial megakernel is global-only (no party-sharded
+        # variant; spmd demotes it to fused) — its whole-launch VMEM
+        # scratch budget is the KI-2 entry that decides whether one
+        # trial's decode + all rounds + reduce fit residency at once.
+        from qba_tpu.ops.round_kernel_tiled import (
+            _MEGA_BUDGET,
+            _mega_estimate,
+            mega_candidates,
+            resolve_mega_block,
+        )
+
+        mega_plan = resolve_mega_block(cfg, trial_pack=pack)
+        check(
+            "pallas_mega/trial",
+            mega_candidates(cfg, blk_v, pack), n_pool,
+            lambda b: _mega_estimate(cfg, b, blk_v, pack),
+            _MEGA_BUDGET, "_MEGA_BUDGET",
+            mega_plan[0] if mega_plan is not None else None,
+            "demotes to the fused per-round engine on TPU",
+        )
 
 
 def gf2_tableau_bytes(cfg: QBAConfig) -> dict:

@@ -341,6 +341,53 @@ def trace_fused(cfg: QBAConfig, n_recv: int | None = None, out_vma=None):
     return [_trace(f"{prefix}pallas_fused/round", fused, args, seeds)], []
 
 
+def trace_mega(cfg: QBAConfig, out_vma=None):
+    """The trial megakernel: decode + in-kernel round loop + decision
+    reduce in one launch.  Returns ``(paths, notes)`` — a missing plan
+    (:func:`~qba_tpu.ops.round_kernel_tiled.resolve_mega_block`)
+    becomes a note, mirroring the engine's recorded demotion to the
+    fused per-round path."""
+    from qba_tpu.ops.round_kernel_tiled import (
+        honest_cells,
+        resolve_mega_block,
+        resolve_verdict_variant,
+    )
+    from qba_tpu.ops.trial_megakernel import build_trial_megakernel
+
+    sb = _seed_bank(cfg)
+    n_lieu = cfg.n_lieutenants
+    n_pool = n_lieu * cfg.slots
+    variant = resolve_verdict_variant(cfg)
+    plan = resolve_mega_block(cfg)
+    if plan is None:
+        return [], [
+            f"pallas_mega: no megakernel plan at (n_parties="
+            f"{cfg.n_parties}, size_l={cfg.size_l}); demotes to the "
+            "fused per-round engine"
+        ]
+    mega = build_trial_megakernel(
+        cfg, *plan, interpret=_interpret(), variant=variant,
+        out_vma=out_vma,
+    )
+    li_full = jnp.zeros((n_lieu, cfg.size_l), jnp.int32)
+    li_arg, li_seed = _li_arg(cfg, variant, sb)
+    hc = honest_cells(jnp.ones((cfg.n_parties + 1,), bool), cfg)
+    z = jnp.zeros((cfg.n_rounds, n_pool, n_lieu), jnp.int32)
+    args = (
+        jnp.zeros((n_lieu, cfg.size_l), bool),  # p_rows
+        li_full,
+        li_arg,
+        jnp.zeros((n_lieu,), jnp.int32),  # v_sent
+        hc,
+        z, z, z,  # attack / rand_v / late, round-stacked
+    )
+    seeds = (
+        sb["bit"], sb["li"], li_seed, sb["v"], sb["bit"],
+        sb["attack"], sb["rand_v"], sb["bit"],
+    )
+    return [_trace("pallas_mega/trial", mega, args, seeds)], []
+
+
 def trace_gf2(cfg: QBAConfig) -> list[TracedPath]:
     """The batched GF(2) symplectic sampler paths — resource generation
     on ``qsim_path="stabilizer"`` (:mod:`qba_tpu.gf2.symplectic`).
@@ -387,10 +434,12 @@ def trace_gf2(cfg: QBAConfig) -> list[TracedPath]:
 
 def trace_paths(cfg: QBAConfig, engines=None):
     """Trace every requested build path.  ``engines`` is an iterable of
-    {"xla", "pallas", "pallas_tiled", "pallas_fused", "spmd", "gf2"};
-    None traces everything.  Returns ``(paths, notes)``."""
+    {"xla", "pallas", "pallas_tiled", "pallas_fused", "pallas_mega",
+    "spmd", "gf2"}; None traces everything.  Returns
+    ``(paths, notes)``."""
     engines = set(engines) if engines is not None else {
-        "xla", "pallas", "pallas_tiled", "pallas_fused", "spmd", "gf2",
+        "xla", "pallas", "pallas_tiled", "pallas_fused", "pallas_mega",
+        "spmd", "gf2",
     }
     paths: list[TracedPath] = []
     notes: list[str] = []
@@ -404,6 +453,10 @@ def trace_paths(cfg: QBAConfig, engines=None):
         notes += n
     if "pallas_fused" in engines:
         p, n = trace_fused(cfg)
+        paths += p
+        notes += n
+    if "pallas_mega" in engines:
+        p, n = trace_mega(cfg)
         paths += p
         notes += n
     if "gf2" in engines:

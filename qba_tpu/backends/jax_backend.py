@@ -73,24 +73,42 @@ def _run_trials_packed_jit(
     return aggregate(run_trials_fused_packed(cfg, keys, pack))
 
 
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _run_trials_mega_packed_jit(
+    cfg: QBAConfig, keys: jax.Array, pack: int
+) -> MonteCarloResult:
+    from qba_tpu.rounds.engine import run_trials_mega_packed
+
+    return aggregate(run_trials_mega_packed(cfg, keys, pack))
+
+
 def run_trials(cfg: QBAConfig, keys: jax.Array | None = None) -> MonteCarloResult:
     """Run ``cfg.trials`` independent protocol executions, batched.
 
-    On the fused round engine with a resolved trial-pack factor
-    ``k > 1`` that divides the batch, dispatch goes through the packed
-    runner (:func:`qba_tpu.rounds.engine.run_trials_fused_packed` —
-    ``k`` trials per kernel grid); results are bit-identical to the
-    plain vmap path trial for trial."""
+    On the fused or megakernel round engine with a resolved trial-pack
+    factor ``k > 1`` that divides the batch, dispatch goes through the
+    matching packed runner
+    (:func:`qba_tpu.rounds.engine.run_trials_fused_packed` /
+    :func:`~qba_tpu.rounds.engine.run_trials_mega_packed` — ``k``
+    trials per kernel grid or launch); results are bit-identical to
+    the plain vmap path trial for trial."""
     if keys is None:
         keys = trial_keys(cfg)
     from qba_tpu.rounds.engine import resolve_round_engine
 
-    if resolve_round_engine(cfg) == "pallas_fused":
+    engine = resolve_round_engine(cfg)
+    if engine == "pallas_fused":
         from qba_tpu.ops.round_kernel_tiled import resolve_trial_pack
 
         pack = resolve_trial_pack(cfg)
         if pack > 1 and keys.shape[0] % pack == 0:
             return _run_trials_packed_jit(cfg, keys, pack)
+    elif engine == "pallas_mega":
+        from qba_tpu.ops.round_kernel_tiled import resolve_trial_pack
+
+        pack = resolve_trial_pack(cfg)
+        if pack > 1 and keys.shape[0] % pack == 0:
+            return _run_trials_mega_packed_jit(cfg, keys, pack)
     return _run_trials_jit(cfg, keys)
 
 

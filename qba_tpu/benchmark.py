@@ -43,11 +43,22 @@ def kernel_plan(cfg: QBAConfig) -> dict:
     - ``trial_pack``: trials folded per fused kernel grid (1 = no
       packing).
     - ``launches_per_round``: pallas_call launches each round costs —
-      1 on the fused path, 2 on the tiled pair, 1 monolithic, 0 XLA.
+      1 on the fused path, 2 on the tiled pair, 1 monolithic, 0 XLA;
+      None on the megakernel (its launch is per TRIAL, not per round).
+    - ``mega_block``: the trial megakernel's ``(decode, verdict)``
+      block plan (None off the ``pallas_mega`` path or when it demotes
+      on VMEM budget).
+    - ``launches_per_trial``: total pallas_call launches one trial
+      costs under the resolved engine — the round-8 fixed-overhead
+      attribution unit (1 on ``pallas_mega``, ``n_rounds`` fused,
+      ``2 * n_rounds`` tiled, 0 XLA); the lint launch pin
+      (:mod:`qba_tpu.analysis.launches`) proves this model against the
+      traced jaxpr.
 
     Every field is a cached compile-probe verdict (or a static plan
     off-TPU), so calling this after a measurement re-reads the memoized
     resolution the run actually used."""
+    from qba_tpu.analysis.launches import LAUNCH_MODEL
     from qba_tpu.rounds.engine import resolve_round_engine
 
     engine = resolve_round_engine(cfg)
@@ -57,9 +68,32 @@ def kernel_plan(cfg: QBAConfig) -> dict:
         "verdict_block": None,
         "rebuild_block": None,
         "fused_block": None,
+        "mega_block": None,
         "trial_pack": 1,
         "launches_per_round": {"xla": 0, "pallas": 1}.get(engine, 2),
+        "launches_per_trial": LAUNCH_MODEL.get(
+            engine, lambda c: None
+        )(cfg),
     }
+    if engine == "pallas_mega":
+        from qba_tpu.ops.round_kernel_tiled import (
+            resolve_mega_block,
+            resolve_trial_pack,
+            resolve_verdict_variant,
+        )
+
+        plan["variant"] = resolve_verdict_variant(cfg)
+        plan["launches_per_round"] = None
+        mega = resolve_mega_block(cfg)
+        plan["mega_block"] = mega
+        if mega is None or cfg.collect_counters:
+            # run_trial demotes (VMEM budget / counters need the host
+            # scan); attribute the fused path that actually runs.
+            plan["launches_per_trial"] = LAUNCH_MODEL["pallas_fused"](
+                cfg
+            )
+        else:
+            plan["trial_pack"] = resolve_trial_pack(cfg)
     if engine in ("pallas_tiled", "pallas_fused"):
         from qba_tpu.ops.round_kernel_tiled import (
             resolve_rebuild_block,
@@ -102,6 +136,13 @@ def engine_description(cfg: QBAConfig) -> str:
     are per-machine compile probes)."""
     plan = kernel_plan(cfg)
     engine = plan["engine"]
+    if engine == "pallas_mega":
+        desc = f"{engine}/{plan['variant']}"
+        if plan["mega_block"] is None:
+            return desc + "/demoted-to-fused"
+        if cfg.collect_counters:
+            return desc + "/demoted-to-fused(counters)"
+        return desc + f"/pack{plan['trial_pack']}"
     if engine == "pallas_fused":
         desc = f"{engine}/{plan['variant']}"
         if plan["fused_block"] is None:

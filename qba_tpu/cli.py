@@ -62,13 +62,16 @@ def _add_config_args(p: argparse.ArgumentParser, trials_default: int) -> None:
     )
     p.add_argument(
         "--round-engine",
-        choices=("auto", "xla", "pallas", "pallas_tiled", "pallas_fused"),
+        choices=(
+            "auto", "xla", "pallas", "pallas_tiled", "pallas_fused",
+            "pallas_mega",
+        ),
         default="auto",
         help="voting-round engine: auto = the fastest engine that "
-        "compiles for this config (fused single-launch round kernel "
-        "first where it compiles, the packet-tiled kernel pair next, "
-        "monolithic kernel, pure XLA as the final fallback); "
-        "all engines are bit-identical",
+        "compiles for this config (one-launch trial megakernel first "
+        "where its VMEM plan fits, fused single-launch round kernel "
+        "next, the packet-tiled kernel pair, monolithic kernel, pure "
+        "XLA as the final fallback); all engines are bit-identical",
     )
     p.add_argument(
         "--trial-pack", type=int, default=None,
@@ -276,7 +279,8 @@ def _parser() -> argparse.ArgumentParser:
     lint.add_argument(
         "--engines", default=None, metavar="E1,E2,...",
         help="restrict to these build paths "
-        "(xla,pallas,pallas_tiled,pallas_fused,spmd,gf2; default: all)",
+        "(xla,pallas,pallas_tiled,pallas_fused,pallas_mega,spmd,gf2; "
+        "default: all)",
     )
     lint.add_argument(
         "--config", action="append", default=None, metavar="P,L,D",

@@ -62,10 +62,17 @@ class QBAConfig:
         (forces the fused single-launch round kernel — verdict +
         rebuild in one ``pallas_call`` per round, optionally
         trial-packed; demotes to the two-kernel tiled path with a
-        warning where it doesn't compile).  All engines are
-        bit-identical (tests/test_round_kernel.py,
+        warning where it doesn't compile), or "pallas_mega" (forces
+        the trial megakernel — decode + the whole in-kernel round
+        loop + decision reduce in ONE ``pallas_call`` per trial
+        batch, :mod:`qba_tpu.ops.trial_megakernel`; demotes to the
+        fused per-round engine with a warning where the VMEM budget
+        refuses it or when ``collect_counters`` needs the host
+        scan).  All engines are bit-identical
+        (tests/test_round_kernel.py,
         tests/test_round_kernel_tiled.py,
-        tests/test_round_kernel_fused.py).
+        tests/test_round_kernel_fused.py,
+        tests/test_trial_megakernel.py).
       tiled_block: explicit packet-block size for the tiled engine
         (must divide ``n_lieutenants * slots``); None = probe-chosen.
       trial_pack: explicit trial-pack factor ``k`` for the fused round
@@ -194,7 +201,8 @@ class QBAConfig:
         if self.p_late > 0.0 and self.delivery != "racy":
             raise ValueError("p_late > 0 requires delivery='racy'")
         if self.round_engine not in (
-            "auto", "xla", "pallas", "pallas_tiled", "pallas_fused"
+            "auto", "xla", "pallas", "pallas_tiled", "pallas_fused",
+            "pallas_mega",
         ):
             raise ValueError(f"unknown round_engine {self.round_engine!r}")
         if self.tiled_block is not None:

@@ -2050,6 +2050,7 @@ def rebuild_candidates(cfg: QBAConfig, n_recv: int | None = None) -> list[int]:
 _TILED_PROBE_CACHE: dict[tuple, int | None] = {}
 _REBUILD_PROBE_CACHE: dict[tuple, int | None] = {}
 _FUSED_PROBE_CACHE: dict[tuple, int | None] = {}
+_MEGA_PROBE_CACHE: dict[tuple, int | None] = {}
 
 # Resolver memo (PR 2 satellite): every resolve_* entry point caches
 # its verdict per (config shape, backend, n_recv, explicit overrides).
@@ -2107,6 +2108,7 @@ def resolve_cache_info() -> dict:
             "tiled": len(_TILED_PROBE_CACHE),
             "rebuild": len(_REBUILD_PROBE_CACHE),
             "fused": len(_FUSED_PROBE_CACHE),
+            "mega": len(_MEGA_PROBE_CACHE),
             "variant": len(_VARIANT_CACHE),
         },
         "probe_stats": dict(PROBE_STATS),
@@ -2175,6 +2177,7 @@ def export_resolver_state() -> dict:
                 [list(k), v] for k, v in _REBUILD_PROBE_CACHE.items()
             ],
             "fused": [[list(k), v] for k, v in _FUSED_PROBE_CACHE.items()],
+            "mega": [[list(k), v] for k, v in _MEGA_PROBE_CACHE.items()],
         },
     }
 
@@ -2204,6 +2207,8 @@ def import_resolver_state(state: dict) -> int:
         (_TILED_PROBE_CACHE, state.get("probe", {}).get("tiled", [])),
         (_REBUILD_PROBE_CACHE, state.get("probe", {}).get("rebuild", [])),
         (_FUSED_PROBE_CACHE, state.get("probe", {}).get("fused", [])),
+        # Absent in pre-megakernel snapshots — .get keeps schema v1.
+        (_MEGA_PROBE_CACHE, state.get("probe", {}).get("mega", [])),
     ):
         for k, v in entries:
             cache[_key_from_json(k)] = v
@@ -2934,4 +2939,153 @@ def resolve_trial_pack(cfg: QBAConfig) -> int:
     return _memo(
         _resolve_key("pack", cfg),
         lambda: _resolve_trial_pack_impl(cfg),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Trial megakernel: planning + compile probe (docs/PERF.md round 8).
+# The kernel itself lives in ops/trial_megakernel.py (it imports the
+# verdict helper from this module); the planner lives here with the
+# other resolvers so the serve warm-start artifact covers it.
+
+_MEGA_BUDGET = 64 * 2**20
+
+
+def _mega_estimate(cfg: QBAConfig, blk_d: int, blk_v: int,
+                   trial_pack: int = 1) -> int:
+    """Loose VMEM estimate for the one-launch trial kernel: the fused
+    round kernel's per-step terms plus what the in-kernel loop keeps
+    resident for the whole launch — BOTH pool halves (ping-pong A/B
+    scratch), the round-stacked draw slabs, and the entry-decode
+    one-hot intermediates."""
+    n_rv = cfg.n_lieutenants
+    n_pool = n_rv * cfg.slots
+    s, max_l = cfg.size_l, cfg.max_l
+    vb = jnp.dtype(pool_vals_dtype(cfg)).itemsize
+    pool = (
+        vb * max_l * n_pool * s + 4 * n_pool * max_l
+        + vb * n_pool * s + 4 * n_pool * 4
+    )
+    draws = 3 * 4 * cfg.n_rounds * n_rv * n_pool
+    decode = 4 * n_pool * n_rv + 4 * n_pool * max(s, cfg.w)
+    return (
+        _fused_estimate(cfg, blk_d, blk_v, None, trial_pack)
+        + trial_pack * (2 * pool + draws + decode)
+    )
+
+
+def mega_candidates(cfg: QBAConfig, blk_v: int | None = None,
+                    trial_pack: int = 1) -> list[int]:
+    """Candidate destination block sizes for the trial megakernel —
+    the fused kernel's candidate rule under the megakernel estimate."""
+    if blk_v is None:
+        blk_v = resolve_tiled_block(cfg)
+    n_pool = cfg.n_lieutenants * cfg.slots
+    divs = [d for d in range(n_pool, 0, -1) if n_pool % d == 0]
+    cands = [d for d in divs if d % 8 == 0] or divs
+    ok = [
+        b for b in cands
+        if _mega_estimate(cfg, b, blk_v, trial_pack) <= _MEGA_BUDGET
+    ]
+    return _order_candidates(ok, _preferred_block(cfg))[
+        :_MAX_PROBE_CANDIDATES
+    ]
+
+
+def _probe_mega_compile(cfg: QBAConfig, blk_d: int, blk_v: int,
+                        variant: str, trial_pack: int = 1) -> None:
+    """Data-free compile probe of one trial-megakernel build (raises on
+    failure, never executes)."""
+    # Deferred import: the megakernel module imports this module's
+    # verdict helper at its top level.
+    from qba_tpu.ops.trial_megakernel import build_trial_megakernel
+
+    PROBE_STATS["compile_probes"] += 1
+    shp, i32, vdt = _probe_shapes(cfg)
+    n_pool = cfg.n_lieutenants * cfg.slots
+    n_rv = cfg.n_lieutenants
+    s, w, gdt = cfg.size_l, cfg.w, _gdt(cfg)
+    kd = (trial_pack,) if trial_pack > 1 else ()
+
+    def kshp(*dims, dt=i32):
+        return shp(*(kd + dims), dt=dt)
+
+    if variant == "allrecv":
+        li_arg = (
+            kshp(s, n_rv, dt=jnp.float32), kshp(s, n_rv, dt=jnp.float32),
+            kshp(s, n_rv, dt=jnp.float32), kshp(s, w * n_rv, dt=gdt),
+            kshp(w * s, n_rv, dt=gdt),
+        )
+    else:
+        li_arg = kshp(n_rv, s)
+    mega = build_trial_megakernel(
+        cfg, blk_d, blk_v, variant=variant, trial_pack=trial_pack,
+    )
+    jax.jit(jax.vmap(mega)).lower(
+        kshp(n_rv, s), kshp(n_rv, s), li_arg, kshp(n_rv),
+        kshp(n_pool, 1),
+        shp(*((cfg.n_rounds,) + kd + (n_pool, n_rv))),
+        shp(*((cfg.n_rounds,) + kd + (n_pool, n_rv))),
+        shp(*((cfg.n_rounds,) + kd + (n_pool, n_rv))),
+    ).compile()
+
+
+def mega_kernel_plan(cfg: QBAConfig, variant: str | None = None,
+                     trial_pack: int = 1) -> int | None:
+    """Destination block size for the trial megakernel, or None if no
+    candidate compiles (the fused per-round engine then takes over —
+    the megakernel's demotion target)."""
+    if variant is None:
+        variant = resolve_verdict_variant(cfg)
+    blk_v = resolve_tiled_block(cfg)
+
+    def compile_one(blk_d):
+        _probe_mega_compile(cfg, blk_d, blk_v, variant, trial_pack)
+
+    return _probe_plan(
+        "trial-mega", cfg,
+        mega_candidates(cfg, blk_v, trial_pack), compile_one,
+        _MEGA_PROBE_CACHE, "falling back to the fused per-round engine",
+        extra={"allrecv": "+allrecv", "group-serial": "+accser"}.get(
+            variant, ""
+        )
+        + (f"+pack{trial_pack}" if trial_pack > 1 else "")
+        + f"+v{blk_v}",
+    )
+
+
+def _resolve_mega_block_impl(
+    cfg: QBAConfig, trial_pack: int = 1
+) -> tuple[int, int] | None:
+    """``(blk_d, blk_v)`` the megakernel engine runs with, or None to
+    demote to the fused per-round engine.  An explicit ``tiled_block``
+    is honored where it divides the pool and fits the megakernel
+    estimate (same discipline as :func:`resolve_fused_block`); off-TPU
+    the estimate alone decides, so an over-budget shape demotes
+    honestly instead of compiling an interpret-mode kernel no TPU plan
+    would admit."""
+    n_pool = cfg.n_lieutenants * cfg.slots
+    blk_v = resolve_tiled_block(cfg)
+    if cfg.tiled_block is not None and n_pool % cfg.tiled_block == 0:
+        if (
+            jax.default_backend() != "tpu"
+            or _mega_estimate(cfg, cfg.tiled_block, blk_v, trial_pack)
+            <= _MEGA_BUDGET
+        ):
+            return (cfg.tiled_block, blk_v)
+    if jax.default_backend() == "tpu":
+        blk_d = mega_kernel_plan(cfg, trial_pack=trial_pack)
+        return None if blk_d is None else (blk_d, blk_v)
+    cands = mega_candidates(cfg, blk_v, trial_pack)
+    return (cands[0], blk_v) if cands else None
+
+
+def resolve_mega_block(
+    cfg: QBAConfig, trial_pack: int = 1
+) -> tuple[int, int] | None:
+    """Memoized :func:`_resolve_mega_block_impl` (see
+    :func:`resolve_verdict_variant`)."""
+    return _memo(
+        _resolve_key("mega", cfg, None, (trial_pack,)),
+        lambda: _resolve_mega_block_impl(cfg, trial_pack),
     )

@@ -1,0 +1,748 @@
+"""One launch = one trial: the round loop, in-kernel.
+
+:func:`build_trial_megakernel` emits a SINGLE ``pallas_call`` whose body
+
+* decodes step 3a on entry (the commander packet consistency verdict +
+  compacted-pool build that :func:`qba_tpu.rounds.engine.step3a_one` /
+  :func:`qba_tpu.ops.round_kernel_tiled.pool_from_step3a` perform on the
+  host for every other engine),
+* runs a ``fori_loop`` over all ``n_dishonest + 1`` voting rounds with
+  the ``vi`` carry, the ``acc``/slot tables, and BOTH mailbox pools
+  (ping-pong A/B) held in VMEM scratch — no HBM round trip between
+  rounds, no per-round launch, and
+* reduces the per-lieutenant decision (``min(Vi)`` / sentinel ``w``,
+  :func:`qba_tpu.core.decide.decide_order`) on exit.
+
+The per-round verdict math is :func:`_verdict_block_accepts` and the
+successor-pool build mirrors ``build_fused_round_kernel`` statement for
+statement, so the megakernel is bit-identical to the ``pallas_fused``
+engine by construction (pinned by tests/test_trial_megakernel.py).  The
+entry decode mirrors ``step3a_one``'s ``consistent`` predicate on the
+single appended own row (conditions 1/3 are vacuous there) and
+``pool_from_step3a``'s prefix-count compaction, as one-hot MXU gathers.
+
+Adversary draws arrive PRE-SAMPLED for all rounds, stacked round-major
+(``[n_rounds, (k,) n_cells, n_rv]``): ``jax.random.fold_in`` is value
+deterministic, so the host loop that stacks them reproduces exactly the
+per-round keys the scanned engines fold in, and the kernel selects a
+round's slab by a dynamic index on the leading (majormost) axis.
+
+Trial packing (``trial_pack = k > 1``) folds ``k`` independent trials
+into one launch, same layout contract as the packed fused kernel: a
+leading ``k`` axis on every trial-varying operand/output/scratch, the
+kernel touching only slice ``t`` per trial.
+
+``ProtocolCounters`` are NOT produced here — the loop the counters
+wrap no longer exists on the host.  ``rounds/engine.py`` records a
+``QBADemotionWarning`` demotion to ``pallas_fused`` when counters are
+requested (the ``scan_rounds(collect=True)`` seam).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from qba_tpu.adversary import (
+    CLEAR_L_BIT,
+    CLEAR_P_BIT,
+    FORGE_BIT,
+    FORGE_P_BIT,
+)
+from qba_tpu.config import QBAConfig
+from qba_tpu.core.types import SENTINEL
+from qba_tpu.ops.round_kernel import (
+    CompilerParams,
+    _lane_group,
+    vma_struct,
+)
+from qba_tpu.ops.round_kernel_tiled import (
+    META_CELL,
+    META_COUNT,
+    META_SENT,
+    META_V,
+    _gdt,
+    _prec,
+    _verdict_block_accepts,
+    all_receiver_supported,
+    pool_vals_dtype,
+)
+
+
+def build_trial_megakernel(
+    cfg: QBAConfig,
+    blk_d: int,
+    blk_v: int,
+    *,
+    interpret: bool = False,
+    variant: str = "group",
+    trial_pack: int = 1,
+    out_vma=None,
+):
+    """Build the one-launch trial kernel.
+
+    Returns ``mega(p_rows, li, li_arg, v_sent, honest_cells, attack,
+    rand_v, late) -> (vi', decisions, overflow)`` with
+
+    * ``p_rows`` — bool/int ``[(k,) n_rv, size_l]`` commander P-masks,
+    * ``li`` — int32 ``[(k,) n_rv, size_l]`` lieutenant lists,
+    * ``li_arg`` — the verdict-table argument (``li`` for the group
+      family, :func:`make_verdict_tables` output for ``"allrecv"``),
+    * ``v_sent`` — int32 ``[(k,) n_rv]`` per-recipient orders,
+    * ``honest_cells`` — int32 ``[(k,) n_pool, 1]``,
+    * draws — int32 ``[n_rounds, (k,) n_pool, n_rv]`` mailbox-cell
+      ordered, stacked round-major,
+
+    and ``vi'`` int32 ``[(k,) n_rv, w]``, ``decisions`` int32
+    ``[(k,) n_rv]``, ``overflow`` bool (per trial when packed).
+    """
+    n_rv, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
+    size_l, w = cfg.size_l, cfg.w
+    n_pool = n_rv * slots
+    n_rounds, n_dis = cfg.n_rounds, cfg.n_dishonest
+    kk = trial_pack
+    packed = kk > 1
+    if kk < 1:
+        raise ValueError(f"trial_pack={kk} must be >= 1")
+    if n_pool % blk_d:
+        raise ValueError(f"blk_d={blk_d} must divide n_pool={n_pool}")
+    if n_pool % blk_v:
+        raise ValueError(f"blk_v={blk_v} must divide n_pool={n_pool}")
+    gdt = _gdt(cfg)
+    vdt = pool_vals_dtype(cfg)
+    if variant not in ("group", "group-serial", "allrecv"):
+        raise ValueError(f"unknown verdict variant {variant!r}")
+    if variant == "allrecv" and not all_receiver_supported(size_l, w):
+        raise ValueError(
+            f"allrecv variant unsupported at size_l={size_l}, w={w}"
+        )
+
+    # Receiver lane-packing plan — identical to the fused kernel.
+    grp = _lane_group(size_l, n_rv)
+    seg_l = grp * size_l
+    r0_list = list(range(0, n_rv - grp + 1, grp))
+    if n_rv % grp:
+        r0_list.append(n_rv - grp)
+    e_np = np.zeros((grp, seg_l), np.float32)
+    for j in range(grp):
+        e_np[j, j * size_l : (j + 1) * size_l] = 1.0
+
+    def kernel(*refs):
+        if variant == "allrecv":
+            (
+                p_ref, pt_ref, li_ref, lit_ref, v_ref, vrow_ref,
+                hon_ref, att_ref, rv_ref, late_ref,
+                t1_ref, t2_ref, tob_ref, tlh_ref, tlh2_ref,
+                ovi_ref, dec_ref, ovf_ref,
+                vals_a, lens_a, pa_scr, meta_a,
+                vals_b, lens_b, pb_scr, meta_b,
+                acc_scr, w_scr, s_scr, lane_scr,
+            ) = refs
+        else:
+            (
+                p_ref, pt_ref, li_ref, lit_ref, v_ref, vrow_ref,
+                hon_ref, att_ref, rv_ref, late_ref,
+                e_ref, lip_ref, lioob_ref,
+                ovi_ref, dec_ref, ovf_ref,
+                vals_a, lens_a, pa_scr, meta_a,
+                vals_b, lens_b, pb_scr, meta_b,
+                acc_scr, w_scr, s_scr, lane_scr,
+            ) = refs
+
+        def T(ref, t):  # full per-trial view of a trial-varying ref
+            return ref[t] if packed else ref[:]
+
+        iota_w = jax.lax.broadcasted_iota(jnp.int32, (n_rv, w), 1)
+
+        # ---- Entry: step 3a (tfg.py:185-196) + pool compaction.  The
+        # consistency predicate on the single-row appended evidence
+        # collapses to condition 2 (conditions 1/3 are vacuous at
+        # |L'| = 1 — see core/consistent.py); the compaction is
+        # pool_from_step3a's exclusive-prefix scatter, expressed as
+        # one-hot MXU gathers over the ok lieutenants.
+        if packed:
+            ovf_ref[:] = jnp.zeros((kk, 1), jnp.int32)
+        else:
+            ovf_ref[:] = jnp.zeros((1, 1), jnp.int32)
+        for t in range(kk):
+            p_i = T(p_ref, t)  # [n_rv, size_l] 0/1
+            li_m = T(li_ref, t)
+            v_col = T(v_ref, t)  # [n_rv, 1]
+            # in-tuple mirrors sublist_row: a P position holding a
+            # SENTINEL list value stays outside the tuple.
+            in_c = (p_i != 0) & (li_m != SENTINEL)
+            bad_c = in_c & ((li_m == v_col) | (li_m > w) | (li_m < 0))
+            ok_c = (
+                jnp.sum(jnp.where(bad_c, 1, 0), axis=1, keepdims=True)
+                == 0
+            )  # [n_rv, 1]
+            vi0 = jnp.where((iota_w == v_col) & ok_c, 1, 0)
+            if packed:
+                ovi_ref[t] = vi0
+            else:
+                ovi_ref[:] = vi0
+
+            # The same verdict lane-major (sublane reduce over the
+            # transposed operands) for the compaction prefix.
+            p_t = T(pt_ref, t)  # [size_l, n_rv]
+            li_t = T(lit_ref, t)
+            v_row = T(vrow_ref, t)  # [1, n_rv]
+            in_r = (p_t != 0) & (li_t != SENTINEL)
+            bad_r = in_r & ((li_t == v_row) | (li_t > w) | (li_t < 0))
+            ok_r = jnp.where(
+                jnp.sum(jnp.where(bad_r, 1, 0), axis=0, keepdims=True)
+                == 0,
+                1,
+                0,
+            )  # [1, n_rv]
+            x = ok_r
+            k = 1
+            while k < n_rv:
+                x = x + jnp.pad(x, ((0, 0), (k, 0)))[:, :n_rv]
+                k *= 2
+            offs_row = x - ok_r  # exclusive prefix = pool position
+            total0 = jnp.sum(ok_r)
+
+            d_col = jax.lax.broadcasted_iota(jnp.int32, (n_pool, 1), 0)
+            live = d_col < total0  # [n_pool, 1]
+            offs_b = jnp.broadcast_to(offs_row, (n_pool, n_rv))
+            ok_b = jnp.broadcast_to(ok_r, (n_pool, n_rv))
+            onehot = (offs_b <= d_col) & (d_col < offs_b + ok_b)
+            oh_i = jnp.where(onehot, 1, 0)
+            oh_f = jnp.where(onehot, 1.0, 0.0).astype(gdt)
+
+            def oh_mm(tbl, dt=gdt, oh_f=oh_f):  # [n_rv,X] -> [n_pool,X]
+                return jax.lax.dot_general(
+                    oh_f.astype(dt), tbl.astype(dt),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=_prec(dt),
+                )
+
+            # Slot-0 cell: row 0 = the appended own row, rows 1+ empty
+            # (append_own on empty evidence).  All gathered values stay
+            # <= max(size_l, w) — exact in gdt (see _gdt).
+            own = jnp.where(p_i != 0, li_m, SENTINEL)
+            own_len = jnp.sum(p_i, axis=1, keepdims=True)
+            row0 = jnp.where(
+                live, oh_mm(own).astype(jnp.int32), SENTINEL
+            ).astype(vdt)
+            empty_row = jnp.full((n_pool, size_l), SENTINEL, vdt)
+            for r in range(max_l):
+                row = row0 if r == 0 else empty_row
+                if packed:
+                    vals_a[r, t] = row
+                else:
+                    vals_a[r] = row
+            l0 = jnp.where(live, oh_mm(own_len).astype(jnp.int32), 0)
+            iota_l = jax.lax.broadcasted_iota(
+                jnp.int32, (n_pool, max_l), 1
+            )
+            lens_v = jnp.where(live & (iota_l == 0), l0, 0)
+            p_dec = jnp.where(
+                live, oh_mm(p_i).astype(jnp.int32), 0
+            ).astype(vdt)
+            iota_rv = jax.lax.broadcasted_iota(
+                jnp.int32, (n_pool, n_rv), 1
+            )
+            r_j = jnp.sum(oh_i * iota_rv, axis=1, keepdims=True)
+            one_col = jnp.where(live, 1, 0)
+            v_dec = jnp.where(live, oh_mm(v_col).astype(jnp.int32), 0)
+            meta_v = jnp.concatenate(
+                [one_col, v_dec, one_col, jnp.where(live, r_j * slots, 0)],
+                axis=1,
+            )
+            if packed:
+                lens_a[t] = lens_v
+                pa_scr[t] = p_dec
+                meta_a[t] = meta_v
+            else:
+                lens_a[:] = lens_v
+                pa_scr[:] = p_dec
+                meta_a[:] = meta_v
+
+        # ---- Round loop: rounds 1..n_dishonest+1 (tfg.py:337) traced
+        # ONCE; vi / pools / slot tables never leave VMEM.
+        def round_body(r_idx, carry):
+            def draws_t(t):
+                if packed:
+                    return (
+                        att_ref[r_idx - 1, t],
+                        rv_ref[r_idx - 1, t],
+                        late_ref[r_idx - 1, t],
+                    )
+                return (
+                    att_ref[r_idx - 1],
+                    rv_ref[r_idx - 1],
+                    late_ref[r_idx - 1],
+                )
+
+            # --- Verdict (phase A): static sub-block loop, vi carried
+            # through ovi — same block-skip + carry as the fused kernel.
+            for t in range(kk):
+                att_t, rv_t, late_t = draws_t(t)
+                if variant == "allrecv":
+                    tables_t = (
+                        T(t1_ref, t), T(t2_ref, t), T(tob_ref, t),
+                        T(tlh_ref, t), T(tlh2_ref, t),
+                    )
+                else:
+                    tables_t = (
+                        e_ref[:], T(lip_ref, t), T(lioob_ref, t),
+                    )
+                for b0 in range(0, n_pool, blk_v):
+                    sl = slice(b0, b0 + blk_v)
+                    meta_blk = meta_a[t, sl] if packed else meta_a[sl]
+                    live = jnp.sum(
+                        meta_blk[:, META_SENT : META_SENT + 1]
+                    ) > 0
+
+                    @pl.when(live)
+                    def _do(t=t, sl=sl, meta_blk=meta_blk,
+                            tables_t=tables_t, att_t=att_t, rv_t=rv_t,
+                            late_t=late_t):
+                        acc, new_vi = _verdict_block_accepts(
+                            variant=variant, blk=blk_v, n_rv=n_rv,
+                            n_cells=n_pool, slots=slots, max_l=max_l,
+                            size_l=size_l, w=w, gdt=gdt, grp=grp,
+                            seg_l=seg_l, r0_list=r0_list,
+                            r_off=0, r_idx=r_idx,
+                            vals=[
+                                (
+                                    vals_a[r, t, sl] if packed
+                                    else vals_a[r, sl]
+                                ).astype(jnp.int32)
+                                for r in range(max_l)
+                            ],
+                            lens=(
+                                lens_a[t, sl] if packed
+                                else lens_a[sl]
+                            ),
+                            # != 0 re-establishes the 0/1 bound the
+                            # KI-3 interval proof needs: scratch reads
+                            # are unbounded after the in-kernel round
+                            # loop widens, and the decode phase stored
+                            # an exact 0/1 mask, so this is free.
+                            p_i32=(
+                                (
+                                    pa_scr[t, sl] if packed
+                                    else pa_scr[sl]
+                                ) != 0
+                            ).astype(jnp.int32),
+                            meta=meta_blk,
+                            vi=T(ovi_ref, t),
+                            honest_col=T(hon_ref, t),
+                            att_t=att_t, rv_t=rv_t,
+                            late_t=late_t,
+                            tables=tables_t,
+                            use_fp=cfg.strategy == "split",
+                        )
+                        if packed:
+                            acc_scr[t, sl] = acc
+                            ovi_ref[t] = new_vi
+                        else:
+                            acc_scr[sl] = acc
+                            ovi_ref[:] = new_vi
+
+                    @pl.when(jnp.logical_not(live))
+                    def _skip_blk(t=t, sl=sl):
+                        zeros = jnp.zeros((blk_v, n_rv), jnp.int32)
+                        if packed:
+                            acc_scr[t, sl] = zeros
+                        else:
+                            acc_scr[sl] = zeros
+
+            # --- Slot allocation, packet-major (sublane Hillis-Steele
+            # prefix); overflow accumulates across rounds (max == any).
+            for t in range(kk):
+                acc_t = T(acc_scr, t)  # [n_pool, n_rv]
+                write0 = (acc_t != 0) & (r_idx <= n_dis)
+                w_i = jnp.where(write0, 1, 0)
+                x = w_i
+                k = 1
+                while k < n_pool:
+                    x = x + jnp.pad(x, ((k, 0), (0, 0)))[:n_pool, :]
+                    k *= 2
+                slot0 = x - w_i  # exclusive prefix = outgoing slot
+                write_m = write0 & (slot0 < slots)
+                ovf_val = jnp.where(
+                    jnp.any(write0 & ~write_m), 1, 0
+                ).reshape(1, 1)
+                if packed:
+                    ovf_ref[t : t + 1, :] = jnp.maximum(
+                        ovf_ref[t : t + 1, :], ovf_val
+                    )
+                    w_scr[t] = jnp.where(write_m, 1, 0)
+                    s_scr[t] = jnp.minimum(slot0, slots)
+                else:
+                    ovf_ref[:] = jnp.maximum(ovf_ref[:], ovf_val)
+                    w_scr[:] = jnp.where(write_m, 1, 0)
+                    s_scr[:] = jnp.minimum(slot0, slots)
+                k_lane = jnp.minimum(
+                    jnp.sum(w_i, axis=0, keepdims=True), slots
+                )  # [1, n_rv]
+                x = k_lane
+                k = 1
+                while k < n_rv:
+                    x = x + jnp.pad(x, ((0, 0), (k, 0)))[:, :n_rv]
+                    k *= 2
+                offs = x - k_lane  # [1, n_rv] exclusive
+                if packed:
+                    lane_scr[t, 0:1, :] = offs
+                    lane_scr[t, 1:2, :] = k_lane
+                else:
+                    lane_scr[0:1, :] = offs
+                    lane_scr[1:2, :] = k_lane
+
+            # --- Successor pool (phase B) into the B half, static
+            # destination-block loop — the fused kernel's _build with
+            # the grid step replaced by bd0.
+            for t in range(kk):
+                att_t, rv_t, late_t = draws_t(t)
+                offs = (
+                    lane_scr[t, 0:1, :] if packed else lane_scr[0:1, :]
+                )
+                k_lane = (
+                    lane_scr[t, 1:2, :] if packed else lane_scr[1:2, :]
+                )
+                total = jnp.sum(k_lane)
+                for bd0 in range(0, n_pool, blk_d):
+                    dsl = slice(bd0, bd0 + blk_d)
+
+                    def zero_outputs(t=t, dsl=dsl):
+                        empty = jnp.full((blk_d, size_l), SENTINEL, vdt)
+                        for r in range(max_l):
+                            if packed:
+                                vals_b[r, t, dsl] = empty
+                            else:
+                                vals_b[r, dsl] = empty
+                        zl = jnp.zeros((blk_d, max_l), jnp.int32)
+                        zp = jnp.zeros((blk_d, size_l), vdt)
+                        zm = jnp.zeros((blk_d, 4), jnp.int32)
+                        if packed:
+                            lens_b[t, dsl] = zl
+                            pb_scr[t, dsl] = zp
+                            meta_b[t, dsl] = zm
+                        else:
+                            lens_b[dsl] = zl
+                            pb_scr[dsl] = zp
+                            meta_b[dsl] = zm
+
+                    @pl.when(bd0 >= total)
+                    def _skip(zero_outputs=zero_outputs):
+                        zero_outputs()
+
+                    @pl.when(bd0 < total)
+                    def _build(t=t, dsl=dsl, bd0=bd0, offs=offs,
+                               k_lane=k_lane, total=total, att_t=att_t,
+                               rv_t=rv_t):
+                        d_col = bd0 + jax.lax.broadcasted_iota(
+                            jnp.int32, (blk_d, 1), 0
+                        )  # global dst position
+                        live = d_col < total  # [blk_d, 1]
+                        offs_b = jnp.broadcast_to(offs, (blk_d, n_rv))
+                        k_b = jnp.broadcast_to(k_lane, (blk_d, n_rv))
+                        onehot = (offs_b <= d_col) & (
+                            d_col < offs_b + k_b
+                        )
+                        oh_i = jnp.where(onehot, 1, 0)
+                        iota_rv = jax.lax.broadcasted_iota(
+                            jnp.int32, (blk_d, n_rv), 1
+                        )
+                        r_j = jnp.sum(
+                            oh_i * iota_rv, axis=1, keepdims=True
+                        )
+                        slot_lane = d_col - jnp.sum(
+                            oh_i * offs_b, axis=1, keepdims=True
+                        )  # [blk_d, 1]
+                        oh_f = jnp.where(onehot, 1.0, 0.0).astype(gdt)
+
+                        def oh_mm(tbl, dt=gdt):  # [n_rv, X]
+                            return jax.lax.dot_general(
+                                oh_f.astype(dt), tbl.astype(dt),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(dt),
+                            )
+
+                        def oh_mm_t(tbl, dt=gdt):  # [n_pool, n_rv]
+                            return jax.lax.dot_general(
+                                oh_f.astype(dt), tbl.astype(dt),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(dt),
+                            )
+
+                        w_sel = oh_mm_t(T(w_scr, t)) > 0.5
+                        s_sel = oh_mm_t(T(s_scr, t)).astype(jnp.int32)
+                        g_t = w_sel & (s_sel == slot_lane)
+                        g_f = jnp.where(g_t, 1.0, 0.0)
+
+                        def gmm(field, dt=gdt):  # [n_pool, X]
+                            return jax.lax.dot_general(
+                                g_f.astype(dt), field.astype(dt),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(dt),
+                            )
+
+                        rows_g = [
+                            gmm(
+                                vals_a[r, t] if packed else vals_a[r]
+                            ).astype(jnp.int32)
+                            for r in range(max_l)
+                        ]
+                        lens_g = gmm(T(lens_a, t)).astype(jnp.int32)
+                        p_g = gmm(T(pa_scr, t)).astype(jnp.int32)
+                        # f32 + HIGHEST: cell ids reach n_pool-1 > 256.
+                        meta_g = gmm(T(meta_a, t), jnp.float32).astype(
+                            jnp.int32
+                        )
+                        cnt_g = meta_g[:, META_COUNT : META_COUNT + 1]
+                        v_g = meta_g[:, META_V : META_V + 1]
+                        cell_g = meta_g[:, META_CELL : META_CELL + 1]
+
+                        iota_cells = jax.lax.broadcasted_iota(
+                            jnp.int32, (blk_d, n_pool), 1
+                        )
+                        oh_cell = jnp.where(
+                            iota_cells == cell_g, 1.0, 0.0
+                        ).astype(gdt)
+
+                        def cell_mm(tbl_t, dt=gdt):  # [n_rv, n_cells]
+                            return jax.lax.dot_general(
+                                oh_cell.astype(dt), tbl_t.astype(dt),
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(dt),
+                            )
+
+                        def cell_col_mm(tbl, dt=gdt):  # [n_cells, 1]
+                            return jax.lax.dot_general(
+                                oh_cell.astype(dt), tbl.astype(dt),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(dt),
+                            )
+
+                        att_rows = cell_mm(att_t)  # [blk_d, n_rv] f32
+                        rv_rows = cell_mm(rv_t)
+                        att_c = jnp.sum(
+                            att_rows * oh_f.astype(jnp.float32),
+                            axis=1, keepdims=True,
+                        ).astype(jnp.int32)
+                        rv_c = jnp.sum(
+                            rv_rows * oh_f.astype(jnp.float32),
+                            axis=1, keepdims=True,
+                        ).astype(jnp.int32)
+                        hon_c = cell_col_mm(T(hon_ref, t)).astype(
+                            jnp.int32
+                        )
+
+                        biz = hon_c == 0
+                        clearp_c = biz & ((att_c & CLEAR_P_BIT) != 0)
+                        clearl_c = biz & ((att_c & CLEAR_L_BIT) != 0)
+                        v2_c = jnp.where(
+                            biz & ((att_c & FORGE_BIT) != 0), rv_c, v_g
+                        )
+                        li_row = oh_mm(T(li_ref, t)).astype(jnp.int32)
+
+                        # Keep/append row algebra — mirrors rebuild_pool.
+                        p2 = (p_g != 0) & ~clearp_c
+                        if cfg.strategy == "split":
+                            # forge-P: statically gated (rebuild_pool).
+                            p2 = (
+                                biz & ((att_c & FORGE_P_BIT) != 0)
+                            ) | p2
+                        own = jnp.where(p2, li_row, SENTINEL)
+                        own_len = jnp.sum(
+                            jnp.where(p2, 1, 0), axis=1, keepdims=True
+                        )
+                        cnt_eff = jnp.where(clearl_c, 0, cnt_g)
+                        dup = jnp.zeros((blk_d, 1), jnp.bool_)
+                        for r in range(max_l):
+                            mism = jnp.sum(
+                                jnp.where(rows_g[r] != own, 1, 0),
+                                axis=1, keepdims=True,
+                            )
+                            dup |= (cnt_g > r) & (mism == 0)
+                        dup &= ~clearl_c
+                        new_cnt = jnp.where(
+                            dup, cnt_eff,
+                            jnp.minimum(cnt_eff + 1, max_l),
+                        )
+
+                        has = live
+                        iota_l = jax.lax.broadcasted_iota(
+                            jnp.int32, (blk_d, max_l), 1
+                        )
+                        keep_row = iota_l < cnt_eff
+                        new_row = ~dup & (iota_l == cnt_eff)
+                        olens_val = jnp.where(
+                            has,
+                            jnp.where(
+                                new_row, own_len,
+                                jnp.where(keep_row, lens_g, 0),
+                            ),
+                            0,
+                        )
+                        if packed:
+                            lens_b[t, dsl] = olens_val
+                        else:
+                            lens_b[dsl] = olens_val
+                        for r in range(max_l):
+                            keep = ~clearl_c & (r < cnt_eff)
+                            is_new = ~dup & (r == cnt_eff)
+                            row = jnp.where(
+                                is_new, own,
+                                jnp.where(keep, rows_g[r], SENTINEL),
+                            )
+                            row = jnp.where(has, row, SENTINEL).astype(
+                                vdt
+                            )
+                            if packed:
+                                vals_b[r, t, dsl] = row
+                            else:
+                                vals_b[r, dsl] = row
+                        op_val = jnp.where(has & p2, 1.0, 0.0).astype(
+                            vdt
+                        )
+                        ometa_val = jnp.where(
+                            has,
+                            jnp.concatenate(
+                                [
+                                    new_cnt,
+                                    v2_c,
+                                    jnp.ones((blk_d, 1), jnp.int32),
+                                    r_j * slots + slot_lane,
+                                ],
+                                axis=1,
+                            ),
+                            0,
+                        )
+                        if packed:
+                            pb_scr[t, dsl] = op_val
+                            meta_b[t, dsl] = ometa_val
+                        else:
+                            pb_scr[dsl] = op_val
+                            meta_b[dsl] = ometa_val
+
+            # --- B half becomes next round's source pool.
+            for t in range(kk):
+                for r in range(max_l):
+                    if packed:
+                        vals_a[r, t] = vals_b[r, t]
+                    else:
+                        vals_a[r] = vals_b[r]
+                if packed:
+                    lens_a[t] = lens_b[t]
+                    pa_scr[t] = pb_scr[t]
+                    meta_a[t] = meta_b[t]
+                else:
+                    lens_a[:] = lens_b[:]
+                    pa_scr[:] = pb_scr[:]
+                    meta_a[:] = meta_b[:]
+            return carry
+
+        jax.lax.fori_loop(1, n_rounds + 1, round_body, jnp.int32(0))
+
+        # ---- Exit: the per-lieutenant decision reduce (decide_order
+        # with is_comm=False): min(Vi), sentinel w when Vi is empty.
+        for t in range(kk):
+            vi_t = T(ovi_ref, t)
+            dec_t = jnp.min(
+                jnp.where(vi_t != 0, iota_w, w), axis=1, keepdims=True
+            )
+            if packed:
+                dec_ref[t] = dec_t
+            else:
+                dec_ref[:] = dec_t
+
+    def kdim(*dims):  # prepend the trial-pack axis when packed
+        return (kk,) + dims if packed else dims
+
+    n_inputs = 15 if variant == "allrecv" else 13
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n_inputs)
+    ]
+    out_specs = tuple(
+        pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(3)
+    )
+
+    def oshp(*dims, dt=jnp.int32):
+        return vma_struct(out_vma, dims, dt)
+
+    pool_scratch = [
+        pltpu.VMEM((max_l,) + kdim(n_pool, size_l), vdt),  # vals
+        pltpu.VMEM(kdim(n_pool, max_l), jnp.int32),  # lens
+        pltpu.VMEM(kdim(n_pool, size_l), vdt),  # p
+        pltpu.VMEM(kdim(n_pool, 4), jnp.int32),  # meta
+    ]
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            oshp(*kdim(n_rv, w)),  # vi'
+            oshp(*kdim(n_rv, 1)),  # decisions
+            oshp(*((kk, 1) if packed else (1, 1))),  # overflow
+        ),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        # No round-scan carries exist to donate — the loop state lives
+        # in VMEM scratch (the KI-5 point; analysis/effects._audit_mega
+        # proves the scan is gone).  The one legal buffer reuse is the
+        # per-recipient order column into the decision column (same
+        # shape/dtype; v is only read at the entry decode, decisions
+        # are only written after the loop).
+        input_output_aliases={4: 1},
+        scratch_shapes=pool_scratch + pool_scratch + [
+            pltpu.VMEM(kdim(n_pool, n_rv), jnp.int32),  # acc
+            pltpu.VMEM(kdim(n_pool, n_rv), jnp.int32),  # write mask
+            pltpu.VMEM(kdim(n_pool, n_rv), jnp.int32),  # clamped slots
+            pltpu.VMEM(kdim(8, n_rv), jnp.int32),  # offs / k_r rows
+        ],
+        compiler_params=CompilerParams(
+            vmem_limit_bytes=100 * 2**20,
+        ),
+        interpret=interpret,
+    )
+
+    def _tail(li_arg):
+        if variant == "allrecv":
+            return tuple(li_arg)
+        if packed:
+            li_pack = jnp.stack(
+                [
+                    li_arg[:, r0 : r0 + grp].reshape(kk, -1)
+                    for r0 in r0_list
+                ],
+                axis=1,
+            )  # [kk, len(r0_list), seg_l]
+        else:
+            li_pack = jnp.stack(
+                [li_arg[r0 : r0 + grp].reshape(-1) for r0 in r0_list]
+            )
+        li_oob_pack = ((li_pack > w) | (li_pack < 0)).astype(jnp.int32)
+        return jnp.asarray(e_np), li_pack, li_oob_pack
+
+    def _t(x):  # receiver-major draw layout (per trial when packed)
+        return jnp.swapaxes(x, -1, -2)
+
+    def mega(p_rows, li, li_arg, v_sent, honest_pk, attack, rand_v,
+             late):
+        p_i = p_rows.astype(jnp.int32)
+        li_i = li.astype(jnp.int32)
+        v_i = v_sent.astype(jnp.int32)
+        out = call(
+            p_i, _t(p_i), li_i, _t(li_i),
+            v_i[..., :, None], v_i[..., None, :], honest_pk,
+            _t(attack), _t(rand_v), _t(late), *_tail(li_arg),
+        )
+        ovi, dec, ovf = out
+        if packed:
+            return ovi, dec[..., 0], ovf[:, 0] > 0
+        return ovi, dec[:, 0], ovf[0, 0] > 0
+
+    return mega

@@ -561,6 +561,22 @@ def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:
     """
     if cfg.round_engine in ("pallas", "pallas_tiled", "pallas_fused"):
         return cfg.round_engine
+    if cfg.round_engine == "pallas_mega":
+        # The megakernel's in-kernel round loop would need a per-round
+        # tp all-gather of the party-sharded vi/mailbox state INSIDE
+        # one launch — no party-sharded variant exists; the fused
+        # per-round kernel is its demotion target here too.
+        warn_and_record(
+            "trial megakernel has no party-sharded variant; demoting "
+            "to the fused per-round engine under the tp mesh",
+            QBADemotionWarning,
+            site="parallel.spmd._resolve_spmd_engine",
+            stacklevel=3,
+            engine_from="pallas_mega",
+            engine_to="pallas_fused",
+            reason="no_party_sharded_megakernel",
+        )
+        return "pallas_fused"
     if cfg.round_engine != "auto" or jax.default_backend() != "tpu":
         return "xla"
     from qba_tpu.ops.round_kernel import kernel_compiles

@@ -616,6 +616,14 @@ def scan_rounds(cfg: QBAConfig, round_body, init):
     :class:`ProtocolCounters` state (computed from the vi delta around
     the body); without it the original scan runs unchanged.
 
+    Engines whose round loop runs IN-KERNEL (the trial megakernel,
+    ``round_engine="pallas_mega"``) have no host scan for this wrapper
+    to instrument: requesting counters on a scan-free engine is DEFINED
+    as a recorded demotion to the fused per-round engine
+    (:func:`_demote_mega` emits the :class:`QBADemotionWarning`), whose
+    counters are bit-identical because every engine's per-round vi
+    sequence is (tests/test_trial_megakernel.py).
+
     Returns ``(carry, overflow_stack, counter_state_or_None)``."""
     rounds = jnp.arange(1, cfg.n_rounds + 1)
     if not cfg.collect_counters:
@@ -996,6 +1004,207 @@ def run_trials_fused_packed(cfg: QBAConfig, keys, pack: int):
     )(vi_flat, v_comm_t, honest_t, ovf_flat, cnt_flat)
 
 
+def _demote_mega(cfg: QBAConfig) -> str | None:
+    """Why the trial megakernel cannot run this config, as the engine
+    it demotes to (None = no demotion, run the megakernel).
+
+    Two recorded reasons: ``collect_counters`` needs the host round
+    scan the megakernel eliminates (the :func:`scan_rounds` seam —
+    counters on the demoted fused path are bit-identical), and a
+    missing plan from :func:`resolve_mega_block` (VMEM budget or
+    compile probe refused the one-launch kernel)."""
+    from qba_tpu.ops.round_kernel_tiled import resolve_mega_block
+
+    if cfg.collect_counters:
+        warn_and_record(
+            "trial megakernel has no host round scan for the counters "
+            "wrapper to instrument; collect_counters demotes to the "
+            "fused per-round engine (bit-identical counters)",
+            QBADemotionWarning,
+            site="rounds.engine.run_trial",
+            stacklevel=3,
+            engine_from="pallas_mega",
+            engine_to="pallas_fused",
+            reason="counters_need_host_scan",
+        )
+        return "pallas_fused"
+    if resolve_mega_block(cfg) is None:
+        warn_and_record(
+            "trial megakernel unavailable at (n_parties="
+            f"{cfg.n_parties}, size_l={cfg.size_l}, slots={cfg.slots});"
+            " demoting to the fused per-round engine",
+            QBADemotionWarning,
+            site="rounds.engine.run_trial",
+            stacklevel=3,
+            engine_from="pallas_mega",
+            engine_to="pallas_fused",
+            n_parties=cfg.n_parties,
+            size_l=cfg.size_l,
+            slots=cfg.slots,
+        )
+        return "pallas_fused"
+    return None
+
+
+def _stacked_draws(cfg: QBAConfig, k_rounds, ctx):
+    """All rounds' attack draws, stacked round-major
+    (``[n_rounds, n_pool, n_rv]`` int32 each) for the in-kernel loop.
+
+    The per-round key is ``fold_in(k_rounds, round_idx)`` — the exact
+    expression the scanning engines evaluate with a traced
+    ``round_idx`` — so the stacked slabs are bit-identical to the
+    per-round draws the fused engine consumes."""
+    draws = [
+        sample_attacks_round(
+            cfg, jax.random.fold_in(k_rounds, r), r, ctx
+        )
+        for r in range(1, cfg.n_rounds + 1)
+    ]
+    return tuple(
+        jnp.stack(x).astype(jnp.int32) for x in zip(*draws)
+    )
+
+
+def run_trial_mega(
+    cfg: QBAConfig, key: jax.Array, hints: PartitionHints | None = None
+) -> TrialResult:
+    """One full protocol execution on the TRIAL MEGAKERNEL
+    (:func:`qba_tpu.ops.trial_megakernel.build_trial_megakernel`): the
+    step-3a particle decode, the whole ``n_rounds`` loop, and the final
+    decision reduce run in ONE ``pallas_call`` — vi/acc/mailbox state
+    never round-trips HBM between rounds, and the only launches left
+    per trial are this kernel plus the setup/qsim ops.  Bit-identical
+    to :func:`run_trial` on every other engine for identical keys
+    (tests/test_trial_megakernel.py).  The caller
+    (:func:`run_trial`) has already established the plan exists via
+    :func:`_demote_mega`."""
+    from qba_tpu.ops.round_kernel_tiled import (
+        honest_cells as honest_cells_fn,
+        make_verdict_tables,
+        resolve_mega_block,
+        resolve_verdict_variant,
+    )
+    from qba_tpu.ops.trial_megakernel import build_trial_megakernel
+
+    honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = setup_trial(
+        cfg, key, hints
+    )
+    variant = resolve_verdict_variant(cfg)
+    blk_d, blk_v = resolve_mega_block(cfg)
+    mega = build_trial_megakernel(
+        cfg, blk_d, blk_v,
+        interpret=jax.default_backend() != "tpu", variant=variant,
+    )
+    ctx = adversary_ctx(cfg, k_rounds, v_sent)
+    att, rv, late = _stacked_draws(cfg, k_rounds, ctx)
+    li_arg = (
+        make_verdict_tables(cfg, lieu_lists)
+        if variant == "allrecv"
+        else lieu_lists
+    )
+    vi_i32, dec, overflow = mega(
+        p_rows, lieu_lists, li_arg, v_sent,
+        honest_cells_fn(honest, cfg), att, rv, late,
+    )
+    # The kernel's exit reduce IS decide_order's lieutenant branch
+    # (masked min over accepted values, w when empty), so the finish
+    # pass needs no vmapped reduce of its own.
+    decisions = jnp.concatenate([v_comm[None], dec])
+    return TrialResult(
+        success=success_oracle(decisions, honest[1:]),
+        decisions=decisions,
+        honest=honest[1:],
+        v_comm=v_comm,
+        vi=vi_i32 != 0,
+        overflow=overflow,
+        counters=None,
+    )
+
+
+def run_trials_mega_packed(cfg: QBAConfig, keys, pack: int):
+    """Batched megakernel runner with TRIAL PACKING — the megakernel
+    analogue of :func:`run_trials_fused_packed`: ``pack`` trials fold
+    into one launch (a leading ``k`` axis on every trial-varying
+    operand), bit-identical to the unpacked path trial for trial.
+    Falls back to the plain per-trial vmap (whose :func:`run_trial`
+    dispatch handles demotion) when no packed plan exists or counters
+    are requested."""
+    from qba_tpu.ops.round_kernel_tiled import (
+        honest_cells as honest_cells_fn,
+        make_verdict_tables,
+        resolve_mega_block,
+        resolve_verdict_variant,
+    )
+    from qba_tpu.ops.trial_megakernel import build_trial_megakernel
+
+    variant = resolve_verdict_variant(cfg)
+    plan = resolve_mega_block(cfg, trial_pack=pack)
+    if cfg.collect_counters or plan is None or pack < 2:
+        return jax.vmap(lambda k: run_trial(cfg, k))(keys)
+    mega = build_trial_megakernel(
+        cfg, *plan, interpret=jax.default_backend() != "tpu",
+        variant=variant, trial_pack=pack,
+    )
+    n_groups = keys.shape[0] // pack
+
+    def setup_one(key):
+        honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = (
+            setup_trial(cfg, key, None)
+        )
+        li_arg = (
+            make_verdict_tables(cfg, lieu_lists)
+            if variant == "allrecv"
+            else lieu_lists
+        )
+        ctx = adversary_ctx(cfg, k_rounds, v_sent)
+        att, rv, late = _stacked_draws(cfg, k_rounds, ctx)
+        return (
+            honest, lieu_lists, li_arg, p_rows, v_sent, v_comm,
+            honest_cells_fn(honest, cfg), att, rv, late,
+        )
+
+    (honest_t, li_t, li_arg_t, p_t, v_sent_t, v_comm_t, hc_t,
+     att_t, rv_t, late_t) = jax.vmap(setup_one)(keys)
+
+    def group(x):  # [trials, ...] -> [n_groups, pack, ...]
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape((n_groups, pack) + a.shape[1:]), x
+        )
+
+    def run_group(p_k, li_k, li_arg_k, v_k, hc_k, att_k, rv_k, late_k):
+        # The kernel's packed draw layout is round-major:
+        # [n_rounds, k, n_pool, n_rv].
+        att_k, rv_k, late_k = (
+            jnp.moveaxis(a, 0, 1) for a in (att_k, rv_k, late_k)
+        )
+        return mega(p_k, li_k, li_arg_k, v_k, hc_k, att_k, rv_k, late_k)
+
+    vi_g, dec_g, ovf_g = jax.vmap(run_group)(
+        group(p_t), group(li_t), group(li_arg_t), group(v_sent_t),
+        group(hc_t), group(att_t), group(rv_t), group(late_t),
+    )
+    n = keys.shape[0]
+    vi_flat = vi_g.reshape((n,) + vi_g.shape[2:])
+    dec_flat = dec_g.reshape((n,) + dec_g.shape[2:])
+    ovf_flat = ovf_g.reshape((n,))
+
+    def fin(vi_i32, dec, v_comm, honest, overflow):
+        decisions = jnp.concatenate([v_comm[None], dec])
+        return TrialResult(
+            success=success_oracle(decisions, honest[1:]),
+            decisions=decisions,
+            honest=honest[1:],
+            v_comm=v_comm,
+            vi=vi_i32 != 0,
+            overflow=overflow,
+            counters=None,
+        )
+
+    return jax.vmap(fin)(
+        vi_flat, dec_flat, v_comm_t, honest_t, ovf_flat
+    )
+
+
 def resolve_round_engine(cfg: QBAConfig) -> str:
     """``auto`` -> the fastest engine that compiles for this config.
 
@@ -1021,6 +1230,7 @@ def resolve_round_engine(cfg: QBAConfig) -> str:
     from qba_tpu.ops.round_kernel import kernel_compiles
     from qba_tpu.ops.round_kernel_tiled import (
         fused_kernel_plan,
+        mega_kernel_plan,
         tiled_kernel_plan,
     )
 
@@ -1030,6 +1240,15 @@ def resolve_round_engine(cfg: QBAConfig) -> str:
         # round trip); the two-kernel tiled path is its demotion
         # target and the bit-identity reference.
         if fused_kernel_plan(cfg) is not None:
+            # ... and the trial megakernel above BOTH where its
+            # one-launch plan compiles (docs/PERF.md round 8: the
+            # whole round loop in one pallas_call, no per-round
+            # launch at all).  Counters need the host round scan, so
+            # collect_counters keeps the fused per-round engine.
+            if not cfg.collect_counters and (
+                mega_kernel_plan(cfg) is not None
+            ):
+                return "pallas_mega"
             return "pallas_fused"
         return "pallas_tiled"
     if kernel_compiles(cfg):
@@ -1041,6 +1260,14 @@ def run_trial(
     cfg: QBAConfig, key: jax.Array, hints: PartitionHints | None = None
 ) -> TrialResult:
     """One full protocol execution — jit-compilable, vmap-batchable."""
+    engine = resolve_round_engine(cfg)
+    if engine == "pallas_mega":
+        # The megakernel absorbs step 3a and the decision reduce too,
+        # so it dispatches before the shared setup below; demotion
+        # (counters / no plan) is recorded and lands on pallas_fused.
+        if _demote_mega(cfg) is None:
+            return run_trial_mega(cfg, key, hints)
+        engine = "pallas_fused"
     honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = setup_trial(
         cfg, key, hints
     )
@@ -1053,7 +1280,6 @@ def run_trial(
 
     # Step 3b (tfg.py:337-348): synchronous rounds 1..n_dishonest+1.
     ctx = adversary_ctx(cfg, k_rounds, v_sent)
-    engine = resolve_round_engine(cfg)
     if engine == "pallas":
         vi, overflow, counters = run_rounds_pallas(
             cfg, vi, mb, lieu_lists, honest, k_rounds, ctx,

@@ -1,0 +1,280 @@
+"""One-launch trial megakernel vs the fused per-round + XLA engines.
+
+The trial megakernel (:func:`qba_tpu.ops.trial_megakernel
+.build_trial_megakernel`) runs the ENTIRE trial — step-1 particle
+decode, the ``fori_loop`` over all ``n_dishonest + 1`` voting rounds,
+and the per-trial decision reduce — in ONE ``pallas_call``, with the
+vi/acc/pool/mailbox state held in VMEM scratch.  Round state never
+round-trips HBM and no per-round launch exists (the KI-5 lint proves
+the host scan disappeared; :mod:`qba_tpu.analysis.launches` pins the
+launch count to 1).  It must stay bit-identical to the fused per-round
+engine and the XLA oracle for the same trial keys, and every refusal
+(VMEM budget, counters, spmd) must be a RECORDED demotion, never a
+silent one.  Runs in interpreter mode on the CPU test mesh; the same
+kernel compiles for real on TPU (``auto`` prefers it wherever the
+one-launch plan fits the megakernel VMEM budget).
+"""
+
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.diagnostics import QBADemotionWarning
+from qba_tpu.rounds import run_trial
+
+
+def batch(cfg, engine, seed, n, strict=True):
+    """A trial batch on a forced engine; warnings are errors unless the
+    engine is expected to demote (strict=False)."""
+    keys = jax.random.split(jax.random.key(seed), n)
+    ecfg = dataclasses.replace(cfg, round_engine=engine)
+    with warnings.catch_warnings():
+        if strict:
+            warnings.simplefilter("error")
+        else:
+            warnings.simplefilter("ignore")
+        return jax.jit(jax.vmap(lambda k: run_trial(ecfg, k)))(keys)
+
+
+def assert_equal(a, b):
+    assert a.vi.tolist() == b.vi.tolist()
+    assert a.decisions.tolist() == b.decisions.tolist()
+    assert a.success.tolist() == b.success.tolist()
+    assert a.overflow.tolist() == b.overflow.tolist()
+
+
+def triad(cfg, seed=0, n=2, strict=True):
+    xla = batch(cfg, "xla", seed, n)
+    fused = batch(cfg, "pallas_fused", seed, n)
+    mega = batch(cfg, "pallas_mega", seed, n, strict=strict)
+    assert_equal(xla, mega)
+    assert_equal(fused, mega)
+
+
+class TestMegaEquivalence:
+    def test_headline_shape(self):
+        # 11p/64 — the headline benchmark config (BASELINE.json).
+        triad(QBAConfig(n_parties=11, size_l=64, n_dishonest=3))
+
+    def test_grp1_window(self):
+        # sizeL >= 128 pushes the verdict algebra into grp == 1.
+        triad(QBAConfig(n_parties=4, size_l=128, n_dishonest=1))
+
+    def test_wide_group_demotes_recorded(self):
+        # 33p/L8: the fused per-round working set alone crowds the
+        # 64 MiB megakernel VMEM budget, so the one-launch plan does
+        # not exist and the forced megakernel must RECORD its demotion
+        # to the fused engine — and still be bit-identical.
+        cfg = QBAConfig(n_parties=33, size_l=8, n_dishonest=10)
+        ecfg = dataclasses.replace(cfg, round_engine="pallas_mega")
+        keys = jax.random.split(jax.random.key(3), 2)
+        with pytest.warns(QBADemotionWarning, match="megakernel unavailable"):
+            mega = jax.vmap(lambda k: run_trial(ecfg, k))(keys)
+        assert_equal(batch(cfg, "pallas_fused", 3, 2), mega)
+
+    @pytest.mark.slow
+    def test_north_star_shape(self):
+        # 33p/64/10 (BASELINE.md config 5).  The megakernel estimate
+        # fits or demotes per machine; either way the verdicts must
+        # match the oracle bit for bit.
+        triad(
+            QBAConfig(n_parties=33, size_l=64, n_dishonest=10),
+            strict=False,
+        )
+
+    def test_racy_delivery(self):
+        # p_late > 0 exercises the late-delivery mask inside the
+        # in-kernel round loop (the `late` draw plane is indexed from
+        # the stacked round-major tables, not a fresh host draw).
+        triad(
+            QBAConfig(
+                n_parties=5, size_l=16, n_dishonest=1,
+                delivery="racy", p_late=0.25,
+            ),
+            seed=5,
+        )
+
+    def test_split_strategy(self):
+        # The forge-P flag algebra is the only strategy-gated extra
+        # math inside the verdict block; it must survive the move
+        # into the in-kernel round loop.
+        triad(
+            QBAConfig(
+                n_parties=11, size_l=16, n_dishonest=3, strategy="split"
+            )
+        )
+
+
+class TestMegaPacking:
+    def test_packed_matches_unpacked(self):
+        from qba_tpu.rounds.engine import run_trials_mega_packed
+
+        cfg = QBAConfig(
+            n_parties=11, size_l=64, n_dishonest=3,
+            round_engine="pallas_mega",
+        )
+        keys = jax.random.split(jax.random.key(7), 4)
+        packed = run_trials_mega_packed(cfg, keys, pack=2)
+        unpacked = jax.vmap(lambda k: run_trial(cfg, k))(keys)
+        assert_equal(unpacked, packed)
+
+    def test_pack_of_one_falls_back(self):
+        from qba_tpu.rounds.engine import run_trials_mega_packed
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=1,
+            round_engine="pallas_mega",
+        )
+        keys = jax.random.split(jax.random.key(9), 2)
+        assert_equal(
+            jax.vmap(lambda k: run_trial(cfg, k))(keys),
+            run_trials_mega_packed(cfg, keys, pack=1),
+        )
+
+
+class TestCountersSeam:
+    def test_counters_demote_recorded_and_bit_identical(self):
+        # The scan_rounds(collect=True) contract on a scan-free
+        # engine: requesting counters IS a recorded demotion to the
+        # fused per-round engine, and everything — counters included —
+        # is bit-identical to running that engine directly.
+        cfg = QBAConfig(
+            n_parties=11, size_l=16, n_dishonest=3,
+            collect_counters=True,
+        )
+        keys = jax.random.split(jax.random.key(11), 2)
+        mcfg = dataclasses.replace(cfg, round_engine="pallas_mega")
+        with pytest.warns(
+            QBADemotionWarning, match="counters"
+        ):
+            mega = jax.vmap(lambda k: run_trial(mcfg, k))(keys)
+        fused = batch(cfg, "pallas_fused", 11, 2)
+        assert_equal(fused, mega)
+        assert mega.counters is not None
+        for got, want in zip(
+            jax.tree_util.tree_leaves(mega.counters),
+            jax.tree_util.tree_leaves(fused.counters),
+        ):
+            assert got.tolist() == want.tolist()
+
+    def test_counters_off_identity(self):
+        # Without counters the megakernel runs for real — same
+        # primaries as the fused engine (counters stay None).
+        cfg = QBAConfig(n_parties=11, size_l=16, n_dishonest=3)
+        mega = batch(cfg, "pallas_mega", 13, 2)
+        fused = batch(cfg, "pallas_fused", 13, 2)
+        assert_equal(fused, mega)
+        assert mega.counters is None
+
+    def test_auto_engine_never_picks_mega_with_counters(self):
+        from qba_tpu.rounds.engine import resolve_round_engine
+
+        cfg = QBAConfig(
+            n_parties=11, size_l=16, n_dishonest=3,
+            collect_counters=True,
+        )
+        assert resolve_round_engine(cfg) != "pallas_mega"
+
+
+class TestDemotions:
+    def test_over_budget_shape_warns_once_per_trace(self):
+        from qba_tpu.rounds.engine import _demote_mega
+
+        cfg = QBAConfig(
+            n_parties=33, size_l=8, n_dishonest=10,
+            round_engine="pallas_mega",
+        )
+        with pytest.warns(QBADemotionWarning) as rec:
+            assert _demote_mega(cfg) == "pallas_fused"
+        [w] = rec.list
+        assert "VMEM" in str(w.message) or "unavailable" in str(w.message)
+
+    def test_spmd_has_no_party_sharded_mega(self):
+        # The megakernel holds the WHOLE trial in one kernel's VMEM;
+        # there is no party-sharded variant, so the tp-mesh resolver
+        # must record a demotion to the fused engine.
+        from qba_tpu.parallel.spmd import _resolve_spmd_engine
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=1,
+            round_engine="pallas_mega",
+        )
+        with pytest.warns(
+            QBADemotionWarning, match="party-sharded"
+        ):
+            assert (
+                _resolve_spmd_engine(cfg, cfg.n_lieutenants)
+                == "pallas_fused"
+            )
+
+
+class TestLaunchModel:
+    def test_launches_per_trial(self):
+        from qba_tpu.analysis.launches import launches_per_trial
+
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=1)
+        assert launches_per_trial(cfg, "xla") == 0
+        assert launches_per_trial(cfg, "pallas") == cfg.n_rounds
+        assert launches_per_trial(cfg, "pallas_tiled") == 2 * cfg.n_rounds
+        assert launches_per_trial(cfg, "pallas_fused") == cfg.n_rounds
+        assert launches_per_trial(cfg, "pallas_mega") == 1
+
+    def test_lint_launch_pin(self):
+        from qba_tpu.analysis.launches import check_launches
+
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=1)
+        report = check_launches(
+            cfg, {"xla", "pallas_fused", "pallas_mega"}
+        )
+        assert report.ok
+        assert any("pallas_mega" in n for n in report.notes)
+
+    def test_kernel_plan_attribution(self):
+        from qba_tpu.benchmark import engine_description, kernel_plan
+
+        cfg = QBAConfig(
+            n_parties=11, size_l=64, n_dishonest=3,
+            round_engine="pallas_mega",
+        )
+        plan = kernel_plan(cfg)
+        assert plan["launches_per_trial"] == 1
+        assert plan["launches_per_round"] is None
+        assert plan["mega_block"] is not None
+        assert engine_description(cfg).startswith("pallas_mega/")
+
+
+class TestServeWarmStart:
+    def test_mega_plan_round_trips_zero_probe(self):
+        # A mega plan resolved once must ride the resolver-state
+        # artifact: a fresh process that imports it re-resolves the
+        # same shape with ZERO new probes or misses (the serve
+        # warm-start contract, tests/test_serve.py).
+        from qba_tpu.ops.round_kernel_tiled import (
+            PROBE_STATS,
+            clear_resolve_caches,
+            export_resolver_state,
+            import_resolver_state,
+            resolve_mega_block,
+        )
+
+        cfg = QBAConfig(n_parties=11, size_l=64, n_dishonest=3)
+        clear_resolve_caches()
+        try:
+            plan = resolve_mega_block(cfg)
+            assert plan is not None
+            state = export_resolver_state()
+            assert any(
+                k[0] == "mega" for k, _ in state["resolve"]
+            )
+            clear_resolve_caches()  # simulate a fresh process
+            assert import_resolver_state(state) > 0
+            assert resolve_mega_block(cfg) == plan
+            assert PROBE_STATS["compile_probes"] == 0
+            assert PROBE_STATS["resolve_misses"] == 0
+            assert PROBE_STATS["resolve_hits"] > 0
+        finally:
+            clear_resolve_caches()
