@@ -13,10 +13,20 @@ process over the file-queue transport, then reports:
 * a bit-identity spot check: one request per bucket re-run directly
   through the engine must match the served result trial for trial.
 
+With ``--transport socket`` the stream instead goes through the fleet
+stack (docs/SERVING.md "Fleet"): a socket front-end with target-aware
+admission feeding ``--replicas N`` worker processes over one shared
+queue, with per-replica attribution in the report and a
+``fleet_summary.json`` in the queue dir.  ``--chaos-kill`` additionally
+SIGKILLs one replica mid-stream and asserts zero lost requests — the
+survivors reclaim the victim's in-flight claims.
+
 Usage:
     python examples/load_gen.py                     # subprocess server
     python examples/load_gen.py --in-process        # same, no subprocess
     python examples/load_gen.py --requests 60 --chunk-trials 16
+    python examples/load_gen.py --transport socket --replicas 2
+    python examples/load_gen.py --transport socket --replicas 2 --chaos-kill
 """
 
 import argparse
@@ -113,6 +123,86 @@ def run_subprocess(args, stream):
     return results, elapsed
 
 
+def run_socket(args, stream):
+    """Drive the full fleet stack: socket front-end + admission +
+    ``--replicas`` worker processes on one shared queue dir."""
+    import socket as socketlib
+
+    from qba_tpu.serve.fleet import (
+        AdmissionController,
+        FleetFrontend,
+        ReplicaPool,
+        fleet_summary,
+        write_fleet_summary,
+    )
+
+    if args.chaos_kill and args.replicas < 2:
+        raise SystemExit("--chaos-kill needs --replicas >= 2 (a survivor "
+                         "must reclaim the victim's claims)")
+    queue_dir = args.queue_dir or tempfile.mkdtemp(prefix="qba_fleet_")
+    admission = AdmissionController(
+        chunk_trials=args.chunk_trials, replicas=args.replicas
+    )
+    pool = ReplicaPool(
+        queue_dir,
+        replicas=args.replicas,
+        chunk_trials=args.chunk_trials,
+        cache_dir=args.cache_dir,
+        telemetry_dir=args.telemetry,
+        reclaim_timeout_s=args.reclaim_timeout_s,
+        poll_s=0.02,
+    )
+    frontend = FleetFrontend(queue_dir, admission, max_requests=len(stream))
+    pool.start()
+    t0 = time.perf_counter()
+    results = []
+    try:
+        port = frontend.start_in_thread()
+        sock = socketlib.create_connection(
+            ("127.0.0.1", port), timeout=args.timeout_s
+        )
+        wire = sock.makefile("rw")
+        for req in stream:
+            wire.write(json.dumps(req.to_json()) + "\n")
+        wire.flush()
+        sock.shutdown(socketlib.SHUT_WR)
+        if args.chaos_kill:
+            # Wait until the fleet is mid-stream, then SIGKILL one
+            # replica; its unclaimed + in-flight work must be reclaimed
+            # by the survivors (zero lost requests, asserted in main).
+            outbox = os.path.join(queue_dir, "outbox")
+            deadline = time.time() + args.timeout_s
+            while time.time() < deadline:
+                landed = (
+                    len(os.listdir(outbox)) if os.path.isdir(outbox) else 0
+                )
+                if landed >= max(1, len(stream) // 4):
+                    break
+                time.sleep(0.05)
+            victim = pool.alive()[-1]
+            pid = pool.kill(victim)
+            print(f"chaos: SIGKILL replica {victim} (pid {pid}); "
+                  f"survivors {pool.alive()} reclaim its claims")
+        for line in wire:
+            if line.strip():
+                results.append(json.loads(line))
+        elapsed = time.perf_counter() - t0
+    finally:
+        frontend.stop_in_thread()
+        codes = pool.stop()
+    summary = fleet_summary(
+        queue_dir,
+        admission_summary=admission.summary(),
+        frontend_status=frontend.status(),
+        elapsed_s=elapsed,
+        telemetry_dir=args.telemetry,
+    )
+    summary["replica_exit_codes"] = codes
+    path = write_fleet_summary(queue_dir, summary)
+    print(f"fleet summary:   {path}")
+    return results, elapsed
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=21)
@@ -120,6 +210,23 @@ def main(argv=None):
     ap.add_argument("--chunk-trials", type=int, default=8)
     ap.add_argument("--in-process", action="store_true",
                     help="drive QBAServer directly instead of a subprocess")
+    ap.add_argument("--transport", choices=("file-queue", "socket"),
+                    default="file-queue",
+                    help="file-queue = one subprocess server; socket = the "
+                    "fleet stack (front-end + admission + --replicas "
+                    "workers)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="worker processes for --transport socket")
+    ap.add_argument("--chaos-kill", action="store_true",
+                    help="socket transport only: SIGKILL one replica "
+                    "mid-stream and assert zero lost requests")
+    ap.add_argument("--reclaim-timeout-s", type=float, default=30.0,
+                    help="fleet crash-recovery reclaim timeout; must "
+                    "exceed the worst-case claim-to-result time (cold "
+                    "compiles!) or live claims get double-served")
+    ap.add_argument("--report-json", default=None,
+                    help="write {rpm, p50_s, p99_s, results, replicas} "
+                    "to this file (CI compares 1- vs 2-replica rates)")
     ap.add_argument("--queue-dir", default=None)
     ap.add_argument("--telemetry", default=None,
                     help="per-request manifest/trace directory")
@@ -142,6 +249,8 @@ def main(argv=None):
     stream = make_stream(args.requests, args.trials, target=args.target)
     if args.in_process:
         results, elapsed = run_in_process(args, stream)
+    elif args.transport == "socket":
+        results, elapsed = run_socket(args, stream)
     else:
         results, elapsed = run_subprocess(args, stream)
 
@@ -192,6 +301,27 @@ def main(argv=None):
     print(f"latency mean:    {lat['mean_s'] * 1e3:.1f} ms  "
           f"(min {lat['min_s'] * 1e3:.1f}, max {lat['max_s'] * 1e3:.1f})")
 
+    if args.transport == "socket":
+        # Per-replica attribution: every result names the replica that
+        # served it, and queue-wait vs device-time come from its spans.
+        per = {}
+        for r in results:
+            per.setdefault(r.get("replica_id"), []).append(r)
+        for rid in sorted(per, key=str):
+            rs = per[rid]
+            waits = [r["queue_wait_s"] for r in rs
+                     if r.get("queue_wait_s") is not None]
+            mean_wait = sum(waits) / len(waits) * 1e3 if waits else 0.0
+            mean_dev = sum(r["latency_s"] for r in rs) / len(rs) * 1e3
+            print(f"replica {rid}:      {len(rs)} requests, "
+                  f"mean queue-wait {mean_wait:.1f} ms, "
+                  f"mean device-time {mean_dev:.1f} ms")
+        admitted = [r for r in results
+                    if (r.get("admission") or {}).get("action")]
+        if admitted:
+            print(f"admission:       {len(admitted)}/{len(results)} "
+                  "results carry a typed admission decision")
+
     if args.target:
         # Time-to-decision: for a targeted request the request span
         # closes when its stopping rule resolves (or the budget runs
@@ -234,6 +364,26 @@ def main(argv=None):
             )
 
     print("manifests:       all valid; bit-identity spot check passed")
+    if args.report_json:
+        with open(args.report_json, "w") as f:
+            json.dump(
+                {
+                    "rpm": rpm,
+                    "p50_s": lat["p50_s"],
+                    "p99_s": lat["p99_s"],
+                    "results": len(results),
+                    "transport": args.transport,
+                    "replicas": (
+                        args.replicas if args.transport == "socket" else 1
+                    ),
+                    "chaos_kill": bool(args.chaos_kill),
+                    "served_by": sorted(
+                        {str(r.get("replica_id")) for r in results}
+                    ),
+                },
+                f,
+                indent=1,
+            )
     return 0
 
 

@@ -19,7 +19,8 @@ The discipline the tree lives by is: a host sync is legal only
 This pass mechanizes it three ways:
 
 * **AST sweep** over the hot modules (``rounds/``, ``ops/``,
-  ``serve/``, ``sweep.py``, ``benchmark.py``): every sync-shaped call
+  ``serve/``, ``serve/fleet/``, ``sweep.py``, ``benchmark.py``): every
+  sync-shaped call
   site must be fenced or annotated.  ``jnp.asarray`` is device-side
   and never flagged.  Zero sites found across the serve/sweep
   pipelines is itself a finding — the audit no longer matches the
@@ -31,6 +32,11 @@ This pass mechanizes it three ways:
   the ``serve.dispatch`` span stays enqueue-only (no sync, never
   fenced), and ``_drain_one`` pops FIFO (``pop(0)``) so readback
   order matches dispatch order.
+* **Fleet front-half proof** (:func:`check_fleet`): the socket
+  front-end never imports jax, no fleet front-half module calls a
+  device entry point, and the replica pool spawns the stock
+  ``serve --transport file-queue`` loop — so multi-replica dispatch
+  ordering inherits the double-buffer proof unchanged.
 * **Jaxpr sweep** over the traced build paths: callback primitives
   (``pure_callback`` / ``io_callback`` / ``debug_callback``) inside a
   hot jitted program are implicit host round-trips per grid step and
@@ -72,7 +78,7 @@ def hot_module_paths(root: str | None = None) -> list[str]:
 
         root = os.path.dirname(qba_tpu.__file__)
     paths: list[str] = []
-    for sub in ("rounds", "ops", "serve"):
+    for sub in ("rounds", "ops", "serve", os.path.join("serve", "fleet")):
         d = os.path.join(root, sub)
         for fname in sorted(os.listdir(d)):
             if fname.endswith(".py"):
@@ -379,6 +385,138 @@ def check_serve_dispatch(source_path: str | None = None) -> Report:
 
 
 # ---------------------------------------------------------------------------
+# Fleet front-half proof.
+
+#: Call names that enter the device path; none may appear in the fleet
+#: front half (frontend/pool/admission/summary) — replicas, and only
+#: replicas, touch devices.
+_DEVICE_ENTRY_NAMES = frozenset({
+    "run_trials", "trial_keys", "pallas_call", "device_put",
+    "wrap_key_data", "block_until_ready", "serve_batch",
+})
+
+
+def _fleet_dir() -> str:
+    import qba_tpu
+
+    return os.path.join(os.path.dirname(qba_tpu.__file__), "serve", "fleet")
+
+
+def check_fleet(fleet_dir: str | None = None) -> Report:
+    """Statically prove the fleet front half does no device work
+    (docs/SERVING.md "Fleet"): the asyncio front-end and pool manager
+    move JSON between sockets and the file queue, and every device
+    byte flows through the replicas' serve loops — whose dispatch
+    ordering :func:`check_serve_dispatch` already proves.
+
+    Three obligations:
+
+    1. ``frontend.py`` never imports jax/jaxlib at all — not even
+       lazily — so the listener can never trigger a device→host
+       transfer (its sync discipline is vacuously clean).
+    2. No fleet module calls a device entry point
+       (``run_trials`` / ``pallas_call`` / ``serve_batch`` / ...):
+       the front half has no dispatch path of its own.
+    3. ``ReplicaPool.worker_argv`` spawns the stock
+       ``serve --transport file-queue`` loop (the ``"serve"`` and
+       ``"file-queue"`` argv constants are present), so pool dispatch
+       ordering inherits the double-buffer proof unchanged.
+    """
+    report = Report()
+    fleet_dir = fleet_dir if fleet_dir is not None else _fleet_dir()
+    if not os.path.isdir(fleet_dir):
+        report.findings.append(Finding(
+            ki="KI-6", check="fleet-front", path="fleet:*",
+            message=(
+                "serve/fleet/ not found — the fleet front-half proof "
+                "no longer matches the module layout"
+            ),
+        ))
+        return report
+
+    modules_checked = 0
+    for fname in sorted(os.listdir(fleet_dir)):
+        if not fname.endswith(".py"):
+            continue
+        modules_checked += 1
+        path = os.path.join(fleet_dir, fname)
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        # Obligation 1: the frontend never imports jax, even lazily.
+        if fname == "frontend.py":
+            for node in ast.walk(tree):
+                mods = []
+                if isinstance(node, ast.Import):
+                    mods = [a.name for a in node.names]
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    mods = [node.module]
+                for mod in mods:
+                    top = mod.split(".")[0]
+                    if top in ("jax", "jaxlib"):
+                        report.findings.append(Finding(
+                            ki="KI-6", check="fleet-front",
+                            path=f"fleet:{fname}",
+                            where=f"{path}:{node.lineno}",
+                            message=(
+                                f"frontend.py imports {mod}: the "
+                                "socket front-end must stay jax-free "
+                                "so it can never perform a "
+                                "device→host transfer"
+                            ),
+                        ))
+        # Obligation 2: no device entry points anywhere in the front
+        # half.
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name in _DEVICE_ENTRY_NAMES:
+                report.findings.append(Finding(
+                    ki="KI-6", check="fleet-front", path=f"fleet:{fname}",
+                    where=f"{path}:{node.lineno}",
+                    message=(
+                        f"fleet front-half module calls {name}(): "
+                        "device work belongs in the replicas' serve "
+                        "loops, which the dispatch-order proof covers "
+                        "— the front half must stay dispatch-free"
+                    ),
+                ))
+
+    # Obligation 3: workers run the proven serve loop.
+    pool_path = os.path.join(fleet_dir, "pool.py")
+    ok_argv = False
+    if os.path.isfile(pool_path):
+        with open(pool_path) as fh:
+            pool_tree = ast.parse(fh.read(), filename=pool_path)
+        argv_fn = _find_method(pool_tree, "ReplicaPool", "worker_argv")
+        if argv_fn is not None:
+            consts = {
+                n.value
+                for n in ast.walk(argv_fn)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            }
+            ok_argv = {"serve", "file-queue", "--transport"} <= consts
+    if not ok_argv:
+        report.findings.append(Finding(
+            ki="KI-6", check="fleet-front", path="fleet:pool.py",
+            where=pool_path,
+            message=(
+                "ReplicaPool.worker_argv does not spawn "
+                "'serve --transport file-queue': pool dispatch "
+                "ordering no longer inherits the serve double-buffer "
+                "proof"
+            ),
+        ))
+    report.stats["fleet_modules_checked"] = modules_checked
+    report.stats["fleet_proof_obligations"] = 3
+    return report
+
+
+# ---------------------------------------------------------------------------
 # Jaxpr half: host callbacks inside traced programs.
 
 
@@ -446,4 +584,5 @@ def check_transfers(module_paths=None) -> Report:
         ))
     report.stats.update(stats)
     report.extend(check_serve_dispatch())
+    report.extend(check_fleet())
     return report

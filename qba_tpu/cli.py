@@ -20,6 +20,10 @@ config is explicit and validated (:class:`qba_tpu.config.QBAConfig`):
   (stdin-JSONL or file-queue) with shape-bucketed, double-buffered
   dispatch and per-request run manifests (:mod:`qba_tpu.serve`,
   docs/SERVING.md).
+* ``fleet`` — multi-replica serving: a socket/HTTP front-end plus N
+  device-pinned serve workers sharing one crash-hardened file queue,
+  with target-aware admission (:mod:`qba_tpu.serve.fleet`,
+  docs/SERVING.md "Fleet").
 """
 
 from __future__ import annotations
@@ -389,6 +393,96 @@ def _parser() -> argparse.ArgumentParser:
         "--cache-stats", action="store_true",
         help="print the resolver-cache/probe counters (size, cap, "
         "evictions) plus the cache-dir artifact status and exit",
+    )
+    serve.add_argument(
+        "--replica-id", metavar="ID", default=None,
+        help="fleet replica identity: stamped on every result/manifest "
+        "and used to name this worker's exit summary "
+        "(summary-<ID>.json) so N replicas sharing one queue dir "
+        "never clobber each other (docs/SERVING.md 'Fleet')",
+    )
+
+    fleet = sub.add_parser(
+        "fleet",
+        help="multi-replica serving: socket/HTTP front-end + N device-"
+        "pinned serve workers over one shared file queue, with target-"
+        "aware admission (docs/SERVING.md 'Fleet')",
+    )
+    fleet.add_argument(
+        "--queue-dir", metavar="DIR", required=True,
+        help="shared queue directory (created if missing); the fleet "
+        "summary lands here as fleet_summary.json",
+    )
+    fleet.add_argument(
+        "--replicas", type=int, default=2,
+        help="worker processes; each runs the file-queue serve loop "
+        "pinned to one device (TPU: chip K via TPU_VISIBLE_CHIPS)",
+    )
+    fleet.add_argument(
+        "--host", default="127.0.0.1",
+        help="front-end listen address",
+    )
+    fleet.add_argument(
+        "--port", type=int, default=0,
+        help="front-end listen port (0 = ephemeral; the bound port is "
+        "printed to stderr at boot)",
+    )
+    fleet.add_argument(
+        "--chunk-trials", type=int, default=64,
+        help="trials per device chunk (shared by workers and the "
+        "admission price quantizer)",
+    )
+    fleet.add_argument(
+        "--depth", type=int, default=2,
+        help="per-replica double-buffer depth",
+    )
+    fleet.add_argument(
+        "--cache-dir", metavar="DIR", default=None,
+        help="shared warm-start artifact directory; the plans.json "
+        "file lock makes concurrent replica boots/saves safe",
+    )
+    fleet.add_argument(
+        "--telemetry", metavar="DIR", default=None,
+        help="per-request telemetry root shared by all replicas (each "
+        "request dir carries its replica_id)",
+    )
+    fleet.add_argument(
+        "--deadline-s", type=float, default=None,
+        help="per-request wall-clock deadline inside each worker",
+    )
+    fleet.add_argument(
+        "--reclaim-timeout-s", type=float, default=5.0,
+        help="crash recovery: claims older than this with no result "
+        "are pushed back to the inbox for a surviving replica",
+    )
+    fleet.add_argument(
+        "--max-reclaims", type=int, default=3,
+        help="reclaim attempts per request before dead-lettering",
+    )
+    fleet.add_argument(
+        "--max-requests", type=int, default=None,
+        help="front-end exits after fully answering this many "
+        "requests (CI smoke); default: run until SIGINT",
+    )
+    fleet.add_argument(
+        "--no-admission", action="store_true",
+        help="disable the admission layer (every request goes straight "
+        "to the queue; no pricing, no defer/reject)",
+    )
+    fleet.add_argument(
+        "--capacity-trials", type=int, default=None,
+        help="admission window: max priced-but-unsettled trials "
+        "fleet-wide (default: replicas * window-chunks * chunk-trials)",
+    )
+    fleet.add_argument(
+        "--window-chunks", type=int, default=8,
+        help="per-replica chunks of headroom in the default capacity "
+        "window",
+    )
+    fleet.add_argument(
+        "--poll-s", type=float, default=0.05,
+        help="worker inbox poll interval (the front-end outbox poll "
+        "runs at a fixed 20ms)",
     )
 
     study = sub.add_parser(
@@ -1009,6 +1103,7 @@ def _cmd_serve(args: argparse.Namespace, out) -> int:
         cache_dir=args.cache_dir,
         warm_start=not args.no_warm_start,
         deadline_s=args.deadline_s,
+        replica_id=args.replica_id,
     )
     if args.transport == "file-queue":
         if not args.queue_dir:
@@ -1033,6 +1128,82 @@ def _cmd_serve(args: argparse.Namespace, out) -> int:
     return 0
 
 
+def _cmd_fleet(args: argparse.Namespace, out) -> int:
+    import json
+    import time
+
+    from qba_tpu.serve.fleet import (
+        AdmissionController,
+        FleetFrontend,
+        ReplicaPool,
+        fleet_summary,
+        write_fleet_summary,
+    )
+
+    admission = None
+    if not args.no_admission:
+        admission = AdmissionController(
+            chunk_trials=args.chunk_trials,
+            replicas=args.replicas,
+            capacity_trials=args.capacity_trials,
+            window_chunks=args.window_chunks,
+        )
+    pool = ReplicaPool(
+        args.queue_dir,
+        replicas=args.replicas,
+        chunk_trials=args.chunk_trials,
+        depth=args.depth,
+        cache_dir=args.cache_dir,
+        telemetry_dir=args.telemetry,
+        deadline_s=args.deadline_s,
+        reclaim_timeout_s=args.reclaim_timeout_s,
+        max_reclaims=args.max_reclaims,
+        poll_s=args.poll_s,
+    )
+    frontend = FleetFrontend(
+        args.queue_dir,
+        admission,
+        host=args.host,
+        port=args.port,
+        max_requests=args.max_requests,
+    )
+    t0 = time.monotonic()
+    pool.start()
+    try:
+        port = frontend.start_in_thread()
+        print(
+            json.dumps(
+                {
+                    "fleet": {
+                        "listening": f"{args.host}:{port}",
+                        "replicas": pool.alive(),
+                        "queue_dir": args.queue_dir,
+                    }
+                }
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
+        try:
+            frontend._thread.join()
+        except KeyboardInterrupt:
+            frontend.stop_in_thread()
+    finally:
+        codes = pool.stop()
+    status = frontend.status()
+    summary = fleet_summary(
+        args.queue_dir,
+        admission_summary=admission.summary() if admission else None,
+        frontend_status=status,
+        elapsed_s=time.monotonic() - t0,
+        telemetry_dir=args.telemetry,
+    )
+    summary["replica_exit_codes"] = codes
+    path = write_fleet_summary(args.queue_dir, summary)
+    print(json.dumps({"fleet_summary": path}), file=sys.stderr)
+    return 0
+
+
 def main(argv: Sequence[str] | None = None, out=None) -> int:
     out = out if out is not None else sys.stdout
     args = _parser().parse_args(argv)
@@ -1052,6 +1223,8 @@ def main(argv: Sequence[str] | None = None, out=None) -> int:
             return _cmd_lint(args, out)
         if args.command == "serve":
             return _cmd_serve(args, out)
+        if args.command == "fleet":
+            return _cmd_fleet(args, out)
     except ValueError as e:  # config validation -> clean CLI failure
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -46,6 +46,16 @@ def plans_path(cache_dir: str | None = None) -> str:
     return os.path.join(cache_dir or default_cache_root(), "plans.json")
 
 
+def plans_lock_path(cache_dir: str | None = None) -> str:
+    """The advisory lock file guarding ``plans.json`` reads/writes.
+
+    A fleet boot starts N replicas against one cache directory; the
+    lock serializes their save/load so no replica ever observes a torn
+    artifact and no replica's flush clobbers another's freshly merged
+    plans (:mod:`qba_tpu.serve.persist`)."""
+    return plans_path(cache_dir) + ".lock"
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at ``path`` (default:
     :func:`xla_cache_dir`, whose ``QBA_COMPILE_CACHE`` env override can

@@ -189,6 +189,47 @@ class SpanRecorder:
         return path
 
 
+def spans_from_jsonl(path: str) -> list[Span]:
+    """Reconstruct :class:`Span` objects from a ``write_jsonl`` export.
+
+    The inverse of :meth:`SpanRecorder.to_jsonl`, for cross-process
+    aggregation: each fleet replica exports its own span file, and the
+    fleet summary merges them back into one list (``index``/``parent``
+    stay file-local — only name/dur/args matter to aggregation).
+    Malformed lines are skipped: a replica killed mid-write must not
+    take the fleet summary down with it."""
+    spans: list[Span] = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return spans
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            d = json.loads(line)
+            spans.append(
+                Span(
+                    name=d["name"],
+                    index=int(d.get("index", len(spans))),
+                    parent=d.get("parent"),
+                    depth=int(d.get("depth", 0)),
+                    t0=float(d.get("t0_s", 0.0)),
+                    dur=(
+                        float(d["dur_s"]) if d.get("dur_s") is not None else None
+                    ),
+                    cat=d.get("cat", "host"),
+                    fenced=bool(d.get("fenced", False)),
+                    args=dict(d.get("args") or {}),
+                )
+            )
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            continue
+    return spans
+
+
 def _percentile(sorted_vals: list[float], q: float) -> float:
     """Linear-interpolation percentile over pre-sorted values (numpy's
     default method, reimplemented so latency summaries stay jax/numpy

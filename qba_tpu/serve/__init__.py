@@ -14,6 +14,11 @@ Module map:
 * :mod:`~qba_tpu.serve.engine` — :class:`QBAServer`, the dispatch loop.
 * :mod:`~qba_tpu.serve.transport` — stdin-JSONL and file-queue drivers.
 * :mod:`~qba_tpu.serve.persist` — the ``plans.json`` warm-start artifact.
+* :mod:`~qba_tpu.serve.queuefs` — jax-free file-queue path helpers.
+* :mod:`~qba_tpu.serve.fleet` — network front-end, replica pool, and
+  target-aware admission (ROADMAP item 4); imported lazily by callers,
+  not here, so the jax-free fleet front half stays importable without
+  the engine.
 """
 
 from qba_tpu.serve.engine import QBAServer, serve_batch

@@ -70,6 +70,7 @@ class _Active:
     overflow: np.ndarray
     arrived: float = 0.0  # time.monotonic() at submit
     deadline_s: float | None = None  # resolved wall-clock budget
+    queue_wait_s: float | None = None  # transport wait before submit
     decisions: np.ndarray | None = None  # allocated at first readback
     filled: int = 0
     chunks: int = 0
@@ -102,6 +103,7 @@ class QBAServer:
         cache_dir: str | None = None,
         warm_start: bool = True,
         deadline_s: float | None = None,
+        replica_id: str | None = None,
     ) -> None:
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
@@ -110,6 +112,10 @@ class QBAServer:
         self.scheduler = BucketScheduler(chunk_trials)
         self.depth = depth
         self.deadline_s = deadline_s
+        # Fleet attribution: set when this server is one worker of a
+        # replica pool — stamped on every result, manifest, and request
+        # span so cross-replica aggregation can tell the workers apart.
+        self.replica_id = replica_id
         self._expired = 0
         self.telemetry_dir = telemetry_dir
         self.cache_dir = cache_dir
@@ -129,9 +135,14 @@ class QBAServer:
                 self.restored_plans = persist.load_plans(cache_dir)
 
     # ---- intake ------------------------------------------------------
-    def submit(self, req: EvalRequest) -> None:
+    def submit(
+        self, req: EvalRequest, *, queue_wait_s: float | None = None
+    ) -> None:
         """Validate and queue one request (the latency clock starts
-        here).  Raises ``ValueError`` on a bad config or duplicate id —
+        here).  ``queue_wait_s`` is the transport-measured wait before
+        this submit (file-queue: claim time minus inbox mtime) — echoed
+        on the result for queue-wait vs device-time attribution.
+        Raises ``ValueError`` on a bad config or duplicate id —
         transports turn that into an error result."""
         if req.request_id in self._active:
             raise ValueError(f"request id already in flight: {req.request_id!r}")
@@ -157,13 +168,16 @@ class QBAServer:
         recorder = SpanRecorder()
         probe_before = probe_stats_snapshot()
         bucket = self.scheduler.bucket_for(cfg)
-        root_ctx = recorder.span(
-            REQUEST_SPAN,
-            cat="serve",
+        span_args: dict[str, Any] = dict(
             request_id=req.request_id,
             bucket=bucket_label(bucket),
             trials=cfg.trials,
         )
+        if self.replica_id is not None:
+            span_args["replica_id"] = self.replica_id
+        if queue_wait_s is not None:
+            span_args["queue_wait_s"] = queue_wait_s
+        root_ctx = recorder.span(REQUEST_SPAN, cat="serve", **span_args)
         root_span = root_ctx.__enter__()
         self.scheduler.enqueue(req.request_id, cfg, key_data)
         if bucket not in self._served_buckets:
@@ -183,6 +197,7 @@ class QBAServer:
                 req.deadline_s if req.deadline_s is not None
                 else self.deadline_s
             ),
+            queue_wait_s=queue_wait_s,
             target=target,
             rule=rule,
         )
@@ -261,6 +276,7 @@ class QBAServer:
                     "expired": True,
                     "trials_completed": ar.filled,
                     "stats": stats_block,
+                    **self._attribution(ar),
                 },
             )
         )
@@ -275,6 +291,8 @@ class QBAServer:
         res.bucket = label
         res.chunks = ar.chunks
         res.manifest = manifest
+        res.replica_id = self.replica_id
+        res.queue_wait_s = ar.queue_wait_s
         if ar.rule is not None and ar.filled:
             # Partial-progress estimate for a timed-out targeted
             # request: anytime-valid over the prefix it did complete.
@@ -284,10 +302,30 @@ class QBAServer:
     def close(self) -> list[EvalResult]:
         return self.flush()
 
+    def _attribution(self, ar: _Active) -> dict[str, Any]:
+        """Fleet attribution fields for a request's manifest extra."""
+        out: dict[str, Any] = {}
+        if self.replica_id is not None:
+            out["replica_id"] = self.replica_id
+        if ar.queue_wait_s is not None:
+            out["queue_wait_s"] = ar.queue_wait_s
+        return out
+
     @property
     def busy(self) -> bool:
         """True while any trial is queued or any chunk is in flight."""
         return bool(self._in_flight) or self.scheduler.pending_trials() > 0
+
+    @property
+    def backlog_trials(self) -> int:
+        """Trials accepted but not yet read back: queued in the
+        scheduler plus in-flight chunks (chunks are fixed-size, padded).
+        The file-queue transport uses this as its work-sharing
+        watermark — claim more only while the pipeline has room."""
+        return (
+            self.scheduler.pending_trials()
+            + len(self._in_flight) * self.scheduler.chunk_trials
+        )
 
     def _dispatch(self, chunk: Chunk) -> list[EvalResult]:
         import jax
@@ -422,6 +460,7 @@ class QBAServer:
                     "chunks": ar.chunks,
                     "restored_plans": self.restored_plans,
                     "stats": stats_block,
+                    **self._attribution(ar),
                 },
             )
         )
@@ -451,6 +490,8 @@ class QBAServer:
                 if stop is not None and stop.estimate is not None
                 else None
             ),
+            replica_id=self.replica_id,
+            queue_wait_s=ar.queue_wait_s,
         )
 
     def _write_telemetry(self, ar: _Active, manifest: dict) -> None:
@@ -473,10 +514,35 @@ class QBAServer:
             self._request_spans, REQUEST_SPAN, percentiles
         )
 
+    def queue_wait_summary(
+        self, percentiles: tuple[float, ...] = (50.0, 99.0)
+    ) -> dict[str, Any]:
+        """Distribution of transport queue waits across finished
+        requests (the ``queue_wait_s`` arg the transports stamp on each
+        ``request`` span) — the other half of the latency attribution:
+        ``latency`` is replica-side time, this is time spent waiting
+        for a replica."""
+        from qba_tpu.obs.telemetry import _percentile
+
+        waits = sorted(
+            float(sp.args["queue_wait_s"])
+            for sp in self._request_spans
+            if "queue_wait_s" in sp.args
+        )
+        summary: dict[str, Any] = {"count": len(waits)}
+        if not waits:
+            return summary
+        summary["mean_s"] = sum(waits) / len(waits)
+        summary["max_s"] = waits[-1]
+        for q in percentiles:
+            summary[f"p{q:g}_s"] = _percentile(waits, q)
+        return summary
+
     def stats(self) -> dict[str, Any]:
         from qba_tpu.ops.round_kernel_tiled import resolve_cache_info
 
         return {
+            "replica_id": self.replica_id,
             "completed": self._completed,
             "expired": self._expired,
             "in_flight_chunks": len(self._in_flight),
@@ -484,6 +550,7 @@ class QBAServer:
             "buckets": [bucket_label(b) for b in self._served_buckets],
             "restored_plans": self.restored_plans,
             "latency": self.latency_summary(),
+            "queue_wait": self.queue_wait_summary(),
             "resolver": resolve_cache_info(),
         }
 

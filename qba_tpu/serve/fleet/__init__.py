@@ -1,0 +1,55 @@
+"""qba_tpu.serve.fleet — network front-end, replica pool, admission.
+
+The fleet subsystem turns the single-process :class:`QBAServer` into a
+multi-replica service without inventing new dispatch machinery:
+
+* :mod:`frontend` — an asyncio socket/HTTP JSONL listener (never
+  imports jax; analysis/transfers.py proves it) that writes admitted
+  requests into the crash-hardened file queue and streams results back;
+* :mod:`pool` — N worker processes, each running the existing
+  ``qba-tpu serve --transport file-queue`` loop pinned to one device,
+  booting from the shared warm-start artifact behind its file lock;
+* :mod:`admission` — target-aware pricing of each request against the
+  KI-2 trial-ceiling model and a fleet-wide capacity window, with
+  typed admit/defer/reject decisions and release-on-settle;
+* :mod:`summary` — cross-replica aggregation: per-replica and
+  fleet-wide p50/p99, queue-wait vs device-time attribution, admission
+  decision counts, one ``fleet_summary.json``.
+
+``qba-tpu fleet`` (cli.py) wires all four together; docs/SERVING.md
+has the topology and operator guide.
+"""
+
+from qba_tpu.serve.fleet.admission import (
+    ADMIT,
+    DEFER,
+    REASONS,
+    REJECT,
+    AdmissionController,
+    AdmissionDecision,
+)
+from qba_tpu.serve.fleet.frontend import FleetFrontend
+from qba_tpu.serve.fleet.pool import Replica, ReplicaPool, make_device_env
+from qba_tpu.serve.fleet.summary import (
+    FLEET_SUMMARY_SCHEMA,
+    fleet_summary,
+    merge_fleet_spans,
+    write_fleet_summary,
+)
+
+__all__ = [
+    "ADMIT",
+    "DEFER",
+    "REJECT",
+    "REASONS",
+    "AdmissionController",
+    "AdmissionDecision",
+    "FleetFrontend",
+    "Replica",
+    "ReplicaPool",
+    "make_device_env",
+    "FLEET_SUMMARY_SCHEMA",
+    "fleet_summary",
+    "merge_fleet_spans",
+    "write_fleet_summary",
+]

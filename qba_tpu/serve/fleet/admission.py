@@ -1,0 +1,241 @@
+"""Target-aware admission: price a request before it touches the queue.
+
+The front-end must not let traffic wedge the pool: a shape whose
+per-trial pool footprint exceeds one device's HBM can never execute
+(KI-2, :func:`qba_tpu.analysis.memory.trial_ceiling`), and a burst of
+huge trial budgets would bury small requests in queue wait.  So every
+request is **priced** before it is enqueued:
+
+* the price is the request's trial budget, discounted by its precision
+  target when it has one (:meth:`qba_tpu.stats.Target.planning_trials`
+  — the Wald expected-sample-size bound, quantized up to whole device
+  chunks, since the scheduler dispatches chunk-granular work);
+* the controller keeps a live ledger of priced-but-unfinished trials
+  against a fleet-wide capacity window (``replicas × window_chunks ×
+  chunk_trials`` by default) and **admits**, **defers** (capacity is
+  temporarily full — retry after a release), or **rejects** (the
+  request can never be served: invalid, unservable shape, or bigger
+  than the whole window) with a typed reason;
+* :meth:`AdmissionController.settle` releases a request's priced
+  capacity when its result lands — an early-stopped target releases
+  the *unused* remainder to the next tenant at the same moment.
+
+Determinism contract (tests/test_fleet.py): decisions are a pure
+function of the request sequence and settle points — no clocks, no
+randomness — so a fixed stream always yields the same decision list.
+No jax at module level: pricing pulls the KI-2 ceiling model in lazily
+and only for shapes it has not seen before.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+#: Decision vocabulary: ``action`` is one of ADMIT/DEFER/REJECT and
+#: ``reason`` one of REASONS (typed, so callers can switch on it).
+ADMIT = "admit"
+DEFER = "defer"
+REJECT = "reject"
+
+REASONS = (
+    "capacity_available",  # admit: priced trials fit the live window
+    "window_full",  # defer: retry once in-flight work settles
+    "invalid_request",  # reject: config/target failed validation
+    "unservable_shape",  # reject: KI-2 ceiling below one device chunk
+    "oversized_request",  # reject: price exceeds the whole fleet window
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionDecision:
+    """One typed admission verdict, echoed on the wire
+    (``EvalResult.admission``) and into the fleet summary."""
+
+    action: str
+    reason: str
+    request_id: str
+    bucket: str = ""
+    priced_trials: int = 0
+    outstanding_trials: int = 0  # ledger total AFTER this decision
+    capacity_trials: int = 0
+    detail: str = ""
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class AdmissionController:
+    """Live capacity ledger + pricing for one fleet front-end.
+
+    ``capacity_trials`` is the fleet-wide outstanding-work window: how
+    many priced trials may be admitted-but-unsettled at once.  The
+    default models each replica holding ``window_chunks`` chunks of
+    work (its double-buffer depth plus queue headroom); the CLI exposes
+    it directly for operators with measured numbers.
+    """
+
+    def __init__(
+        self,
+        *,
+        chunk_trials: int = 64,
+        replicas: int = 1,
+        capacity_trials: int | None = None,
+        window_chunks: int = 8,
+        hbm_bytes: int | None = None,
+    ) -> None:
+        if chunk_trials < 1:
+            raise ValueError(f"chunk_trials must be >= 1, got {chunk_trials}")
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.chunk_trials = chunk_trials
+        self.replicas = replicas
+        self.capacity_trials = (
+            capacity_trials
+            if capacity_trials is not None
+            else replicas * window_chunks * chunk_trials
+        )
+        self.hbm_bytes = hbm_bytes
+        self._outstanding: dict[str, int] = {}  # request_id -> priced
+        self._ceilings: dict[str, int] = {}  # bucket label -> KI-2 ceiling
+        self.decisions: list[AdmissionDecision] = []
+        self.released_trials = 0  # settled price, incl. early-stop refunds
+
+    @property
+    def outstanding_trials(self) -> int:
+        return sum(self._outstanding.values())
+
+    # ---- pricing -----------------------------------------------------
+    def _chunk_quantize(self, trials: int) -> int:
+        chunks = -(-trials // self.chunk_trials)
+        return chunks * self.chunk_trials
+
+    def price(self, req) -> tuple[int, str]:
+        """(priced_trials, detail) for one request: the trial budget,
+        target-discounted, rounded up to whole device chunks."""
+        if req.target is None:
+            return self._chunk_quantize(req.trials), "full budget"
+        from qba_tpu.stats import parse_target
+
+        target = parse_target(req.target)
+        planned = target.planning_trials(req.trials)
+        return (
+            self._chunk_quantize(planned),
+            f"target plans {planned} of {req.trials} budget trials",
+        )
+
+    def _ceiling(self, req) -> int:
+        """KI-2 trial ceiling for the request's shape bucket, memoized
+        per bucket label (the ceiling is pure shape arithmetic)."""
+        from qba_tpu.analysis.memory import HBM_BYTES, trial_ceiling
+        from qba_tpu.serve.scheduler import bucket_config, bucket_label
+
+        bucket = bucket_config(req.config(), self.chunk_trials)
+        label = bucket_label(bucket)
+        if label not in self._ceilings:
+            self._ceilings[label] = trial_ceiling(
+                bucket,
+                hbm_bytes=(
+                    self.hbm_bytes if self.hbm_bytes is not None else HBM_BYTES
+                ),
+            )
+        return self._ceilings[label]
+
+    # ---- the decision ------------------------------------------------
+    def try_admit(self, req) -> AdmissionDecision:
+        """Price and decide one request.  ``admit`` records the price
+        in the ledger (the caller MUST eventually :meth:`settle`);
+        ``defer`` and ``reject`` leave the ledger untouched."""
+        from qba_tpu.serve.scheduler import bucket_config, bucket_label
+
+        rid = req.request_id
+        try:
+            label = bucket_label(bucket_config(req.config(), self.chunk_trials))
+            ceiling = self._ceiling(req)
+            priced, detail = self.price(req)
+        except ValueError as e:
+            return self._decide(
+                REJECT, "invalid_request", rid, detail=str(e)
+            )
+        if ceiling < self.chunk_trials:
+            return self._decide(
+                REJECT, "unservable_shape", rid, bucket=label, priced=priced,
+                detail=(
+                    f"KI-2 trial ceiling {ceiling} < chunk_trials "
+                    f"{self.chunk_trials}: one device chunk of this shape "
+                    "exhausts HBM"
+                ),
+            )
+        if priced > self.capacity_trials:
+            return self._decide(
+                REJECT, "oversized_request", rid, bucket=label, priced=priced,
+                detail=(
+                    f"priced {priced} trials > fleet window "
+                    f"{self.capacity_trials}: would wedge every other tenant"
+                ),
+            )
+        if self.outstanding_trials + priced > self.capacity_trials:
+            return self._decide(
+                DEFER, "window_full", rid, bucket=label, priced=priced,
+                detail=(
+                    f"{self.outstanding_trials} trials outstanding; retry "
+                    "after a release"
+                ),
+            )
+        self._outstanding[rid] = priced
+        return self._decide(
+            ADMIT, "capacity_available", rid, bucket=label, priced=priced,
+            detail=detail,
+        )
+
+    def settle(self, request_id: str, executed_trials: int | None = None) -> int:
+        """Release a finished request's priced capacity; returns the
+        trials released.  ``executed_trials`` (from the result) lets
+        the summary report how much of the price an early stop
+        refunded — the release itself is always the full price, which
+        is what makes deferred admits retry-able the moment any
+        tenant finishes."""
+        priced = self._outstanding.pop(request_id, 0)
+        self.released_trials += priced
+        return priced
+
+    def _decide(
+        self,
+        action: str,
+        reason: str,
+        rid: str,
+        *,
+        bucket: str = "",
+        priced: int = 0,
+        detail: str = "",
+    ) -> AdmissionDecision:
+        assert reason in REASONS, reason
+        dec = AdmissionDecision(
+            action=action,
+            reason=reason,
+            request_id=rid,
+            bucket=bucket,
+            priced_trials=priced,
+            outstanding_trials=self.outstanding_trials,
+            capacity_trials=self.capacity_trials,
+            detail=detail,
+        )
+        self.decisions.append(dec)
+        return dec
+
+    def summary(self) -> dict[str, Any]:
+        """Decision counts + ledger state for the fleet summary."""
+        by_action: dict[str, int] = {}
+        by_reason: dict[str, int] = {}
+        for dec in self.decisions:
+            by_action[dec.action] = by_action.get(dec.action, 0) + 1
+            by_reason[dec.reason] = by_reason.get(dec.reason, 0) + 1
+        return {
+            "decisions": len(self.decisions),
+            "by_action": by_action,
+            "by_reason": by_reason,
+            "capacity_trials": self.capacity_trials,
+            "outstanding_trials": self.outstanding_trials,
+            "released_trials": self.released_trials,
+            "bucket_ceilings": dict(self._ceilings),
+        }

@@ -1,0 +1,386 @@
+"""The fleet front-end: asyncio sockets in, file-queue out.
+
+One asyncio TCP server speaks the existing wire model — one
+:class:`~qba_tpu.serve.request.EvalRequest` JSON object per line in,
+one :class:`~qba_tpu.serve.request.EvalResult` JSON object per line
+out (completion order) — plus a minimal HTTP mode on the same port
+(``POST`` any path with a JSONL body answers 200 with the result
+lines; ``GET`` answers the live fleet status).  Requests without a
+``request_id`` get one assigned here.
+
+The front-end does **no device work** — statically provable
+(:func:`qba_tpu.analysis.transfers.check_fleet`): it never imports
+jax, and its only job is admission
+(:class:`~qba_tpu.serve.fleet.admission.AdmissionController`) plus
+moving JSON between sockets and the PR 9 crash-hardened file queue.
+Admitted requests are dropped into ``inbox/`` (temp + rename), the
+replica pool's claim/reclaim/dead-letter/deadline machinery is the
+entire fault story, and a poller watches ``outbox/`` to route each
+result back to the connection that asked — after settling its priced
+capacity, which is the moment a deferred request gets retried.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import os
+import threading
+from collections import deque
+from typing import Any
+
+from qba_tpu.serve.fleet.admission import ADMIT, DEFER, AdmissionController
+from qba_tpu.serve.queuefs import drop_request, queue_paths, result_path
+from qba_tpu.serve.request import EvalRequest, EvalResult
+
+
+class FleetFrontend:
+    """One listening socket bridging clients to the shared queue."""
+
+    def __init__(
+        self,
+        queue_dir: str,
+        admission: AdmissionController | None = None,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        poll_s: float = 0.02,
+        request_prefix: str = "fl",
+        max_requests: int | None = None,
+    ) -> None:
+        self.queue_dir = queue_dir
+        self.paths = queue_paths(queue_dir)
+        os.makedirs(self.paths["inbox"], exist_ok=True)
+        os.makedirs(self.paths["outbox"], exist_ok=True)
+        self.admission = admission
+        self.host = host
+        self.port = port  # 0 = ephemeral; replaced by the bound port
+        self.poll_s = poll_s
+        self.max_requests = max_requests
+        self._ids = itertools.count()
+        self._prefix = request_prefix
+        self._futures: dict[str, asyncio.Future] = {}
+        self._admitted: dict[str, dict[str, Any]] = {}  # rid -> decision json
+        self._deferred: deque[EvalRequest] = deque()
+        self.requests_seen = 0  # valid requests accepted off sockets
+        self.results_forwarded = 0
+        self._release = asyncio.Event()
+        self._done = asyncio.Event()
+        self._server: asyncio.AbstractServer | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._connections: set[asyncio.Task] = set()
+        # Thread-mode plumbing (start_in_thread/stop_in_thread).
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+
+    # ---- lifecycle ---------------------------------------------------
+    async def start(self) -> None:
+        """Bind the socket and start the outbox/admission pollers;
+        ``self.port`` holds the actual port after this returns."""
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._tasks = [
+            asyncio.ensure_future(self._watch_outbox()),
+            asyncio.ensure_future(self._retry_deferred()),
+        ]
+
+    async def serve_until_done(self) -> None:
+        """Run until :meth:`request_stop` (or ``max_requests`` requests
+        have been fully answered), then shut down cleanly."""
+        if self._server is None:
+            await self.start()
+        await self._done.wait()
+        await self._shutdown()
+
+    def request_stop(self) -> None:
+        self._done.set()
+
+    async def _shutdown(self) -> None:
+        assert self._server is not None
+        self._server.close()
+        await self._server.wait_closed()
+        # Let in-flight connection handlers finish writing their last
+        # results (wait_closed does not wait for handler coroutines).
+        if self._connections:
+            await asyncio.wait(self._connections, timeout=30)
+        for t in [*self._tasks, *self._connections]:
+            t.cancel()
+        for t in [*self._tasks, *self._connections]:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    def run(self) -> None:
+        """Blocking convenience: serve on a fresh event loop."""
+        asyncio.run(self.serve_until_done())
+
+    def start_in_thread(self) -> int:
+        """Run the front-end on a daemon thread; returns the bound port
+        once the socket is listening (for in-process drivers: tests and
+        examples/load_gen.py)."""
+        ready = threading.Event()
+
+        def _main() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            loop.run_until_complete(self.start())
+            ready.set()
+            loop.run_until_complete(self.serve_until_done())
+            loop.close()
+
+        self._thread = threading.Thread(target=_main, daemon=True)
+        self._thread.start()
+        if not ready.wait(timeout=60):
+            raise RuntimeError("fleet frontend failed to start listening")
+        return self.port
+
+    def stop_in_thread(self, timeout_s: float = 60.0) -> None:
+        if self._loop is not None and self._thread is not None:
+            try:
+                self._loop.call_soon_threadsafe(self._done.set)
+            except RuntimeError:
+                pass  # loop already closed: max_requests ended the serve
+            self._thread.join(timeout=timeout_s)
+
+    # ---- request intake ----------------------------------------------
+    def _assign_id(self) -> str:
+        while True:
+            rid = f"{self._prefix}{next(self._ids):05d}"
+            if rid not in self._futures:
+                return rid
+
+    def _intake(self, payload: dict[str, Any]) -> tuple[str, asyncio.Future]:
+        """Admit one decoded request payload; always returns a future
+        that resolves to the result JSON (rejections resolve it
+        immediately)."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        rid = str(payload.get("request_id") or self._assign_id())
+        payload = {**payload, "request_id": rid}
+        if rid in self._futures:
+            fut.set_result(
+                EvalResult.failure(
+                    rid, f"request id already pending: {rid!r}"
+                ).to_json()
+            )
+            return rid, fut
+        try:
+            req = EvalRequest.from_json(payload)
+        except (ValueError, TypeError) as e:
+            fut.set_result(EvalResult.failure(rid, str(e)).to_json())
+            return rid, fut
+        self.requests_seen += 1
+        if self.admission is None:
+            self._futures[rid] = fut
+            drop_request(self.paths["inbox"], req.to_json(), rid)
+            self._maybe_close_intake()
+            return rid, fut
+        decision = self.admission.try_admit(req)
+        if decision.action == ADMIT:
+            self._futures[rid] = fut
+            self._admitted[rid] = decision.to_json()
+            drop_request(self.paths["inbox"], req.to_json(), rid)
+        elif decision.action == DEFER:
+            self._futures[rid] = fut
+            self._admitted[rid] = decision.to_json()
+            self._deferred.append(req)
+        else:
+            res = EvalResult.failure(
+                rid, f"rejected: {decision.reason} ({decision.detail})"
+            )
+            res.admission = decision.to_json()
+            fut.set_result(res.to_json())
+        self._maybe_close_intake()
+        return rid, fut
+
+    def _maybe_close_intake(self) -> None:
+        if (
+            self.max_requests is not None
+            and self.requests_seen >= self.max_requests
+            and not self._futures
+            and not self._deferred
+        ):
+            self._done.set()
+
+    # ---- background pollers ------------------------------------------
+    async def _watch_outbox(self) -> None:
+        """Route finished results from the outbox back to their
+        callers, settling priced capacity as they land."""
+        while True:
+            landed = []
+            for rid in list(self._futures):
+                path = result_path(self.paths["outbox"], rid)
+                if not os.path.exists(path):
+                    continue
+                try:
+                    with open(path) as f:
+                        payload = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue  # racing the writer's rename; next poll wins
+                fut = self._futures.pop(rid, None)
+                if fut is None or fut.done():
+                    continue
+                decision = self._admitted.pop(rid, None)
+                if decision is not None:
+                    payload["admission"] = decision
+                if self.admission is not None:
+                    self.admission.settle(rid, payload.get("n_trials"))
+                    self._release.set()
+                self.results_forwarded += 1
+                fut.set_result(payload)
+                landed.append(rid)
+            if landed:
+                self._maybe_close_intake()
+            await asyncio.sleep(self.poll_s)
+
+    async def _retry_deferred(self) -> None:
+        """Re-run admission for deferred requests (FIFO, head-of-line)
+        every time a settle releases capacity."""
+        while True:
+            await self._release.wait()
+            self._release.clear()
+            while self._deferred and self.admission is not None:
+                req = self._deferred[0]
+                decision = self.admission.try_admit(req)
+                if decision.action == DEFER:
+                    break
+                self._deferred.popleft()
+                rid = req.request_id
+                self._admitted[rid] = decision.to_json()
+                if decision.action == ADMIT:
+                    drop_request(self.paths["inbox"], req.to_json(), rid)
+                else:  # became unservable — resolve the waiting future
+                    fut = self._futures.pop(rid, None)
+                    self._admitted.pop(rid, None)
+                    if fut is not None and not fut.done():
+                        res = EvalResult.failure(
+                            rid,
+                            f"rejected: {decision.reason} ({decision.detail})",
+                        )
+                        res.admission = decision.to_json()
+                        fut.set_result(res.to_json())
+            self._maybe_close_intake()
+
+    # ---- connection handling -----------------------------------------
+    async def _handle_connection(self, reader, writer) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._connections.add(task)
+            task.add_done_callback(self._connections.discard)
+        try:
+            first = await reader.readline()
+            if not first:
+                return
+            head = first.decode("utf-8", "replace")
+            if head.split(" ", 1)[0] in ("GET", "POST", "PUT"):
+                await self._handle_http(head, reader, writer)
+            else:
+                await self._handle_jsonl(head, reader, writer)
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _handle_jsonl(self, first_line: str, reader, writer) -> None:
+        """Raw JSONL: results stream back in completion order."""
+        lock = asyncio.Lock()  # serialize concurrent result writes
+        pending: list[asyncio.Task] = []
+
+        async def forward(fut: asyncio.Future) -> None:
+            payload = await fut
+            async with lock:
+                writer.write((json.dumps(payload) + "\n").encode())
+                await writer.drain()
+
+        async def take(raw: str) -> None:
+            raw = raw.strip()
+            if not raw:
+                return
+            try:
+                payload = json.loads(raw)
+                if not isinstance(payload, dict):
+                    raise ValueError(
+                        f"request must be a JSON object, got {payload!r:.80}"
+                    )
+            except (json.JSONDecodeError, ValueError) as e:
+                fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                fut.set_result(
+                    EvalResult.failure("<undecoded>", str(e)).to_json()
+                )
+            else:
+                _, fut = self._intake(payload)
+            pending.append(asyncio.ensure_future(forward(fut)))
+
+        await take(first_line)
+        while True:
+            line = await reader.readline()
+            if not line:
+                break
+            await take(line.decode("utf-8", "replace"))
+        if pending:
+            await asyncio.gather(*pending)
+
+    async def _handle_http(self, request_line: str, reader, writer) -> None:
+        """Minimal HTTP: ``GET`` -> status JSON; ``POST`` (JSONL body)
+        -> 200 with one result line per request."""
+        method = request_line.split(" ", 1)[0]
+        length = 0
+        while True:
+            line = await reader.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            name, _, value = line.decode("utf-8", "replace").partition(":")
+            if name.strip().lower() == "content-length":
+                try:
+                    length = int(value.strip())
+                except ValueError:
+                    pass
+        if method == "GET":
+            body = json.dumps(self.status(), default=str).encode()
+        else:
+            raw = await reader.readexactly(length) if length else b""
+            futs = []
+            for line_text in raw.decode("utf-8", "replace").splitlines():
+                if not line_text.strip():
+                    continue
+                try:
+                    payload = json.loads(line_text)
+                    if not isinstance(payload, dict):
+                        raise ValueError("request must be a JSON object")
+                except (json.JSONDecodeError, ValueError) as e:
+                    fut: asyncio.Future = (
+                        asyncio.get_running_loop().create_future()
+                    )
+                    fut.set_result(
+                        EvalResult.failure("<undecoded>", str(e)).to_json()
+                    )
+                    futs.append(fut)
+                else:
+                    futs.append(self._intake(payload)[1])
+            results = await asyncio.gather(*futs) if futs else []
+            body = "".join(json.dumps(r) + "\n" for r in results).encode()
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+            b"Connection: close\r\n\r\n" + body
+        )
+        await writer.drain()
+
+    # ---- reporting ---------------------------------------------------
+    def status(self) -> dict[str, Any]:
+        return {
+            "requests_seen": self.requests_seen,
+            "results_forwarded": self.results_forwarded,
+            "pending": len(self._futures),
+            "deferred": len(self._deferred),
+            "admission": (
+                self.admission.summary() if self.admission is not None else None
+            ),
+        }

@@ -1,0 +1,254 @@
+"""The replica pool: N worker processes over one shared file queue.
+
+Each replica is a separate OS process running the *existing*, proven
+serve loop — ``qba-tpu serve --transport file-queue --replica-id rK``
+(:func:`qba_tpu.serve.transport.serve_file_queue` driving a
+:class:`~qba_tpu.serve.engine.QBAServer`) — against the shared queue
+directory.  The pool process itself never touches a device; all the
+multi-process machinery rides on protocols that already exist:
+
+* **work distribution** — the inbox claim is an atomic rename, so N
+  pollers never double-serve a request;
+* **fault story** — a replica killed mid-request leaves a stale claim
+  that any *surviving* replica reclaims (``--reclaim-timeout-s``), so
+  ``kill -9`` loses zero requests (tests/test_fleet.py, CI fleet job);
+* **warm start** — every replica boots from the shared cache dir; the
+  artifact lock (:mod:`qba_tpu.serve.persist`) keeps concurrent
+  save/load merges torn-free, and the merged union makes the second
+  fleet boot zero-probe on all replicas;
+* **device placement** — per-replica environment: on CPU each worker
+  is its own jax process; on TPU ``make_device_env`` pins replica K to
+  chip K (``TPU_VISIBLE_CHIPS``) so the pool is a dp slice of the
+  8-device mesh, one chip per worker, no mesh config needed.
+
+The pool writes ``replicas.json`` (pids + env) into the queue dir so
+out-of-process chaos drivers (examples/load_gen.py ``--chaos-kill``)
+can pick a victim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any
+
+from qba_tpu.serve.queuefs import queue_paths, request_slug, write_json_atomic
+
+
+def make_device_env(index: int, platform: str | None = None) -> dict[str, str]:
+    """Per-replica environment overrides pinning worker ``index`` to
+    one device.  CPU (the CI backend): nothing to pin — each process
+    has its own host device.  TPU: ``TPU_VISIBLE_CHIPS`` restricts the
+    worker to chip ``index`` and the process-bounds vars tell the
+    runtime it owns a 1-chip slice (the standard single-host
+    multi-process carve-up)."""
+    platform = platform or os.environ.get("JAX_PLATFORMS", "")
+    env: dict[str, str] = {}
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    if "tpu" in platform:
+        env["TPU_VISIBLE_CHIPS"] = str(index)
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+        env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+    else:
+        # One replica ~= one core: cap XLA's CPU intra-op thread pool
+        # so N replicas scale on an N-core host instead of N full-size
+        # thread pools fighting over it — the dp-slice analogue of the
+        # one-chip-per-worker TPU carve-up above.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "intra_op_parallelism_threads" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_cpu_multi_thread_eigen=false"
+                " intra_op_parallelism_threads=1"
+            ).strip()
+    return env
+
+
+@dataclasses.dataclass
+class Replica:
+    """One pool worker: its id, process handle, and pinned env."""
+
+    replica_id: str
+    proc: subprocess.Popen
+    env: dict[str, str]
+    returncode: int | None = None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class ReplicaPool:
+    """Spawn, watch, kill, and stop N serve workers on one queue dir."""
+
+    def __init__(
+        self,
+        queue_dir: str,
+        *,
+        replicas: int = 2,
+        chunk_trials: int = 64,
+        depth: int = 2,
+        cache_dir: str | None = None,
+        telemetry_dir: str | None = None,
+        deadline_s: float | None = None,
+        reclaim_timeout_s: float | None = 5.0,
+        max_reclaims: int = 3,
+        poll_s: float = 0.05,
+        platform: str | None = None,
+        python: str | None = None,
+    ) -> None:
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.queue_dir = queue_dir
+        self.n_replicas = replicas
+        self.chunk_trials = chunk_trials
+        self.depth = depth
+        self.cache_dir = cache_dir
+        self.telemetry_dir = telemetry_dir
+        self.deadline_s = deadline_s
+        self.reclaim_timeout_s = reclaim_timeout_s
+        self.max_reclaims = max_reclaims
+        self.poll_s = poll_s
+        self.platform = platform
+        self.python = python or sys.executable
+        self.replicas: list[Replica] = []
+        self.restarted: list[str] = []
+
+    def worker_argv(self, replica_id: str) -> list[str]:
+        """The exact serve invocation a replica runs — the file-queue
+        loop whose dispatch ordering check_serve_dispatch proves; the
+        pool adds no dispatch path of its own."""
+        argv = [
+            self.python, "-m", "qba_tpu", "serve",
+            "--transport", "file-queue",
+            "--queue-dir", self.queue_dir,
+            "--replica-id", replica_id,
+            "--chunk-trials", str(self.chunk_trials),
+            "--depth", str(self.depth),
+            "--poll-s", str(self.poll_s),
+            "--max-reclaims", str(self.max_reclaims),
+        ]
+        if self.reclaim_timeout_s is not None:
+            argv += ["--reclaim-timeout-s", str(self.reclaim_timeout_s)]
+        if self.cache_dir is not None:
+            argv += ["--cache-dir", self.cache_dir]
+        if self.telemetry_dir is not None:
+            argv += ["--telemetry", self.telemetry_dir]
+        if self.deadline_s is not None:
+            argv += ["--deadline-s", str(self.deadline_s)]
+        return argv
+
+    def _spawn(self, index: int) -> Replica:
+        replica_id = f"r{index}"
+        overrides = make_device_env(index, self.platform)
+        env = {**os.environ, **overrides}
+        # Workers run `-m qba_tpu` from whatever cwd the pool owner has;
+        # make the package importable even when it isn't installed and
+        # the cwd is not the repo root.
+        import qba_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(qba_tpu.__file__)))
+        parts = env.get("PYTHONPATH", "").split(os.pathsep)
+        if pkg_root not in parts:
+            env["PYTHONPATH"] = os.pathsep.join([pkg_root] + [p for p in parts if p])
+        proc = subprocess.Popen(self.worker_argv(replica_id), env=env)
+        return Replica(replica_id=replica_id, proc=proc, env=overrides)
+
+    def start(self) -> list[str]:
+        """Boot every replica; returns their ids.  Boot order is not
+        serialized — the plans.json artifact lock makes concurrent
+        warm starts safe."""
+        if self.replicas:
+            raise RuntimeError("pool already started")
+        os.makedirs(self.queue_dir, exist_ok=True)
+        self.replicas = [self._spawn(i) for i in range(self.n_replicas)]
+        self._write_state()
+        return [r.replica_id for r in self.replicas]
+
+    def _write_state(self) -> None:
+        write_json_atomic(
+            os.path.join(self.queue_dir, "replicas.json"),
+            {
+                "replicas": [
+                    {
+                        "replica_id": r.replica_id,
+                        "pid": r.proc.pid,
+                        "alive": r.alive,
+                        "env": r.env,
+                    }
+                    for r in self.replicas
+                ],
+                "restarted": self.restarted,
+            },
+        )
+
+    def alive(self) -> list[str]:
+        return [r.replica_id for r in self.replicas if r.alive]
+
+    def kill(self, replica_id: str, sig: int = signal.SIGKILL) -> int:
+        """Chaos hook: send ``sig`` (default ``kill -9``) to one
+        replica; returns its pid.  The victim's in-flight claims are
+        reclaimed by the survivors."""
+        for r in self.replicas:
+            if r.replica_id == replica_id and r.alive:
+                r.proc.send_signal(sig)
+                r.proc.wait(timeout=60)
+                r.returncode = r.proc.returncode
+                self._write_state()
+                return r.proc.pid
+        raise ValueError(f"no live replica {replica_id!r}")
+
+    def respawn_dead(self) -> list[str]:
+        """Replace every dead replica with a fresh process under the
+        same id/env slot (the supervision loop for long-lived fleets;
+        chaos tests leave this off to prove reclaim alone suffices)."""
+        respawned = []
+        for i, r in enumerate(self.replicas):
+            if not r.alive and r.replica_id not in respawned:
+                self.replicas[i] = self._spawn(i)
+                self.restarted.append(r.replica_id)
+                respawned.append(r.replica_id)
+        if respawned:
+            self._write_state()
+        return respawned
+
+    def stop(self, timeout_s: float = 300.0) -> dict[str, int | None]:
+        """Drop the stop sentinel and wait for every live replica to
+        drain and exit; returns ``{replica_id: returncode}``."""
+        paths = queue_paths(self.queue_dir)
+        with open(paths["stop"], "w"):
+            pass
+        deadline = time.monotonic() + timeout_s
+        codes: dict[str, int | None] = {}
+        for r in self.replicas:
+            budget = max(0.1, deadline - time.monotonic())
+            try:
+                r.proc.wait(timeout=budget)
+            except subprocess.TimeoutExpired:
+                r.proc.kill()
+                r.proc.wait(timeout=30)
+            codes[r.replica_id] = r.proc.returncode
+        self._write_state()
+        return codes
+
+    def summaries(self) -> dict[str, dict[str, Any]]:
+        """Per-replica exit summaries (``summary-<id>.json`` written by
+        each worker's serve loop)."""
+        out: dict[str, dict[str, Any]] = {}
+        for r in self.replicas:
+            path = os.path.join(
+                self.queue_dir,
+                f"summary-{request_slug(r.replica_id)}.json",
+            )
+            try:
+                with open(path) as f:
+                    out[r.replica_id] = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+        return out

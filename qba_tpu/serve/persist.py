@@ -16,16 +16,27 @@ shape the server has dispatched, so ``qba-tpu lint --saved-plans`` can
 re-trace those exact engine builds through the KI-1/KI-2/KI-3 gates —
 plans loaded from disk get the same machine-checked guarantees as
 freshly probed ones (:func:`qba_tpu.analysis.driver.saved_plan_configs`).
+
+Concurrency contract (the fleet replica pool shares ONE cache dir):
+every read and write happens under an advisory ``flock`` on
+``plans.json.lock``, writes go through a writer-unique temp file +
+``os.replace``, and a save MERGES with the artifact already on disk
+(union of resolver entries and config shapes, local entries winning)
+instead of clobbering it — so N replicas flushing concurrently can
+never tear the file or drop each other's plans, and the union is what
+makes the *second* fleet boot zero-probe on every replica.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import fcntl
 import json
 import os
-from typing import Any
+from typing import Any, Iterator
 
-from qba_tpu.compile_cache import plans_path
+from qba_tpu.compile_cache import plans_lock_path, plans_path
 from qba_tpu.config import QBAConfig
 
 PLANS_SCHEMA = "qba-tpu/saved-plans/v1"
@@ -48,29 +59,111 @@ def plan_config_entry(cfg: QBAConfig) -> dict[str, Any]:
     return entry
 
 
+@contextlib.contextmanager
+def plans_lock(cache_dir: str | None) -> Iterator[None]:
+    """Exclusive advisory lock over the ``plans.json`` artifact.
+
+    ``flock`` on a sidecar lock file (never on ``plans.json`` itself —
+    ``os.replace`` swaps the inode under concurrent writers, which
+    would silently unlock them).  Reentrancy is not needed: every
+    caller below takes the lock exactly once, at the top."""
+    lock_file = plans_lock_path(cache_dir)
+    os.makedirs(os.path.dirname(lock_file) or ".", exist_ok=True)
+    with open(lock_file, "a") as fh:
+        fcntl.flock(fh.fileno(), fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(fh.fileno(), fcntl.LOCK_UN)
+
+
+def _read_payload(path: str) -> dict[str, Any] | None:
+    """Best-effort read of an existing artifact (None on any defect)."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(payload, dict) or payload.get("schema") != PLANS_SCHEMA:
+        return None
+    return payload
+
+
+def _merge_states(
+    old: dict[str, Any] | None, new: dict[str, Any]
+) -> dict[str, Any]:
+    """Union two resolver-state snapshots, ``new`` entries winning.
+
+    Each section is a ``[[key, value], ...]`` list keyed by nested-list
+    resolver keys; the union is keyed on the JSON encoding of the key.
+    Snapshots from a different jax version/backend don't merge — their
+    verdicts would be rejected at import anyway."""
+    if (
+        not isinstance(old, dict)
+        or old.get("schema") != new.get("schema")
+        or old.get("jax_version") != new.get("jax_version")
+        or old.get("backend") != new.get("backend")
+    ):
+        return new
+
+    def union(a: list, b: list) -> list:
+        merged: dict[str, Any] = {}
+        for k, v in list(a) + list(b):
+            merged[json.dumps(k)] = [k, v]
+        return [merged[k] for k in sorted(merged)]
+
+    out = dict(new)
+    out["resolve"] = union(old.get("resolve", []), new.get("resolve", []))
+    out["variant"] = union(old.get("variant", []), new.get("variant", []))
+    probe = {}
+    for section in ("tiled", "rebuild", "fused", "mega"):
+        probe[section] = union(
+            old.get("probe", {}).get(section, []),
+            new.get("probe", {}).get(section, []),
+        )
+    out["probe"] = probe
+    return out
+
+
 def save_plans(
     cache_dir: str | None, configs: list[QBAConfig] | None = None
 ) -> str:
     """Write ``plans.json`` under ``cache_dir`` from the live resolver
-    caches.  Returns the path written."""
+    caches, merged with whatever is already on disk (lock + unique
+    temp + atomic rename: concurrent replica flushes interleave to the
+    union, never a torn or clobbered file).  Returns the path written."""
     from qba_tpu.ops.round_kernel_tiled import export_resolver_state
 
     path = plans_path(cache_dir)
+    state = export_resolver_state()
     seen: list[dict[str, Any]] = []
     for cfg in configs or []:
         entry = plan_config_entry(cfg)
         if entry not in seen:
             seen.append(entry)
-    payload = {
-        "schema": PLANS_SCHEMA,
-        "resolver_state": export_resolver_state(),
-        "configs": seen,
-    }
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=2, sort_keys=True)
-    os.replace(tmp, path)
+    with plans_lock(cache_dir):
+        prior = _read_payload(path)
+        if prior is not None:
+            state = _merge_states(prior.get("resolver_state"), state)
+            for entry in prior.get("configs", []):
+                if entry not in seen:
+                    seen.append(entry)
+        payload = {
+            "schema": PLANS_SCHEMA,
+            "resolver_state": state,
+            "configs": seen,
+        }
+        # Writer-unique temp name: two processes racing a shared
+        # ".tmp" would interleave writes into one file before the
+        # renames — pid-suffixing keeps every writer on its own inode.
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     return path
 
 
@@ -78,16 +171,15 @@ def load_plans(cache_dir: str | None) -> int:
     """Restore resolver caches from ``cache_dir``'s ``plans.json``.
     Returns the number of resolver entries restored (0 when the file is
     absent, unreadable, or from an incompatible build — warm start is
-    best-effort, a cold boot is always correct)."""
+    best-effort, a cold boot is always correct).  Reads under the
+    artifact lock so a replica booting mid-save of a peer waits for the
+    complete file instead of warm-starting from the stale one."""
     from qba_tpu.ops.round_kernel_tiled import import_resolver_state
 
     path = plans_path(cache_dir)
-    try:
-        with open(path) as f:
-            payload = json.load(f)
-    except (OSError, json.JSONDecodeError):
-        return 0
-    if not isinstance(payload, dict) or payload.get("schema") != PLANS_SCHEMA:
+    with plans_lock(cache_dir):
+        payload = _read_payload(path)
+    if payload is None:
         return 0
     state = payload.get("resolver_state")
     if not isinstance(state, dict):

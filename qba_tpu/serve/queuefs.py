@@ -1,0 +1,67 @@
+"""File-queue layout and atomic-write helpers (jax-free by design).
+
+The file-queue transport (:mod:`qba_tpu.serve.transport`) and the fleet
+front-end (:mod:`qba_tpu.serve.fleet.frontend`) share one on-disk
+protocol: requests are dropped into ``inbox/`` and claimed by atomic
+rename into ``claimed/``, results land in ``outbox/`` via temp-file +
+rename, and a ``stop`` sentinel triggers drain.  This module owns the
+path layout and the two atomicity helpers so both sides agree on them
+without the front-end importing the engine — the asyncio front-end
+must stay importable (and provably, see
+:func:`qba_tpu.analysis.transfers.check_fleet`) with no jax and no
+device values in the process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+def queue_paths(queue_dir: str) -> dict[str, str]:
+    return {
+        "inbox": os.path.join(queue_dir, "inbox"),
+        "claimed": os.path.join(queue_dir, "claimed"),
+        "done": os.path.join(queue_dir, "done"),
+        "dead": os.path.join(queue_dir, "dead"),
+        "outbox": os.path.join(queue_dir, "outbox"),
+        "stop": os.path.join(queue_dir, "stop"),
+        "summary": os.path.join(queue_dir, "summary.json"),
+    }
+
+
+def write_json_atomic(path: str, payload: dict[str, Any]) -> None:
+    """Temp-file + rename: a concurrent reader sees the old file or the
+    new one, never a partial write.  The temp name is writer-unique so
+    concurrent writers of the same path don't interleave into one temp
+    file before their renames."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, default=str)
+    os.replace(tmp, path)
+
+
+def request_slug(request_id: str) -> str:
+    """Filesystem-safe slug for a request id (shared by result files
+    and per-request telemetry directories)."""
+    return "".join(
+        c if c.isalnum() or c in "-_." else "_" for c in request_id
+    ) or "request"
+
+
+def result_path(outbox: str, request_id: str) -> str:
+    return os.path.join(outbox, request_slug(request_id) + ".json")
+
+
+def inbox_request_path(inbox: str, request_id: str) -> str:
+    return os.path.join(inbox, request_slug(request_id) + ".json")
+
+
+def drop_request(inbox: str, payload: dict[str, Any], request_id: str) -> str:
+    """Write one request file into the inbox atomically; returns the
+    path.  This is the producer half of the claim protocol — the
+    rename guarantees a consumer never reads partial JSON."""
+    path = inbox_request_path(inbox, request_id)
+    write_json_atomic(path, payload)
+    return path

@@ -146,6 +146,15 @@ class EvalResult:
     # trials actually executed (<= the requested budget).
     stop: dict[str, Any] | None = None
     ci: dict[str, Any] | None = None
+    # Fleet attribution (docs/SERVING.md "Fleet"): which pool replica
+    # served the request, and how long it sat in the shared queue
+    # before a worker claimed it — ``latency_s`` minus ``queue_wait_s``
+    # is the replica-side (dispatch + device + readback) share.
+    replica_id: str | None = None
+    queue_wait_s: float | None = None
+    # Typed admission decision (qba_tpu.serve.fleet.admission), attached
+    # by the front-end: action, reason, and the priced trial capacity.
+    admission: dict[str, Any] | None = None
 
     def to_json(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)

@@ -31,6 +31,12 @@ import time
 from typing import IO, Any, Iterable
 
 from qba_tpu.serve.engine import QBAServer
+from qba_tpu.serve.queuefs import (
+    queue_paths,
+    request_slug,
+    result_path as _result_path_for,
+    write_json_atomic,
+)
 from qba_tpu.serve.request import EvalResult, decode_request_line
 
 
@@ -77,30 +83,11 @@ def serve_jsonl(
     return server.stats()
 
 
-def queue_paths(queue_dir: str) -> dict[str, str]:
-    return {
-        "inbox": os.path.join(queue_dir, "inbox"),
-        "claimed": os.path.join(queue_dir, "claimed"),
-        "done": os.path.join(queue_dir, "done"),
-        "dead": os.path.join(queue_dir, "dead"),
-        "outbox": os.path.join(queue_dir, "outbox"),
-        "stop": os.path.join(queue_dir, "stop"),
-        "summary": os.path.join(queue_dir, "summary.json"),
-    }
-
-
-def _write_json(path: str, payload: dict[str, Any]) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=1, default=str)
-    os.replace(tmp, path)
-
-
-def _result_path(outbox: str, request_id: str) -> str:
-    slug = "".join(
-        c if c.isalnum() or c in "-_." else "_" for c in request_id
-    ) or "request"
-    return os.path.join(outbox, slug + ".json")
+# Queue layout + atomicity helpers live in the jax-free
+# qba_tpu.serve.queuefs so the fleet front-end shares them without
+# importing the engine; re-exported names keep existing callers working.
+_write_json = write_json_atomic
+_result_path = _result_path_for
 
 
 def _reclaim_stale(
@@ -220,7 +207,15 @@ def serve_file_queue(
             names = sorted(
                 n for n in os.listdir(paths["inbox"]) if n.endswith(".json")
             )
+            # Work-sharing watermark: one pipeline-full of queued trials
+            # per consumer.  Past it, serve what we hold before claiming
+            # more — the flush window is when peer replicas sharing this
+            # queue dir claim the rest of the inbox.  A lone consumer
+            # still drains everything, a watermark's worth at a time.
+            prefetch = max(1, server.depth) * server.scheduler.chunk_trials
             for name in names:
+                if server.backlog_trials >= prefetch:
+                    emit(server.flush())
                 claimed = os.path.join(paths["claimed"], name)
                 try:
                     os.replace(os.path.join(paths["inbox"], name), claimed)
@@ -228,9 +223,20 @@ def serve_file_queue(
                     continue  # another consumer claimed it
                 seen += 1
                 try:
+                    # The request file's mtime is its enqueue time
+                    # (producers write via temp + rename, and the
+                    # rename into claimed/ preserves it) — so claim
+                    # time minus mtime IS the queue wait, attributed
+                    # separately from device time on the result.
+                    try:
+                        queue_wait = max(
+                            0.0, time.time() - os.path.getmtime(claimed)
+                        )
+                    except OSError:
+                        queue_wait = None
                     with open(claimed) as f:
                         req = decode_request_line(f.read())
-                    server.submit(req)
+                    server.submit(req, queue_wait_s=queue_wait)
                 except ValueError as e:
                     emit([EvalResult.failure(os.path.splitext(name)[0], str(e))])
                     settle(name)
@@ -258,5 +264,14 @@ def _finish(
 ) -> dict[str, Any]:
     stats = server.stats()
     stats["reclaimed"] = reclaimed
-    _write_json(paths["summary"], stats)
+    path = paths["summary"]
+    if server.replica_id is not None:
+        # One summary file per replica: N pool workers sharing a queue
+        # directory must not clobber each other's exit summaries —
+        # fleet_summary() aggregates the per-replica files.
+        path = os.path.join(
+            os.path.dirname(path),
+            f"summary-{request_slug(server.replica_id)}.json",
+        )
+    _write_json(path, stats)
     return stats

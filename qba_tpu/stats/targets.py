@@ -18,10 +18,11 @@ constructs a fresh stopping rule per cell/request.
 from __future__ import annotations
 
 import dataclasses
+import math
 import re
 from typing import Any
 
-from qba_tpu.stats.sequential import SPRT, MixtureMartingaleCI
+from qba_tpu.stats.sequential import SPRT, MixtureMartingaleCI, _clip_p
 
 __all__ = ["Target", "parse_target"]
 
@@ -83,6 +84,44 @@ class Target:
         return MixtureMartingaleCI(
             confidence=self.confidence, target_width=self.width
         )
+
+    def planning_trials(self, budget: int) -> int:
+        """A-priori trial price of this target for capacity planning
+        (the fleet admission layer, docs/SERVING.md "Fleet").
+
+        Deterministic, pure arithmetic, and deliberately a *planning
+        estimate* rather than a guarantee — ``budget`` stays the hard
+        ceiling and early stops release the difference:
+
+        * ``decide`` — Wald's zero-drift expected-sample-size
+          approximation at the indifference boundary ``p = threshold``
+          (the worst case): ``E[N] ≈ -log_a · log_b / E[Z²]`` where
+          ``Z`` is the per-trial log-likelihood-ratio increment.
+        * ``ci_width`` — the anytime Hoeffding-style fixed point
+          ``n = (log(1/α) + log(n+1)) / (2 (w/2)²)`` for the mixture
+          sequence to reach half-width ``w/2``.
+        """
+        if budget < 1:
+            raise ValueError(f"budget must be >= 1, got {budget}")
+        alpha = 1.0 - self.confidence
+        if self.kind == "decide":
+            p0 = _clip_p(self.threshold - self.delta)
+            p1 = _clip_p(self.threshold + self.delta)
+            s = math.log(p1 / p0)
+            f = math.log((1.0 - p1) / (1.0 - p0))
+            log_a = math.log((1.0 - alpha) / alpha)
+            log_b = math.log(alpha / (1.0 - alpha))
+            p = self.threshold
+            second_moment = p * s * s + (1.0 - p) * f * f
+            expected = -log_a * log_b / second_moment
+        else:
+            half = self.width / 2.0
+            expected = 1.0
+            for _ in range(32):
+                expected = (
+                    math.log(1.0 / alpha) + math.log(expected + 1.0)
+                ) / (2.0 * half * half)
+        return max(1, min(budget, math.ceil(expected)))
 
     def to_json(self) -> dict[str, Any]:
         return {

@@ -1,0 +1,505 @@
+"""Fleet-subsystem tests (docs/SERVING.md "Fleet").
+
+Five contracts:
+
+* **Pricing determinism** — :meth:`Target.planning_trials` is pure
+  arithmetic: same target, same price; tighter precision prices more
+  trials; the request budget is a hard cap.
+* **Admission determinism** — the admit/defer/reject decision sequence
+  is a pure function of the request sequence and the settle points: a
+  fixed stream replayed through a fresh controller yields the
+  bit-identical decision list, with typed reasons.
+* **Attribution** — a result served through the file queue carries the
+  serving replica's id and its queue wait, in the wire result AND the
+  validated manifest, and each replica writes its own exit summary.
+* **Fleet bit-identity** — a request answered through the full socket
+  front-end + admission + file-queue worker stack equals a direct
+  single-process :func:`serve_batch` run trial for trial.
+* **Artifact merge** — concurrent-style saves to one ``plans.json``
+  union their resolver states and config shapes instead of clobbering
+  (the property that makes a shared warm-start artifact safe for N
+  replicas).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.serve import EvalRequest, QBAServer, serve_batch
+from qba_tpu.serve.fleet import (
+    ADMIT,
+    DEFER,
+    REASONS,
+    REJECT,
+    AdmissionController,
+    FleetFrontend,
+    ReplicaPool,
+    fleet_summary,
+    make_device_env,
+)
+from qba_tpu.serve.transport import serve_file_queue
+from qba_tpu.stats import parse_target
+
+
+def _req(rid, n=4, L=4, d=0, trials=4, seed=0, **kw):
+    return EvalRequest(
+        request_id=rid, n_parties=n, size_l=L, n_dishonest=d,
+        trials=trials, seed=seed, **kw,
+    )
+
+
+# ---- pricing -----------------------------------------------------------
+
+
+def test_planning_trials_deterministic_and_budget_capped():
+    t = parse_target("decide vs 1/3")
+    assert t.planning_trials(10_000) == t.planning_trials(10_000)
+    # The Wald bound at the 1/3 boundary with default delta/confidence
+    # is a few hundred trials — well under a 10k budget, over a 10-trial
+    # one (the budget is a hard cap, and the floor is one trial).
+    price = t.planning_trials(10_000)
+    assert 10 < price < 10_000
+    assert t.planning_trials(10) == 10
+    assert t.planning_trials(1) == 1
+    with pytest.raises(ValueError):
+        t.planning_trials(0)
+
+
+def test_planning_trials_monotone_in_precision():
+    loose = parse_target("decide vs 1/3 +-0.1").planning_trials(10**6)
+    tight = parse_target("decide vs 1/3 +-0.02").planning_trials(10**6)
+    assert tight > loose
+    wide = parse_target("ci_width<=0.1").planning_trials(10**7)
+    narrow = parse_target("ci_width<=0.01").planning_trials(10**7)
+    assert narrow > wide
+    # Higher confidence prices more trials too.
+    p95 = parse_target("ci_width<=0.05 @ 95%").planning_trials(10**7)
+    p99 = parse_target("ci_width<=0.05 @ 99%").planning_trials(10**7)
+    assert p99 > p95
+
+
+# ---- admission ---------------------------------------------------------
+
+
+def _decision_stream(ac):
+    """A fixed request sequence with a mid-stream settle; returns the
+    decision JSON list (capacity 16: chunk_trials=8 * window_chunks=2)."""
+    out = [
+        ac.try_admit(_req("A", trials=16)),   # admit, fills the window
+        ac.try_admit(_req("B", trials=8)),    # defer: window full
+        ac.try_admit(_req("C", trials=24)),   # reject: > whole window
+        ac.try_admit(_req("bad", n=0)),       # reject: invalid config
+    ]
+    ac.settle("A", executed_trials=16)
+    out.append(ac.try_admit(_req("B", trials=8)))  # now admits
+    return [d.to_json() for d in out]
+
+
+def _controller(**kw):
+    kw.setdefault("chunk_trials", 8)
+    kw.setdefault("replicas", 1)
+    kw.setdefault("window_chunks", 2)
+    return AdmissionController(**kw)
+
+
+def test_admission_decision_sequence_is_deterministic():
+    first = _decision_stream(_controller())
+    second = _decision_stream(_controller())
+    assert first == second  # pure function of stream + settle points
+    actions = [(d["action"], d["reason"]) for d in first]
+    assert actions == [
+        (ADMIT, "capacity_available"),
+        (DEFER, "window_full"),
+        (REJECT, "oversized_request"),
+        (REJECT, "invalid_request"),
+        (ADMIT, "capacity_available"),
+    ]
+    assert all(d["reason"] in REASONS for d in first)
+    # The ledger is visible in every decision: A's admit filled the
+    # 16-trial window; B's post-settle admit sees it drained.
+    assert first[0]["outstanding_trials"] == 16
+    assert first[-1]["outstanding_trials"] == 8
+
+
+def test_admission_prices_targets_below_budget():
+    ac = _controller(window_chunks=64)
+    dec = ac.try_admit(_req("T", trials=4096, target="decide vs 1/3"))
+    assert dec.action == ADMIT
+    # Chunk-quantized Wald price, not the full 4096-trial budget.
+    assert dec.priced_trials % 8 == 0
+    assert dec.priced_trials < 4096
+    untargeted = ac.try_admit(_req("U", trials=24))
+    assert untargeted.priced_trials == 24  # already chunk-aligned
+
+
+def test_admission_rejects_unservable_shape():
+    # With (essentially) no HBM the KI-2 ceiling is below one chunk:
+    # the shape can never execute, so it must be rejected up front —
+    # not parked in the queue to wedge a replica.
+    ac = _controller(hbm_bytes=1)
+    dec = ac.try_admit(_req("huge", trials=8))
+    assert (dec.action, dec.reason) == (REJECT, "unservable_shape")
+    assert ac.outstanding_trials == 0
+
+
+def test_admission_settle_is_idempotent_and_releases():
+    ac = _controller()
+    ac.try_admit(_req("A", trials=16))
+    assert ac.settle("A") == 16
+    assert ac.settle("A") == 0  # double-settle releases nothing
+    assert ac.settle("never-admitted") == 0
+    s = ac.summary()
+    assert s["released_trials"] == 16
+    assert s["outstanding_trials"] == 0
+    assert s["by_action"] == {ADMIT: 1}
+
+
+# ---- attribution through the file queue --------------------------------
+
+
+def _queue_dirs(tmp_path):
+    qdir = tmp_path / "q"
+    for d in ("inbox", "claimed", "done", "dead", "outbox"):
+        os.makedirs(qdir / d)
+    return qdir
+
+
+def test_result_and_manifest_carry_replica_and_queue_wait(tmp_path):
+    qdir = _queue_dirs(tmp_path)
+    req = _req("w0", trials=3, seed=5)
+    (qdir / "inbox" / "w0.json").write_text(json.dumps(req.to_json()))
+    server = QBAServer(chunk_trials=4, replica_id="r7")
+    stats = serve_file_queue(server, str(qdir), poll_s=0.01, max_requests=1)
+    res = json.loads((qdir / "outbox" / "w0.json").read_text())
+    assert res["error"] is None
+    assert res["replica_id"] == "r7"
+    assert res["queue_wait_s"] >= 0.0
+    # Attribution is in the validated manifest too, not just the wire.
+    assert res["manifest"]["replica_id"] == "r7"
+    assert res["manifest"]["queue_wait_s"] == res["queue_wait_s"]
+    # Per-replica exit summary: summary-<id>.json, never summary.json
+    # (N replicas share the queue dir and must not clobber each other).
+    assert stats["replica_id"] == "r7"
+    assert (qdir / "summary-r7.json").exists()
+    assert not (qdir / "summary.json").exists()
+    summary = json.loads((qdir / "summary-r7.json").read_text())
+    assert summary["replica_id"] == "r7"
+    assert summary["queue_wait"]["count"] == 1
+
+
+def test_queue_wait_summary_in_server_stats():
+    server = QBAServer(chunk_trials=4, replica_id="rq")
+    server.submit(_req("q0", trials=2), queue_wait_s=0.25)
+    server.flush()
+    stats = server.stats()
+    assert stats["replica_id"] == "rq"
+    assert stats["queue_wait"]["count"] == 1
+    assert stats["queue_wait"]["max_s"] == pytest.approx(0.25)
+
+
+# ---- the full stack: socket front-end + worker + bit-identity ----------
+
+
+def _worker(qdir, n_requests, replica_id="r0"):
+    server = QBAServer(chunk_trials=4, replica_id=replica_id)
+    return serve_file_queue(
+        server, str(qdir), poll_s=0.01, max_requests=n_requests
+    )
+
+
+def test_socket_frontend_end_to_end_with_admission(tmp_path):
+    qdir = tmp_path / "q"
+    ac = AdmissionController(chunk_trials=4, replicas=1, window_chunks=64)
+    fe = FleetFrontend(str(qdir), ac, poll_s=0.01, max_requests=3)
+    worker = threading.Thread(target=_worker, args=(qdir, 2), daemon=True)
+    worker.start()
+    port = fe.start_in_thread()
+    lines = [
+        json.dumps(_req("s1", trials=3, seed=5).to_json()),
+        json.dumps({"n_parties": 4, "size_l": 4, "trials": 2}),  # no id
+        "this is not json",
+        json.dumps({"request_id": "bad1", "n_parties": 0, "size_l": 4,
+                    "trials": 2}),
+    ]
+    conn = socket.create_connection(("127.0.0.1", port), timeout=120)
+    wire = conn.makefile("rw")
+    for line in lines:
+        wire.write(line + "\n")
+    wire.flush()
+    conn.shutdown(socket.SHUT_WR)
+    results = [json.loads(line) for line in wire if line.strip()]
+    fe.stop_in_thread()
+    worker.join(timeout=120)
+    assert len(results) == 4
+    by_id = {r["request_id"]: r for r in results}
+    # Valid request: served, admitted, attributed.
+    assert by_id["s1"]["error"] is None
+    assert by_id["s1"]["admission"]["action"] == ADMIT
+    assert by_id["s1"]["replica_id"] == "r0"
+    # Id-less request: the front-end assigned one.
+    assigned = [rid for rid in by_id if rid.startswith("fl")]
+    assert len(assigned) == 1 and by_id[assigned[0]]["error"] is None
+    # Malformed line: structured error, not a dropped connection.
+    assert "<undecoded>" in by_id
+    assert by_id["<undecoded>"]["error"]
+    # Invalid config: typed admission rejection, never hits the queue.
+    assert "invalid_request" in by_id["bad1"]["error"]
+    assert by_id["bad1"]["admission"]["reason"] == "invalid_request"
+    assert not os.path.exists(os.path.join(str(qdir), "inbox", "bad1.json"))
+    # Bit-identity: the served result equals a direct single-process
+    # serve_batch of the identical request.
+    direct = serve_batch(QBAServer(chunk_trials=4),
+                         [_req("s1", trials=3, seed=5)])[0]
+    assert by_id["s1"]["success"] == direct.success
+    assert by_id["s1"]["successes"] == direct.successes
+
+
+def test_http_get_status_and_post_jsonl(tmp_path):
+    qdir = tmp_path / "q"
+    fe = FleetFrontend(str(qdir), None, poll_s=0.01, max_requests=1)
+    worker = threading.Thread(target=_worker, args=(qdir, 1), daemon=True)
+    worker.start()
+    port = fe.start_in_thread()
+
+    def _http(raw: bytes) -> tuple[int, bytes]:
+        c = socket.create_connection(("127.0.0.1", port), timeout=120)
+        c.sendall(raw)
+        buf = b""
+        while True:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        c.close()
+        head, _, body = buf.partition(b"\r\n\r\n")
+        return int(head.split(b" ")[1]), body
+
+    code, body = _http(b"GET /status HTTP/1.1\r\nHost: x\r\n\r\n")
+    assert code == 200
+    status = json.loads(body)
+    assert status["requests_seen"] == 0 and status["admission"] is None
+
+    payload = (json.dumps(_req("h1", trials=2, seed=3).to_json()) + "\n").encode()
+    code, body = _http(
+        b"POST /eval HTTP/1.1\r\nHost: x\r\nContent-Length: "
+        + str(len(payload)).encode() + b"\r\n\r\n" + payload
+    )
+    assert code == 200
+    res = json.loads(body.splitlines()[0])
+    assert res["request_id"] == "h1" and res["error"] is None
+    assert res["replica_id"] == "r0"
+    fe.stop_in_thread()
+    worker.join(timeout=120)
+
+
+# ---- fleet summary -----------------------------------------------------
+
+
+def test_fleet_summary_aggregates_replicas_and_admission(tmp_path):
+    qdir = tmp_path / "q"
+    outbox = qdir / "outbox"
+    os.makedirs(outbox)
+    for i, (rid, rep) in enumerate(
+        [("a", "r0"), ("b", "r0"), ("c", "r1"), ("d", "r1"), ("e", "r1")]
+    ):
+        (outbox / f"{rid}.json").write_text(json.dumps({
+            "request_id": rid, "error": None, "latency_s": 0.1 * (i + 1),
+            "queue_wait_s": 0.01 * i, "replica_id": rep,
+        }))
+    (outbox / "err.json").write_text(json.dumps({
+        "request_id": "err", "error": "boom", "latency_s": None,
+    }))
+    (qdir / "summary-r0.json").write_text(json.dumps({
+        "replica_id": "r0", "completed": 2, "reclaimed": 3, "expired": 0,
+    }))
+    summary = fleet_summary(
+        str(qdir),
+        admission_summary={"decisions": 6},
+        elapsed_s=30.0,
+    )
+    assert summary["results"] == 6
+    assert summary["completed"] == 5 and summary["errored"] == 1
+    assert summary["replicas"]["r0"]["completed"] == 2
+    assert summary["replicas"]["r1"]["completed"] == 3
+    assert summary["replicas"]["r0"]["exit_summary"]["reclaimed"] == 3
+    assert summary["reclaimed"] == 3
+    assert summary["latency"]["count"] == 5
+    assert summary["latency"]["p50_s"] == pytest.approx(0.3)
+    assert summary["queue_wait"]["count"] == 5
+    assert summary["requests_per_min"] == pytest.approx(10.0)
+    assert summary["admission"] == {"decisions": 6}
+
+
+def test_spans_from_jsonl_round_trip(tmp_path):
+    from qba_tpu.obs.telemetry import SpanRecorder, spans_from_jsonl
+
+    rec = SpanRecorder()
+    with rec.span("request", cat="host", request_id="x", replica_id="r0"):
+        with rec.span("serve.dispatch"):
+            pass
+    path = tmp_path / "spans.jsonl"
+    rec.write_jsonl(str(path))
+    # A replica killed mid-write leaves a torn last line; the merge
+    # must skip it, not crash.
+    with open(path, "a") as f:
+        f.write('{"name": "torn", "t0_s": ')
+    spans = spans_from_jsonl(str(path))
+    assert [sp.name for sp in spans] == ["request", "serve.dispatch"]
+    assert spans[0].args["replica_id"] == "r0"
+    assert spans[0].dur == pytest.approx(rec.spans[0].dur)
+    assert spans_from_jsonl(str(tmp_path / "missing.jsonl")) == []
+
+
+# ---- shared-artifact merge (satellite: lockfile + atomic rename) -------
+
+
+def test_merge_states_unions_and_new_wins():
+    from qba_tpu.serve.persist import _merge_states
+
+    meta = {"schema": "s", "jax_version": "j", "backend": "cpu"}
+    old = {**meta, "resolve": [[["k1"], "old"], [["k2"], "old"]],
+           "variant": [], "probe": {"tiled": [[["t1"], 1]], "rebuild": [],
+                                    "fused": [], "mega": []}}
+    new = {**meta, "resolve": [[["k2"], "new"], [["k3"], "new"]],
+           "variant": [], "probe": {"tiled": [], "rebuild": [],
+                                    "fused": [], "mega": []}}
+    merged = _merge_states(old, new)
+    entries = dict((json.dumps(k), v) for k, v in merged["resolve"])
+    assert entries == {'["k1"]': "old", '["k2"]': "new", '["k3"]': "new"}
+    assert merged["probe"]["tiled"] == [[["t1"], 1]]
+    # Different jax build: no merge — import would reject it anyway.
+    stale = {**old, "jax_version": "other"}
+    assert _merge_states(stale, new) == new
+
+
+def test_save_plans_merges_configs_across_writers(tmp_path):
+    # Two sequential saves with disjoint config sets model two replicas
+    # flushing: the artifact must hold the union, not the last writer.
+    from qba_tpu.serve.persist import save_plans, saved_configs
+
+    cache = str(tmp_path / "cache")
+    cfg_a = QBAConfig(n_parties=4, size_l=4, trials=1)
+    cfg_b = QBAConfig(n_parties=5, size_l=4, trials=1)
+    save_plans(cache, [cfg_a])
+    path = save_plans(cache, [cfg_b])
+    got = {(c.n_parties, c.size_l) for c in saved_configs(path)}
+    assert got == {(4, 4), (5, 4)}
+    # Idempotent: re-saving the same shapes does not duplicate entries.
+    save_plans(cache, [cfg_a, cfg_b])
+    assert len(saved_configs(path)) == 2
+
+
+def test_plans_lock_is_exclusive(tmp_path):
+    from qba_tpu.serve.persist import plans_lock
+
+    cache = str(tmp_path / "cache")
+    order: list[str] = []
+
+    def hold():
+        with plans_lock(cache):
+            order.append("t-acquired")
+            time.sleep(0.3)
+            order.append("t-released")
+
+    t = threading.Thread(target=hold)
+    t.start()
+    time.sleep(0.1)  # let the thread take the lock first
+    with plans_lock(cache):
+        order.append("main-acquired")
+    t.join()
+    assert order == ["t-acquired", "t-released", "main-acquired"]
+
+
+# ---- pool plumbing (no subprocesses in tier-1) -------------------------
+
+
+def test_worker_argv_spawns_the_proven_serve_loop(tmp_path):
+    pool = ReplicaPool(str(tmp_path / "q"), replicas=2, chunk_trials=16,
+                       cache_dir="/c", reclaim_timeout_s=7.0)
+    argv = pool.worker_argv("r1")
+    # The pool adds no dispatch path of its own: workers run the stock
+    # file-queue serve loop (check_fleet proves this statically too).
+    assert "serve" in argv and "file-queue" in argv
+    assert argv[argv.index("--replica-id") + 1] == "r1"
+    assert argv[argv.index("--chunk-trials") + 1] == "16"
+    assert argv[argv.index("--reclaim-timeout-s") + 1] == "7.0"
+    assert argv[argv.index("--cache-dir") + 1] == "/c"
+
+
+def test_make_device_env_pins_tpu_chips():
+    cpu = make_device_env(3, "cpu")
+    assert cpu["JAX_PLATFORMS"] == "cpu"
+    # CPU replicas are capped to one intra-op thread (one replica ~=
+    # one core) so replica counts mean something on an N-core host.
+    assert "intra_op_parallelism_threads=1" in cpu["XLA_FLAGS"]
+    env = make_device_env(3, "tpu")
+    assert "XLA_FLAGS" not in env
+    assert env["TPU_VISIBLE_CHIPS"] == "3"
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+    assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+
+
+def test_check_fleet_is_clean_and_catches_violations(tmp_path):
+    from qba_tpu.analysis.transfers import check_fleet
+
+    assert check_fleet().findings == []
+    # A front half that imports jax or dispatches device work itself
+    # must be flagged.
+    bad = tmp_path / "fleet"
+    os.makedirs(bad)
+    (bad / "frontend.py").write_text(
+        "import jax\n\ndef f(cfg, keys):\n    return run_trials(cfg, keys)\n"
+    )
+    (bad / "pool.py").write_text("class ReplicaPool:\n    pass\n")
+    report = check_fleet(str(bad))
+    checks = {f.check for f in report.findings}
+    assert checks == {"fleet-front"}
+    messages = " ".join(f.message for f in report.findings)
+    assert "imports jax" in messages
+    assert "run_trials" in messages
+    assert "worker_argv" in messages
+
+
+@pytest.mark.slow
+def test_two_replica_pool_chaos_kill_loses_nothing(tmp_path):
+    """The CI fleet job's kill -9 story, in miniature: 2 subprocess
+    replicas, one SIGKILLed mid-stream, every request still answered."""
+    from qba_tpu.serve.queuefs import drop_request
+
+    qdir = str(tmp_path / "q")
+    pool = ReplicaPool(qdir, replicas=2, chunk_trials=4,
+                       reclaim_timeout_s=20.0, poll_s=0.02,
+                       cache_dir=str(tmp_path / "cache"))
+    pool.start()
+    reqs = [_req(f"k{i}", trials=3, seed=i) for i in range(8)]
+    inbox = os.path.join(qdir, "inbox")
+    os.makedirs(inbox, exist_ok=True)
+    for r in reqs:
+        drop_request(inbox, r.to_json(), r.request_id)
+    outbox = os.path.join(qdir, "outbox")
+    deadline = time.time() + 300
+    killed = False
+    while time.time() < deadline:
+        done = len(os.listdir(outbox)) if os.path.isdir(outbox) else 0
+        if not killed and done >= 2:
+            pool.kill(pool.alive()[-1])
+            killed = True
+        if done >= len(reqs):
+            break
+        time.sleep(0.1)
+    codes = pool.stop()
+    assert killed
+    results = {
+        name[:-5]: json.loads(open(os.path.join(outbox, name)).read())
+        for name in os.listdir(outbox)
+    }
+    assert set(results) == {r.request_id for r in reqs}  # zero lost
+    assert all(r["error"] is None for r in results.values())
+    assert -9 in codes.values() or any(
+        c != 0 for c in codes.values()
+    )  # the victim really died
