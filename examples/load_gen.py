@@ -170,13 +170,11 @@ def run_socket(args, stream):
             # Wait until the fleet is mid-stream, then SIGKILL one
             # replica; its unclaimed + in-flight work must be reclaimed
             # by the survivors (zero lost requests, asserted in main).
-            outbox = os.path.join(queue_dir, "outbox")
+            # Counted via the front-end (not the outbox listing: it
+            # moves forwarded results to consumed/ as they land).
             deadline = time.time() + args.timeout_s
             while time.time() < deadline:
-                landed = (
-                    len(os.listdir(outbox)) if os.path.isdir(outbox) else 0
-                )
-                if landed >= max(1, len(stream) // 4):
+                if frontend.results_forwarded >= max(1, len(stream) // 4):
                     break
                 time.sleep(0.05)
             victim = pool.alive()[-1]

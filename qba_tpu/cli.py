@@ -484,6 +484,13 @@ def _parser() -> argparse.ArgumentParser:
         help="worker inbox poll interval (the front-end outbox poll "
         "runs at a fixed 20ms)",
     )
+    fleet.add_argument(
+        "--platform", default=None,
+        help="jax platform for the workers (cpu/tpu); default: "
+        "JAX_PLATFORMS if set, else TPU hardware is auto-detected so "
+        "replicas get chip-pinned even where jax auto-initializes "
+        "TPU without any env var",
+    )
 
     study = sub.add_parser(
         "study", help="success-rate curve over a swept parameter"
@@ -1159,6 +1166,7 @@ def _cmd_fleet(args: argparse.Namespace, out) -> int:
         reclaim_timeout_s=args.reclaim_timeout_s,
         max_reclaims=args.max_reclaims,
         poll_s=args.poll_s,
+        platform=args.platform,
     )
     frontend = FleetFrontend(
         args.queue_dir,

@@ -29,7 +29,12 @@ from qba_tpu.serve.fleet.admission import (
     AdmissionDecision,
 )
 from qba_tpu.serve.fleet.frontend import FleetFrontend
-from qba_tpu.serve.fleet.pool import Replica, ReplicaPool, make_device_env
+from qba_tpu.serve.fleet.pool import (
+    Replica,
+    ReplicaPool,
+    make_device_env,
+    tpu_present,
+)
 from qba_tpu.serve.fleet.summary import (
     FLEET_SUMMARY_SCHEMA,
     fleet_summary,
@@ -48,6 +53,7 @@ __all__ = [
     "Replica",
     "ReplicaPool",
     "make_device_env",
+    "tpu_present",
     "FLEET_SUMMARY_SCHEMA",
     "fleet_summary",
     "merge_fleet_spans",

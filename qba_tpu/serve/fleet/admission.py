@@ -142,10 +142,19 @@ class AdmissionController:
         return self._ceilings[label]
 
     # ---- the decision ------------------------------------------------
-    def try_admit(self, req) -> AdmissionDecision:
+    def try_admit(self, req, *, record: bool = True) -> AdmissionDecision:
         """Price and decide one request.  ``admit`` records the price
         in the ledger (the caller MUST eventually :meth:`settle`);
-        ``defer`` and ``reject`` leave the ledger untouched."""
+        ``defer`` and ``reject`` leave the ledger untouched.
+
+        ``record=False`` keeps the decision out of :attr:`decisions`
+        (the ledger still mutates on admit) — the front-end's deferred
+        retry loop uses it so a still-full re-poll of the deferred
+        head doesn't append a DEFER per settle event: the decision
+        list stays a pure function of the request stream and settle
+        points, not of settle *timing*.  A retry that resolves
+        (admit/reject) is recorded by the caller via :meth:`record`.
+        """
         from qba_tpu.serve.scheduler import bucket_config, bucket_label
 
         rid = req.request_id
@@ -155,7 +164,7 @@ class AdmissionController:
             priced, detail = self.price(req)
         except ValueError as e:
             return self._decide(
-                REJECT, "invalid_request", rid, detail=str(e)
+                REJECT, "invalid_request", rid, detail=str(e), record=record
             )
         if ceiling < self.chunk_trials:
             return self._decide(
@@ -165,6 +174,7 @@ class AdmissionController:
                     f"{self.chunk_trials}: one device chunk of this shape "
                     "exhausts HBM"
                 ),
+                record=record,
             )
         if priced > self.capacity_trials:
             return self._decide(
@@ -173,6 +183,7 @@ class AdmissionController:
                     f"priced {priced} trials > fleet window "
                     f"{self.capacity_trials}: would wedge every other tenant"
                 ),
+                record=record,
             )
         if self.outstanding_trials + priced > self.capacity_trials:
             return self._decide(
@@ -181,12 +192,19 @@ class AdmissionController:
                     f"{self.outstanding_trials} trials outstanding; retry "
                     "after a release"
                 ),
+                record=record,
             )
         self._outstanding[rid] = priced
         return self._decide(
             ADMIT, "capacity_available", rid, bucket=label, priced=priced,
-            detail=detail,
+            detail=detail, record=record,
         )
+
+    def record(self, decision: AdmissionDecision) -> None:
+        """Append a decision obtained with ``try_admit(record=False)``
+        to the ledger — the retry loop's way of recording only the
+        final verdict of a deferred request, not every failed poll."""
+        self.decisions.append(decision)
 
     def settle(self, request_id: str, executed_trials: int | None = None) -> int:
         """Release a finished request's priced capacity; returns the
@@ -208,6 +226,7 @@ class AdmissionController:
         bucket: str = "",
         priced: int = 0,
         detail: str = "",
+        record: bool = True,
     ) -> AdmissionDecision:
         assert reason in REASONS, reason
         dec = AdmissionDecision(
@@ -220,7 +239,8 @@ class AdmissionController:
             capacity_trials=self.capacity_trials,
             detail=detail,
         )
-        self.decisions.append(dec)
+        if record:
+            self.decisions.append(dec)
         return dec
 
     def summary(self) -> dict[str, Any]:

@@ -53,6 +53,7 @@ class FleetFrontend:
         self.paths = queue_paths(queue_dir)
         os.makedirs(self.paths["inbox"], exist_ok=True)
         os.makedirs(self.paths["outbox"], exist_ok=True)
+        os.makedirs(self.paths["consumed"], exist_ok=True)
         self.admission = admission
         self.host = host
         self.port = port  # 0 = ephemeral; replaced by the bound port
@@ -151,7 +152,13 @@ class FleetFrontend:
     def _assign_id(self) -> str:
         while True:
             rid = f"{self._prefix}{next(self._ids):05d}"
-            if rid not in self._futures:
+            if rid not in self._futures and not os.path.exists(
+                result_path(self.paths["outbox"], rid)
+            ):
+                # The outbox check keeps a restarted front-end (whose
+                # counter restarts at fl00000 over the same queue dir)
+                # from watching a leftover result file and resolving a
+                # fresh request with a stale payload.
                 return rid
 
     def _intake(self, payload: dict[str, Any]) -> tuple[str, asyncio.Future]:
@@ -165,6 +172,20 @@ class FleetFrontend:
             fut.set_result(
                 EvalResult.failure(
                     rid, f"request id already pending: {rid!r}"
+                ).to_json()
+            )
+            return rid, fut
+        if os.path.exists(result_path(self.paths["outbox"], rid)):
+            # A leftover result under this id (client id reuse, or a
+            # previous fleet run over the same queue dir) would resolve
+            # this request instantly with the stale payload while the
+            # fresh one still executes — refuse instead.
+            fut.set_result(
+                EvalResult.failure(
+                    rid,
+                    f"request id {rid!r} already has a result in the "
+                    "outbox (id reuse over a live queue dir); pick a "
+                    "fresh id",
                 ).to_json()
             )
             return rid, fut
@@ -232,6 +253,22 @@ class FleetFrontend:
                     self._release.set()
                 self.results_forwarded += 1
                 fut.set_result(payload)
+                try:
+                    # Consume the result file: a forwarded result left
+                    # in outbox/ would answer a future request under a
+                    # reused id with this (by then stale) payload, and
+                    # the watch loop would keep rereading it.  Moved,
+                    # not deleted — fleet_summary() recomputes the
+                    # client-experienced latency/queue-wait
+                    # distributions from consumed/ + outbox/.
+                    os.replace(
+                        path,
+                        os.path.join(
+                            self.paths["consumed"], os.path.basename(path)
+                        ),
+                    )
+                except OSError:
+                    pass
                 landed.append(rid)
             if landed:
                 self._maybe_close_intake()
@@ -245,9 +282,15 @@ class FleetFrontend:
             self._release.clear()
             while self._deferred and self.admission is not None:
                 req = self._deferred[0]
-                decision = self.admission.try_admit(req)
+                # record=False: a still-full retry must not append a
+                # DEFER per settle event — the decision ledger stays a
+                # pure function of the request stream and settle
+                # points, not of settle timing.  Only the retry that
+                # resolves (admit or reject) is recorded.
+                decision = self.admission.try_admit(req, record=False)
                 if decision.action == DEFER:
                     break
+                self.admission.record(decision)
                 self._deferred.popleft()
                 rid = req.request_id
                 self._admitted[rid] = decision.to_json()

@@ -40,18 +40,54 @@ from typing import Any
 from qba_tpu.serve.queuefs import queue_paths, request_slug, write_json_atomic
 
 
+def tpu_present() -> bool:
+    """Best-effort, jax-free TPU detection for hosts where
+    ``JAX_PLATFORMS`` is unset (the common case — jax auto-detects the
+    platform, so operators rarely export it).  Checks the TPU runtime
+    env vars the launchers set, then the libtpu install, then the
+    accelerator device nodes.  Must never import jax: the pool process
+    stays device-free (:func:`qba_tpu.analysis.transfers.check_fleet`)."""
+    tpu_env = (
+        "TPU_ACCELERATOR_TYPE",
+        "TPU_WORKER_ID",
+        "TPU_WORKER_HOSTNAMES",
+        "CLOUD_TPU_TASK_ID",
+        "TPU_VISIBLE_CHIPS",
+    )
+    if any(os.environ.get(v) for v in tpu_env):
+        return True
+    try:
+        import importlib.util
+
+        if importlib.util.find_spec("libtpu") is not None:
+            return True
+    except (ImportError, ValueError):
+        pass
+    return any(
+        os.path.exists(p) for p in ("/dev/accel0", "/dev/vfio/0")
+    )
+
+
 def make_device_env(index: int, platform: str | None = None) -> dict[str, str]:
     """Per-replica environment overrides pinning worker ``index`` to
     one device.  CPU (the CI backend): nothing to pin — each process
     has its own host device.  TPU: ``TPU_VISIBLE_CHIPS`` restricts the
     worker to chip ``index`` and the process-bounds vars tell the
     runtime it owns a 1-chip slice (the standard single-host
-    multi-process carve-up)."""
+    multi-process carve-up).
+
+    With no explicit ``platform`` and no ``JAX_PLATFORMS`` in the
+    environment, TPU hardware is auto-detected (:func:`tpu_present`):
+    on a real TPU host jax auto-initializes TPU without any env var,
+    and falling into the CPU branch there would leave every replica
+    grabbing all chips (libtpu is single-process per chip, so replicas
+    2..N would die at startup) with CPU thread-cap flags to boot."""
     platform = platform or os.environ.get("JAX_PLATFORMS", "")
     env: dict[str, str] = {}
     if platform:
         env["JAX_PLATFORMS"] = platform
-    if "tpu" in platform:
+    on_tpu = "tpu" in platform or (not platform and tpu_present())
+    if on_tpu:
         env["TPU_VISIBLE_CHIPS"] = str(index)
         env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
         env["TPU_PROCESS_BOUNDS"] = "1,1,1"

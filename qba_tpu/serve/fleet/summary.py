@@ -31,21 +31,29 @@ from qba_tpu.serve.queuefs import queue_paths, write_json_atomic
 FLEET_SUMMARY_SCHEMA = "qba-tpu/fleet-summary/v1"
 
 
-def _load_results(outbox: str) -> list[dict[str, Any]]:
-    results = []
-    try:
-        names = sorted(os.listdir(outbox))
-    except OSError:
-        return results
-    for name in names:
-        if not name.endswith(".json"):
+def _load_results(outbox: str, consumed: str | None = None) -> list[dict[str, Any]]:
+    """All result payloads for one fleet run.  The front-end moves a
+    result from ``outbox/`` to ``consumed/`` once it is forwarded to
+    its caller, so both directories together are the run's results;
+    on a filename collision (a request id reused over a live queue
+    dir) the outbox copy — the newer, not-yet-forwarded one — wins."""
+    by_name: dict[str, dict[str, Any]] = {}
+    for directory in (consumed, outbox):
+        if directory is None:
             continue
         try:
-            with open(os.path.join(outbox, name)) as f:
-                results.append(json.load(f))
-        except (OSError, json.JSONDecodeError):
+            names = sorted(os.listdir(directory))
+        except OSError:
             continue
-    return results
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(directory, name)) as f:
+                    by_name[name] = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+    return [by_name[name] for name in sorted(by_name)]
 
 
 def _replica_summaries(queue_dir: str) -> dict[str, dict[str, Any]]:
@@ -108,7 +116,7 @@ def fleet_summary(
 ) -> dict[str, Any]:
     """Aggregate one fleet run's artifacts into a summary dict."""
     paths = queue_paths(queue_dir)
-    results = _load_results(paths["outbox"])
+    results = _load_results(paths["outbox"], paths["consumed"])
     ok = [r for r in results if not r.get("error")]
     per_replica: dict[str, dict[str, Any]] = {}
     for r in ok:

@@ -14,6 +14,7 @@ device values in the process.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Any
@@ -26,6 +27,7 @@ def queue_paths(queue_dir: str) -> dict[str, str]:
         "done": os.path.join(queue_dir, "done"),
         "dead": os.path.join(queue_dir, "dead"),
         "outbox": os.path.join(queue_dir, "outbox"),
+        "consumed": os.path.join(queue_dir, "consumed"),
         "stop": os.path.join(queue_dir, "stop"),
         "summary": os.path.join(queue_dir, "summary.json"),
     }
@@ -43,11 +45,25 @@ def write_json_atomic(path: str, payload: dict[str, Any]) -> None:
 
 
 def request_slug(request_id: str) -> str:
-    """Filesystem-safe slug for a request id (shared by result files
-    and per-request telemetry directories)."""
-    return "".join(
+    """Filesystem-safe **injective** slug for a request id (shared by
+    result files and per-request telemetry directories).
+
+    An id that is already filesystem-safe maps to itself; anything
+    else maps to its sanitized form plus a short hash of the raw id.
+    Injectivity matters because distinct client-supplied ids must
+    never share a queue filename — ``'a/b'`` and ``'a_b'`` colliding
+    would overwrite one request's inbox file with the other's and
+    resolve both pending futures from a single result.
+    """
+    safe = "".join(
         c if c.isalnum() or c in "-_." else "_" for c in request_id
-    ) or "request"
+    )
+    if safe == request_id and safe:
+        return safe
+    digest = hashlib.sha1(
+        request_id.encode("utf-8", "surrogatepass")
+    ).hexdigest()[:10]
+    return f"{safe or 'request'}-{digest}"
 
 
 def result_path(outbox: str, request_id: str) -> str:

@@ -109,6 +109,14 @@ def _reclaim_stale(
     and after ``max_reclaims`` attempts the file is dead-lettered to
     ``dead/`` with a structured error result in the outbox.  ``live``
     names this process's own in-progress claims, which are never stale.
+
+    Age is measured from the claim file's mtime, which every consumer
+    re-stamps to the claim instant right after the claim rename (the
+    rename alone would preserve the producer's enqueue-time mtime, and
+    inbox wait must not count toward claim staleness — N replicas
+    share this directory, and a backlogged request older than the
+    timeout would otherwise be stolen from its live claimant the
+    moment it was claimed).
     """
     reclaimed = 0
     now = time.time()
@@ -222,18 +230,29 @@ def serve_file_queue(
                 except OSError:
                     continue  # another consumer claimed it
                 seen += 1
+                # The request file's mtime is its enqueue time
+                # (producers write via temp + rename, and the rename
+                # into claimed/ preserves it) — so claim time minus
+                # mtime IS the queue wait, attributed separately from
+                # device time on the result.  Capture it, then stamp
+                # claim time onto the file: peers judge claim
+                # staleness by this same mtime, and without the
+                # re-stamp a request that waited longer than the
+                # reclaim timeout in the inbox would look stale the
+                # instant it was claimed and be stolen from its live
+                # claimant (re-executed, then dead-lettered).
+                claim_t = time.time()
                 try:
-                    # The request file's mtime is its enqueue time
-                    # (producers write via temp + rename, and the
-                    # rename into claimed/ preserves it) — so claim
-                    # time minus mtime IS the queue wait, attributed
-                    # separately from device time on the result.
-                    try:
-                        queue_wait = max(
-                            0.0, time.time() - os.path.getmtime(claimed)
-                        )
-                    except OSError:
-                        queue_wait = None
+                    queue_wait = max(
+                        0.0, claim_t - os.path.getmtime(claimed)
+                    )
+                except OSError:
+                    queue_wait = None
+                try:
+                    os.utime(claimed, (claim_t, claim_t))
+                except OSError:
+                    pass  # raced away; the eventual result still wins
+                try:
                     with open(claimed) as f:
                         req = decode_request_line(f.read())
                     server.submit(req, queue_wait_s=queue_wait)

@@ -147,6 +147,27 @@ def test_admission_rejects_unservable_shape():
     assert ac.outstanding_trials == 0
 
 
+def test_retry_defers_do_not_inflate_decision_ledger():
+    # The front-end's deferred-retry loop re-polls the deferred head on
+    # every settle event; those polls run with record=False so the
+    # decision list stays a pure function of the request stream and
+    # settle points — not of how many settles fired while a request
+    # waited.  Only the retry that resolves is recorded, via record().
+    ac = _controller()  # capacity 16
+    ac.try_admit(_req("A", trials=16))
+    assert ac.try_admit(_req("B", trials=8)).action == DEFER
+    for _ in range(5):
+        dec = ac.try_admit(_req("B", trials=8), record=False)
+        assert dec.action == DEFER
+    assert len(ac.decisions) == 2  # the admit + the one intake DEFER
+    ac.settle("A")
+    dec = ac.try_admit(_req("B", trials=8), record=False)
+    assert dec.action == ADMIT
+    ac.record(dec)
+    assert [d.action for d in ac.decisions] == [ADMIT, DEFER, ADMIT]
+    assert ac.outstanding_trials == 8  # record=False still prices admits
+
+
 def test_admission_settle_is_idempotent_and_releases():
     ac = _controller()
     ac.try_admit(_req("A", trials=16))
@@ -257,6 +278,38 @@ def test_socket_frontend_end_to_end_with_admission(tmp_path):
                          [_req("s1", trials=3, seed=5)])[0]
     assert by_id["s1"]["success"] == direct.success
     assert by_id["s1"]["successes"] == direct.successes
+    # Forwarded results are consumed out of outbox/ (bounded growth; a
+    # reused id can't resolve from a stale file) but still feed the
+    # fleet summary from consumed/.
+    assert os.listdir(qdir / "outbox") == []
+    assert len(os.listdir(qdir / "consumed")) == 2  # s1 + assigned id
+    assert fleet_summary(str(qdir))["completed"] == 2
+
+
+def test_frontend_refuses_id_with_leftover_result(tmp_path):
+    # A result file already sitting in outbox/ under an incoming id
+    # (client id reuse, or a front-end restarted over a live queue dir)
+    # must be refused at intake — resolving the new request from the
+    # stale payload while the fresh one executes is never acceptable.
+    qdir = tmp_path / "q"
+    os.makedirs(qdir / "outbox")
+    (qdir / "outbox" / "dup1.json").write_text(json.dumps(
+        {"request_id": "dup1", "error": None, "success": [True]}
+    ))
+    fe = FleetFrontend(str(qdir), None, poll_s=0.01)
+    port = fe.start_in_thread()
+    conn = socket.create_connection(("127.0.0.1", port), timeout=120)
+    wire = conn.makefile("rw")
+    wire.write(json.dumps(_req("dup1", trials=2).to_json()) + "\n")
+    wire.flush()
+    conn.shutdown(socket.SHUT_WR)
+    [res] = [json.loads(line) for line in wire if line.strip()]
+    fe.stop_in_thread()
+    assert res["request_id"] == "dup1"
+    assert "already has a result" in res["error"]
+    # The stale file was not consumed and nothing hit the queue.
+    assert (qdir / "outbox" / "dup1.json").exists()
+    assert not os.path.exists(qdir / "inbox" / "dup1.json")
 
 
 def test_http_get_status_and_post_jsonl(tmp_path):
@@ -442,6 +495,26 @@ def test_make_device_env_pins_tpu_chips():
     assert env["TPU_VISIBLE_CHIPS"] == "3"
     assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
     assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+
+
+def test_make_device_env_autodetects_tpu_hardware(monkeypatch):
+    from qba_tpu.serve.fleet import tpu_present
+
+    # JAX_PLATFORMS is commonly unset on TPU hosts (jax auto-detects):
+    # detection via the TPU runtime env vars must still pin chips, or
+    # every replica would grab all chips and replicas 2..N die at boot.
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    assert tpu_present()
+    env = make_device_env(2)
+    assert env["TPU_VISIBLE_CHIPS"] == "2"
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+    assert "XLA_FLAGS" not in env  # no CPU thread caps on TPU workers
+    assert "JAX_PLATFORMS" not in env  # keep jax's own auto-detection
+    # An explicit platform always beats detection.
+    cpu = make_device_env(2, "cpu")
+    assert "TPU_VISIBLE_CHIPS" not in cpu
+    assert cpu["JAX_PLATFORMS"] == "cpu"
 
 
 def test_check_fleet_is_clean_and_catches_violations(tmp_path):

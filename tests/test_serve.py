@@ -421,6 +421,55 @@ def test_poison_claim_dead_lettered_with_structured_error(tmp_path):
     assert not (qdir / "claimed" / "poison0.json").exists()
 
 
+def test_claim_restamps_mtime_so_peers_cannot_steal_backlog(tmp_path):
+    # The claim rename preserves the producer's mtime (enqueue time),
+    # which peer replicas would read as claim age: a request that
+    # waited longer than the reclaim timeout in a shared inbox would
+    # be "stale" the instant it was claimed and stolen from its live
+    # claimant.  The claimant therefore re-stamps the claim file's
+    # mtime to the claim instant — observable on the settled file in
+    # done/, whose rename preserves it — while still attributing the
+    # full enqueue-to-claim wait as queue_wait_s.
+    import os
+    import time
+
+    from qba_tpu.serve.transport import serve_file_queue
+
+    qdir = _queue_dirs(tmp_path)
+    req = _req("old0", trials=2)
+    path = qdir / "inbox" / "old0.json"
+    path.write_text(json.dumps(req.to_json()))
+    old = time.time() - 7200.0
+    os.utime(path, (old, old))
+    stats = serve_file_queue(
+        QBAServer(chunk_trials=4), str(qdir), poll_s=0.01,
+        max_requests=1, reclaim_timeout_s=5.0,
+    )
+    assert stats["reclaimed"] == 0
+    res = json.loads((qdir / "outbox" / "old0.json").read_text())
+    assert res["error"] is None
+    assert res["queue_wait_s"] > 7000.0  # wait measured from enqueue
+    # ...but the claim was re-stamped: its age never looked like 2h.
+    assert time.time() - os.path.getmtime(qdir / "done" / "old0.json") < 600.0
+
+
+def test_request_slug_is_injective_and_filesystem_safe():
+    from qba_tpu.serve.queuefs import request_slug
+
+    # Already-safe ids map to themselves (stable filenames everywhere).
+    assert request_slug("plain-id_0.7") == "plain-id_0.7"
+    assert request_slug("r7") == "r7"
+    # Mangled ids must not collide with each other or with safe ids:
+    # 'a/b' and 'a_b' sharing a filename would overwrite one request's
+    # inbox file and resolve both futures from a single result.
+    slugs = {request_slug(rid) for rid in ("a/b", "a:b", "a_b", "a.b")}
+    assert len(slugs) == 4
+    assert all("/" not in s and ":" not in s for s in slugs)
+    # Deterministic, and the empty id doesn't alias a literal one.
+    assert request_slug("a/b") == request_slug("a/b")
+    assert request_slug("") != request_slug("request")
+
+
 def test_reclaim_backoff_is_exponential(tmp_path):
     # k-th reclaim needs age >= timeout * 2**k: after one reclaim, a
     # claim of the same age is NOT immediately reclaimable again.
