@@ -17,9 +17,25 @@ With ``--transport socket`` the stream instead goes through the fleet
 stack (docs/SERVING.md "Fleet"): a socket front-end with target-aware
 admission feeding ``--replicas N`` worker processes over one shared
 queue, with per-replica attribution in the report and a
-``fleet_summary.json`` in the queue dir.  ``--chaos-kill`` additionally
-SIGKILLs one replica mid-stream and asserts zero lost requests — the
-survivors reclaim the victim's in-flight claims.
+``fleet_summary.json`` in the queue dir.
+
+Chaos modes (each asserts zero lost requests + bounded blast radius):
+
+* ``--chaos-kill`` — SIGKILL one replica mid-stream; the survivors
+  reclaim the victim's in-flight claims (no supervisor needed).
+* ``--chaos-hang`` — SIGSTOP one replica mid-stream (``--supervise``
+  required): the watchdog must classify it hung, SIGKILL it, and
+  release its in-flight request for a survivor within one watchdog
+  period.
+* ``--chaos-poison`` — inject a request that hard-crashes any worker
+  claiming it (the transport's test-only ``QBA_TEST_CRASH_HOOK``;
+  ``--supervise`` required): the supervisor must quarantine it after
+  at most 2 worker deaths, return a crash-report error for it, and
+  serve every other request cleanly.
+* ``--chaos-flap`` — SIGKILL the same replica repeatedly
+  (``--supervise`` required): the crash-loop breaker must bench the
+  slot and release its admission capacity while the survivor finishes
+  the stream.
 
 Usage:
     python examples/load_gen.py                     # subprocess server
@@ -27,6 +43,8 @@ Usage:
     python examples/load_gen.py --requests 60 --chunk-trials 16
     python examples/load_gen.py --transport socket --replicas 2
     python examples/load_gen.py --transport socket --replicas 2 --chaos-kill
+    python examples/load_gen.py --transport socket --replicas 2 \\
+        --supervise --chaos-poison
 """
 
 import argparse
@@ -47,6 +65,11 @@ BUCKETS = (
     dict(n_parties=5, size_l=8, n_dishonest=1),
     dict(n_parties=4, size_l=16, n_dishonest=2),
 )
+
+#: --chaos-poison marker: workers spawned with QBA_TEST_CRASH_HOOK set
+#: to this token hard-exit when they claim a request whose id contains
+#: it (qba_tpu.serve.transport.CRASH_HOOK_ENV).
+POISON_TOKEN = "poisonpill"
 
 
 def make_stream(n_requests: int, trials: int, target: str | None = None):
@@ -123,22 +146,49 @@ def run_subprocess(args, stream):
     return results, elapsed
 
 
+def _mid_stream(frontend, stream, timeout_s):
+    """Block until the fleet is mid-stream (a quarter of the results
+    forwarded) — counted via the front-end, not the outbox listing: it
+    moves forwarded results to consumed/ as they land."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if frontend.results_forwarded >= max(1, len(stream) // 4):
+            return
+        time.sleep(0.05)
+
+
 def run_socket(args, stream):
     """Drive the full fleet stack: socket front-end + admission +
-    ``--replicas`` worker processes on one shared queue dir."""
+    ``--replicas`` worker processes on one shared queue dir, optionally
+    supervised, optionally under chaos."""
+    import signal as signallib
     import socket as socketlib
+    import threading
 
     from qba_tpu.serve.fleet import (
         AdmissionController,
         FleetFrontend,
+        FleetSupervisor,
         ReplicaPool,
         fleet_summary,
         write_fleet_summary,
     )
+    from qba_tpu.serve.transport import CRASH_HOOK_ENV
 
-    if args.chaos_kill and args.replicas < 2:
-        raise SystemExit("--chaos-kill needs --replicas >= 2 (a survivor "
-                         "must reclaim the victim's claims)")
+    chaos = [
+        f for f in ("chaos_kill", "chaos_hang", "chaos_poison", "chaos_flap")
+        if getattr(args, f)
+    ]
+    if len(chaos) > 1:
+        raise SystemExit(f"pick one chaos mode, not {chaos}")
+    if chaos and args.replicas < 2:
+        raise SystemExit(f"--{chaos[0].replace('_', '-')} needs "
+                         "--replicas >= 2 (a survivor must finish the "
+                         "stream)")
+    if chaos and chaos[0] != "chaos_kill" and not args.supervise:
+        raise SystemExit(f"--{chaos[0].replace('_', '-')} needs "
+                         "--supervise (the supervisor IS the recovery "
+                         "path under test)")
     queue_dir = args.queue_dir or tempfile.mkdtemp(prefix="qba_fleet_")
     admission = AdmissionController(
         chunk_trials=args.chunk_trials, replicas=args.replicas
@@ -151,9 +201,38 @@ def run_socket(args, stream):
         telemetry_dir=args.telemetry,
         reclaim_timeout_s=args.reclaim_timeout_s,
         poll_s=0.02,
+        respawn_backoff_s=0.2,
     )
-    frontend = FleetFrontend(queue_dir, admission, max_requests=len(stream))
+    supervisor = None
+    sup_stop = threading.Event()
+    sup_thread = None
+    if args.supervise:
+        supervisor = FleetSupervisor(
+            pool,
+            admission=admission,
+            watchdog_s=args.watchdog_s,
+            breaker_k=3,
+            breaker_window_s=60.0,
+            poison_threshold=2,
+        )
+    frontend = FleetFrontend(
+        queue_dir,
+        admission,
+        max_requests=len(stream),
+        health_provider=supervisor.health if supervisor else None,
+    )
+    if args.chaos_poison:
+        # Workers inherit the environment at spawn: arm the test-only
+        # crash hook so claiming a poison-marked request kills them.
+        # Stays set until the run ends — the supervisor RESPAWNS dead
+        # workers mid-stream, and a respawn must be just as mortal.
+        os.environ[CRASH_HOOK_ENV] = POISON_TOKEN
     pool.start()
+    if supervisor is not None:
+        sup_thread = threading.Thread(
+            target=supervisor.run, args=(sup_stop, 0.2), daemon=True
+        )
+        sup_thread.start()
     t0 = time.perf_counter()
     results = []
     try:
@@ -167,26 +246,66 @@ def run_socket(args, stream):
         wire.flush()
         sock.shutdown(socketlib.SHUT_WR)
         if args.chaos_kill:
-            # Wait until the fleet is mid-stream, then SIGKILL one
-            # replica; its unclaimed + in-flight work must be reclaimed
-            # by the survivors (zero lost requests, asserted in main).
-            # Counted via the front-end (not the outbox listing: it
-            # moves forwarded results to consumed/ as they land).
-            deadline = time.time() + args.timeout_s
-            while time.time() < deadline:
-                if frontend.results_forwarded >= max(1, len(stream) // 4):
-                    break
-                time.sleep(0.05)
+            # SIGKILL one replica mid-stream; its unclaimed + in-flight
+            # work must be reclaimed by the survivors (zero lost
+            # requests, asserted in main).
+            _mid_stream(frontend, stream, args.timeout_s)
             victim = pool.alive()[-1]
             pid = pool.kill(victim)
             print(f"chaos: SIGKILL replica {victim} (pid {pid}); "
                   f"survivors {pool.alive()} reclaim its claims")
+        elif args.chaos_hang:
+            # SIGSTOP one replica mid-stream: it stays "alive" to the
+            # pool but its heartbeat goes stale — only the supervisor's
+            # watchdog can tell it from a busy worker.
+            _mid_stream(frontend, stream, args.timeout_s)
+            victim = next(
+                r for r in pool.replicas if r.replica_id == pool.alive()[-1]
+            )
+            os.kill(victim.proc.pid, signallib.SIGSTOP)
+            print(f"chaos: SIGSTOP replica {victim.replica_id} "
+                  f"(pid {victim.proc.pid}); the watchdog must kill it "
+                  "and re-serve its in-flight request")
+        elif args.chaos_flap:
+            # Kill the same slot repeatedly: the crash-loop breaker
+            # must bench it instead of respawning forever.
+            _mid_stream(frontend, stream, args.timeout_s)
+            victim = pool.alive()[-1]
+            deadline = time.time() + args.timeout_s
+            for k in range(3):
+                while time.time() < deadline:
+                    try:
+                        pool.kill(victim)
+                        break
+                    except ValueError:
+                        time.sleep(0.1)  # waiting on the respawn
+                print(f"chaos: SIGKILL {k + 1}/3 of replica {victim}")
+            print(f"breaker should bench {victim}; survivors "
+                  "finish the stream")
         for line in wire:
             if line.strip():
                 results.append(json.loads(line))
         elapsed = time.perf_counter() - t0
+        if supervisor is not None and (args.chaos_hang or args.chaos_flap):
+            # Fast survivors can drain the whole stream before the
+            # frozen victim's beat goes stale (hang) or before the
+            # supervisor's next poll sees the last death (flap).  The
+            # detection itself is the contract under test, so hold the
+            # supervisor open until it lands instead of racing it to
+            # shutdown.
+            settle = time.time() + max(30.0, 4 * args.watchdog_s)
+            while time.time() < settle:
+                if args.chaos_hang and supervisor.hung_killed:
+                    break
+                if args.chaos_flap and pool.benched:
+                    break
+                time.sleep(0.2)
     finally:
+        os.environ.pop(CRASH_HOOK_ENV, None)
         frontend.stop_in_thread()
+        sup_stop.set()
+        if sup_thread is not None:
+            sup_thread.join(timeout=30)
         codes = pool.stop()
     summary = fleet_summary(
         queue_dir,
@@ -194,10 +313,12 @@ def run_socket(args, stream):
         frontend_status=frontend.status(),
         elapsed_s=elapsed,
         telemetry_dir=args.telemetry,
+        self_healing=supervisor.summary() if supervisor else None,
     )
     summary["replica_exit_codes"] = codes
     path = write_fleet_summary(queue_dir, summary)
     print(f"fleet summary:   {path}")
+    args._fleet_summary = summary  # chaos assertions in main()
     return results, elapsed
 
 
@@ -218,6 +339,23 @@ def main(argv=None):
     ap.add_argument("--chaos-kill", action="store_true",
                     help="socket transport only: SIGKILL one replica "
                     "mid-stream and assert zero lost requests")
+    ap.add_argument("--supervise", action="store_true",
+                    help="socket transport only: run the self-healing "
+                    "supervisor (watchdog + quarantine + breaker)")
+    ap.add_argument("--watchdog-s", type=float, default=3.0,
+                    help="supervisor heartbeat staleness budget "
+                    "(compile phase gets 30x)")
+    ap.add_argument("--chaos-hang", action="store_true",
+                    help="SIGSTOP one replica mid-stream; needs "
+                    "--supervise: the watchdog must detect + recover")
+    ap.add_argument("--chaos-poison", action="store_true",
+                    help="inject a worker-crashing request; needs "
+                    "--supervise: quarantined after <= 2 deaths with a "
+                    "crash report, everything else served cleanly")
+    ap.add_argument("--chaos-flap", action="store_true",
+                    help="SIGKILL the same replica 3x; needs "
+                    "--supervise: the breaker must bench the slot and "
+                    "release its admission capacity")
     ap.add_argument("--reclaim-timeout-s", type=float, default=30.0,
                     help="fleet crash-recovery reclaim timeout; must "
                     "exceed the worst-case claim-to-result time (cold "
@@ -245,6 +383,17 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     stream = make_stream(args.requests, args.trials, target=args.target)
+    poison_ids = set()
+    if args.chaos_poison:
+        from qba_tpu.serve import EvalRequest
+
+        # One poison request mid-stream (past the bit-identity head):
+        # any worker that claims it dies via the test-only crash hook.
+        poison = EvalRequest(
+            request_id=f"lg-{POISON_TOKEN}", trials=4, seed=999, **BUCKETS[0]
+        )
+        stream.insert(len(stream) // 2, poison)
+        poison_ids = {poison.request_id}
     if args.in_process:
         results, elapsed = run_in_process(args, stream)
     elif args.transport == "socket":
@@ -253,16 +402,21 @@ def main(argv=None):
         results, elapsed = run_subprocess(args, stream)
 
     errors = [r for r in results if r.get("error")]
-    if errors:
-        raise SystemExit(f"{len(errors)} requests failed: {errors[:3]}")
+    unexpected = [r for r in errors if r["request_id"] not in poison_ids]
+    if unexpected:
+        raise SystemExit(
+            f"{len(unexpected)} requests failed: {unexpected[:3]}"
+        )
     if len(results) != len(stream):
         raise SystemExit(f"got {len(results)} results for {len(stream)} requests")
 
-    # Every result must carry a schema-clean manifest.
+    # Every served result must carry a schema-clean manifest (poison
+    # requests never execute — their crash-report errors have none).
     from qba_tpu.obs.manifest import validate_manifest
 
     for r in results:
-        validate_manifest(r["manifest"])
+        if r["request_id"] not in poison_ids:
+            validate_manifest(r["manifest"])
 
     # Bit-identity spot check: first request of each bucket vs a direct
     # engine run of the identical config.
@@ -288,6 +442,7 @@ def main(argv=None):
     spans = [
         types.SimpleNamespace(name="request", dur=r["latency_s"])
         for r in results
+        if not r.get("error")  # a quarantined request has no latency
     ]
     lat = span_latency_summary(spans, "request")
     rpm = len(results) / elapsed * 60.0
@@ -319,6 +474,71 @@ def main(argv=None):
         if admitted:
             print(f"admission:       {len(admitted)}/{len(results)} "
                   "results carry a typed admission decision")
+
+        # Chaos postconditions: bounded blast radius, proven from the
+        # fleet summary + the crash reports on the wire (KI-9).
+        fleet = getattr(args, "_fleet_summary", None) or {}
+        healing = fleet.get("self_healing") or {}
+        if args.chaos_poison:
+            poisoned = [r for r in errors if r["request_id"] in poison_ids]
+            if len(poisoned) != len(poison_ids):
+                raise SystemExit(
+                    f"poison requests got {len(poisoned)} error results, "
+                    f"expected {len(poison_ids)}"
+                )
+            for r in poisoned:
+                report = r.get("crash_report")
+                if not report:
+                    raise SystemExit(
+                        f"poison result {r['request_id']} carries no "
+                        f"crash report: {r.get('error')}"
+                    )
+                missing = {"blamed_replicas", "phases", "exit_codes",
+                           "reclaim_count"} - set(report)
+                if missing:
+                    raise SystemExit(f"crash report missing {missing}")
+                if len(report["blamed_replicas"]) > 2:
+                    raise SystemExit(
+                        "blast radius exceeded: poison request killed "
+                        f"{len(report['blamed_replicas'])} workers "
+                        "(quarantine threshold is 2)"
+                    )
+            if fleet.get("quarantined", 0) < len(poison_ids):
+                raise SystemExit(
+                    "fleet summary missed the quarantine: "
+                    f"{fleet.get('quarantined')} < {len(poison_ids)}"
+                )
+            print(f"chaos-poison:    quarantined after "
+                  f"{len(poisoned[0]['crash_report']['blamed_replicas'])} "
+                  "worker death(s); crash report on the wire")
+        if args.chaos_hang:
+            if healing.get("hung_killed", 0) < 1:
+                raise SystemExit(
+                    "the watchdog never killed the SIGSTOP'd replica "
+                    f"(self_healing: {healing})"
+                )
+            print(f"chaos-hang:      watchdog killed "
+                  f"{healing['hung_killed']} hung worker(s); "
+                  "stream completed with zero lost requests")
+        if args.chaos_flap:
+            benched = healing.get("benched") or []
+            adm = fleet.get("admission") or {}
+            if not benched:
+                raise SystemExit(
+                    f"the breaker never benched the flapping replica "
+                    f"(self_healing: {healing})"
+                )
+            if adm and adm.get("capacity_trials", 0) >= adm.get(
+                "base_capacity_trials", 0
+            ):
+                raise SystemExit(
+                    "benched replica did not release admission capacity: "
+                    f"{adm.get('capacity_trials')} >= "
+                    f"{adm.get('base_capacity_trials')}"
+                )
+            print(f"chaos-flap:      breaker benched {benched}; "
+                  f"admission window now {adm.get('capacity_trials')}"
+                  f"/{adm.get('base_capacity_trials')} trials")
 
     if args.target:
         # Time-to-decision: for a targeted request the request span
@@ -375,6 +595,14 @@ def main(argv=None):
                         args.replicas if args.transport == "socket" else 1
                     ),
                     "chaos_kill": bool(args.chaos_kill),
+                    "chaos": [
+                        m for m in ("kill", "hang", "poison", "flap")
+                        if getattr(args, f"chaos_{m}")
+                    ],
+                    "supervised": bool(args.supervise),
+                    "self_healing": (
+                        getattr(args, "_fleet_summary", None) or {}
+                    ).get("self_healing"),
                     "served_by": sorted(
                         {str(r.get("replica_id")) for r in results}
                     ),

@@ -409,11 +409,12 @@ def check_fleet(fleet_dir: str | None = None) -> Report:
     byte flows through the replicas' serve loops — whose dispatch
     ordering :func:`check_serve_dispatch` already proves.
 
-    Three obligations:
+    Four obligations:
 
-    1. ``frontend.py`` never imports jax/jaxlib at all — not even
-       lazily — so the listener can never trigger a device→host
-       transfer (its sync discipline is vacuously clean).
+    1. ``frontend.py`` and ``supervisor.py`` never import jax/jaxlib
+       at all — not even lazily — so neither the listener nor the
+       self-healing loop can ever trigger a device→host transfer
+       (their sync discipline is vacuously clean).
     2. No fleet module calls a device entry point
        (``run_trials`` / ``pallas_call`` / ``serve_batch`` / ...):
        the front half has no dispatch path of its own.
@@ -421,6 +422,11 @@ def check_fleet(fleet_dir: str | None = None) -> Report:
        ``serve --transport file-queue`` loop (the ``"serve"`` and
        ``"file-queue"`` argv constants are present), so pool dispatch
        ordering inherits the double-buffer proof unchanged.
+    4. Heartbeat writes stay on the worker side of the KI-6 fence: no
+       fleet module constructs a ``HeartbeatWriter`` or calls
+       ``.beat()`` (the supervisor only ever *reads* heartbeats),
+       while the worker-side transport loop does construct one — the
+       observation channel exists and flows one way.
     """
     report = Report()
     fleet_dir = fleet_dir if fleet_dir is not None else _fleet_dir()
@@ -442,8 +448,9 @@ def check_fleet(fleet_dir: str | None = None) -> Report:
         path = os.path.join(fleet_dir, fname)
         with open(path) as fh:
             tree = ast.parse(fh.read(), filename=path)
-        # Obligation 1: the frontend never imports jax, even lazily.
-        if fname == "frontend.py":
+        # Obligation 1: neither the frontend nor the supervisor ever
+        # imports jax, even lazily.
+        if fname in ("frontend.py", "supervisor.py"):
             for node in ast.walk(tree):
                 mods = []
                 if isinstance(node, ast.Import):
@@ -458,12 +465,34 @@ def check_fleet(fleet_dir: str | None = None) -> Report:
                             path=f"fleet:{fname}",
                             where=f"{path}:{node.lineno}",
                             message=(
-                                f"frontend.py imports {mod}: the "
-                                "socket front-end must stay jax-free "
-                                "so it can never perform a "
-                                "device→host transfer"
+                                f"{fname} imports {mod}: the fleet "
+                                "front half must stay jax-free so it "
+                                "can never perform a device→host "
+                                "transfer"
                             ),
                         ))
+        # Obligation 4a: heartbeats flow worker -> supervisor only.  A
+        # fleet module writing one would forge the very evidence the
+        # watchdog and blame attribution rest on.
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name in ("HeartbeatWriter", "beat"):
+                report.findings.append(Finding(
+                    ki="KI-6", check="fleet-front", path=f"fleet:{fname}",
+                    where=f"{path}:{node.lineno}",
+                    message=(
+                        f"fleet front-half module calls {name}(): "
+                        "heartbeats are written by workers and only "
+                        "read here — a front-half write would forge "
+                        "the watchdog's evidence"
+                    ),
+                ))
         # Obligation 2: no device entry points anywhere in the front
         # half.
         for node in ast.walk(tree):
@@ -511,8 +540,39 @@ def check_fleet(fleet_dir: str | None = None) -> Report:
                 "proof"
             ),
         ))
+    # Obligation 4b: the worker-side transport loop actually writes
+    # heartbeats (constructs a HeartbeatWriter) — without it the
+    # supervisor would watchdog against a channel nobody feeds.
+    transport_path = os.path.join(
+        os.path.dirname(fleet_dir), "transport.py"
+    )
+    writes_heartbeat = False
+    if os.path.isfile(transport_path):
+        with open(transport_path) as fh:
+            transport_tree = ast.parse(fh.read(), filename=transport_path)
+        writes_heartbeat = any(
+            isinstance(node, ast.Call)
+            and (
+                (isinstance(node.func, ast.Name)
+                 and node.func.id == "HeartbeatWriter")
+                or (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "HeartbeatWriter")
+            )
+            for node in ast.walk(transport_tree)
+        )
+    if not writes_heartbeat:
+        report.findings.append(Finding(
+            ki="KI-6", check="fleet-front", path="fleet:transport.py",
+            where=transport_path,
+            message=(
+                "serve/transport.py constructs no HeartbeatWriter: "
+                "workers have stopped feeding the supervisor's "
+                "observation channel — hung workers become "
+                "undetectable"
+            ),
+        ))
     report.stats["fleet_modules_checked"] = modules_checked
-    report.stats["fleet_proof_obligations"] = 3
+    report.stats["fleet_proof_obligations"] = 4
     return report
 
 

@@ -491,6 +491,40 @@ def _parser() -> argparse.ArgumentParser:
         "replicas get chip-pinned even where jax auto-initializes "
         "TPU without any env var",
     )
+    fleet.add_argument(
+        "--supervise", action="store_true",
+        help="run the self-healing supervisor: heartbeat watchdog "
+        "(SIGKILL hung workers), immediate claim release + poison "
+        "quarantine on worker death, crash-loop breaker, respawn "
+        "with backoff (docs/SERVING.md 'Self-healing')",
+    )
+    fleet.add_argument(
+        "--watchdog-s", type=float, default=10.0,
+        help="base heartbeat staleness budget; the compile phase gets "
+        "30x (cold XLA compiles are slow, not hung)",
+    )
+    fleet.add_argument(
+        "--breaker-k", type=int, default=3,
+        help="crash-loop breaker: deaths of one replica slot inside "
+        "--breaker-window-s that bench it for good",
+    )
+    fleet.add_argument(
+        "--breaker-window-s", type=float, default=60.0,
+        help="crash-loop breaker window (seconds)",
+    )
+    fleet.add_argument(
+        "--poison-threshold", type=int, default=2,
+        help="worker deaths blamed on one request before it is "
+        "quarantined (dead-lettered with a crash report)",
+    )
+    fleet.add_argument(
+        "--max-respawns", type=int, default=5,
+        help="respawns per replica slot before it is benched",
+    )
+    fleet.add_argument(
+        "--respawn-backoff-s", type=float, default=0.5,
+        help="base exponential backoff between respawns of one slot",
+    )
 
     study = sub.add_parser(
         "study", help="success-rate curve over a swept parameter"
@@ -1137,11 +1171,13 @@ def _cmd_serve(args: argparse.Namespace, out) -> int:
 
 def _cmd_fleet(args: argparse.Namespace, out) -> int:
     import json
+    import threading
     import time
 
     from qba_tpu.serve.fleet import (
         AdmissionController,
         FleetFrontend,
+        FleetSupervisor,
         ReplicaPool,
         fleet_summary,
         write_fleet_summary,
@@ -1167,16 +1203,36 @@ def _cmd_fleet(args: argparse.Namespace, out) -> int:
         max_reclaims=args.max_reclaims,
         poll_s=args.poll_s,
         platform=args.platform,
+        max_respawns=args.max_respawns,
+        respawn_backoff_s=args.respawn_backoff_s,
     )
+    supervisor = None
+    if args.supervise:
+        supervisor = FleetSupervisor(
+            pool,
+            admission=admission,
+            watchdog_s=args.watchdog_s,
+            breaker_k=args.breaker_k,
+            breaker_window_s=args.breaker_window_s,
+            poison_threshold=args.poison_threshold,
+        )
     frontend = FleetFrontend(
         args.queue_dir,
         admission,
         host=args.host,
         port=args.port,
         max_requests=args.max_requests,
+        health_provider=supervisor.health if supervisor else None,
     )
     t0 = time.monotonic()
     pool.start()
+    sup_stop = threading.Event()
+    sup_thread = None
+    if supervisor is not None:
+        sup_thread = threading.Thread(
+            target=supervisor.run, args=(sup_stop,), daemon=True
+        )
+        sup_thread.start()
     try:
         port = frontend.start_in_thread()
         print(
@@ -1186,6 +1242,7 @@ def _cmd_fleet(args: argparse.Namespace, out) -> int:
                         "listening": f"{args.host}:{port}",
                         "replicas": pool.alive(),
                         "queue_dir": args.queue_dir,
+                        "supervised": supervisor is not None,
                     }
                 }
             ),
@@ -1197,6 +1254,12 @@ def _cmd_fleet(args: argparse.Namespace, out) -> int:
         except KeyboardInterrupt:
             frontend.stop_in_thread()
     finally:
+        # Stop supervising BEFORE dropping the stop sentinel: workers
+        # draining a slow flush must not be watchdogged or "respawned"
+        # into a stopping queue.
+        sup_stop.set()
+        if sup_thread is not None:
+            sup_thread.join(timeout=30)
         codes = pool.stop()
     status = frontend.status()
     summary = fleet_summary(
@@ -1205,6 +1268,7 @@ def _cmd_fleet(args: argparse.Namespace, out) -> int:
         frontend_status=status,
         elapsed_s=time.monotonic() - t0,
         telemetry_dir=args.telemetry,
+        self_healing=supervisor.summary() if supervisor else None,
     )
     summary["replica_exit_codes"] = codes
     path = write_fleet_summary(args.queue_dir, summary)

@@ -117,6 +117,11 @@ class QBAServer:
         # span so cross-replica aggregation can tell the workers apart.
         self.replica_id = replica_id
         self._expired = 0
+        # Set by the file-queue transport when this server is a fleet
+        # worker: a jax-free queuefs.HeartbeatWriter that stamps the
+        # lifecycle phase (compile/dispatch/readback here; idle/claim in
+        # the transport loop) for the supervisor's watchdog.
+        self.heartbeat = None
         self.telemetry_dir = telemetry_dir
         self.cache_dir = cache_dir
         self.recorder = SpanRecorder()  # server-level chunk spans
@@ -340,6 +345,16 @@ class QBAServer:
             bucket=label, chunk=chunk.index, trials=chunk.used,
             padded=self.scheduler.chunk_trials - chunk.used,
         )
+        if self.heartbeat is not None:
+            # First dispatch of a bucket may trigger a cold XLA compile
+            # (minutes, not milliseconds) — beat the distinct "compile"
+            # phase so the supervisor's watchdog grants it more rope.
+            self.heartbeat.beat(
+                "compile"
+                if chunk.bucket not in self._bucket_decisions
+                else "dispatch",
+                sorted({seg.request_id for seg in chunk.segments}),
+            )
         if chunk.bucket not in self._bucket_decisions:
             # First dispatch of this bucket: capture the live resolver
             # decisions so every request served from it can carry them
@@ -362,6 +377,10 @@ class QBAServer:
     def _drain_one(self) -> list[EvalResult]:
         chunk, mc = self._in_flight.pop(0)
         label = bucket_label(chunk.bucket)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(
+                "readback", sorted({seg.request_id for seg in chunk.segments})
+            )
         with self.recorder.span(
             "serve.readback", cat="serve", bucket=label, chunk=chunk.index
         ) as sp:

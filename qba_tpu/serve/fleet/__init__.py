@@ -14,7 +14,11 @@ multi-replica service without inventing new dispatch machinery:
   typed admit/defer/reject decisions and release-on-settle;
 * :mod:`summary` — cross-replica aggregation: per-replica and
   fleet-wide p50/p99, queue-wait vs device-time attribution, admission
-  decision counts, one ``fleet_summary.json``.
+  decision counts, one ``fleet_summary.json``;
+* :mod:`supervisor` — the self-healing loop: phase-aware heartbeat
+  watchdog (SIGKILLs hung workers), blame-attributed crash ledger,
+  poison-request quarantine, and a crash-loop breaker that benches
+  flapping replicas and releases their admission capacity.
 
 ``qba-tpu fleet`` (cli.py) wires all four together; docs/SERVING.md
 has the topology and operator guide.
@@ -41,6 +45,11 @@ from qba_tpu.serve.fleet.summary import (
     merge_fleet_spans,
     write_fleet_summary,
 )
+from qba_tpu.serve.fleet.supervisor import (
+    CRASH_LEDGER_SCHEMA,
+    WATCHDOG_PHASE_SCALE,
+    FleetSupervisor,
+)
 
 __all__ = [
     "ADMIT",
@@ -58,4 +67,7 @@ __all__ = [
     "fleet_summary",
     "merge_fleet_spans",
     "write_fleet_summary",
+    "CRASH_LEDGER_SCHEMA",
+    "WATCHDOG_PHASE_SCALE",
+    "FleetSupervisor",
 ]

@@ -100,6 +100,11 @@ class AdmissionController:
         self._ceilings: dict[str, int] = {}  # bucket label -> KI-2 ceiling
         self.decisions: list[AdmissionDecision] = []
         self.released_trials = 0  # settled price, incl. early-stop refunds
+        # Degraded-mode accounting: the supervisor's crash-loop breaker
+        # shrinks the window when it benches a replica, so admission
+        # keeps pricing against capacity that actually exists.
+        self._base_capacity = self.capacity_trials
+        self._benched: set[str] = set()
 
     @property
     def outstanding_trials(self) -> int:
@@ -206,6 +211,22 @@ class AdmissionController:
         final verdict of a deferred request, not every failed poll."""
         self.decisions.append(decision)
 
+    def bench_replica(self, replica_id: str) -> int:
+        """Release one benched replica's share of the capacity window
+        (crash-loop breaker, docs/SERVING.md "Self-healing"): with a
+        slot permanently out of service, admitting against its share
+        would queue requests against phantom capacity.  The share is
+        the per-replica slice of the *initial* window; returns the
+        trials actually released (0 on a repeat bench of the same id).
+        Deterministic like every other decision input: the window is a
+        pure function of the bench events, not of time."""
+        if replica_id in self._benched:
+            return 0
+        self._benched.add(replica_id)
+        share = min(self._base_capacity // self.replicas, self.capacity_trials)
+        self.capacity_trials -= share
+        return share
+
     def settle(self, request_id: str, executed_trials: int | None = None) -> int:
         """Release a finished request's priced capacity; returns the
         trials released.  ``executed_trials`` (from the result) lets
@@ -255,6 +276,8 @@ class AdmissionController:
             "by_action": by_action,
             "by_reason": by_reason,
             "capacity_trials": self.capacity_trials,
+            "base_capacity_trials": self._base_capacity,
+            "benched_replicas": sorted(self._benched),
             "outstanding_trials": self.outstanding_trials,
             "released_trials": self.released_trials,
             "bucket_ceilings": dict(self._ceilings),

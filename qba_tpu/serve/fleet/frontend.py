@@ -48,6 +48,7 @@ class FleetFrontend:
         poll_s: float = 0.02,
         request_prefix: str = "fl",
         max_requests: int | None = None,
+        health_provider=None,
     ) -> None:
         self.queue_dir = queue_dir
         self.paths = queue_paths(queue_dir)
@@ -59,6 +60,12 @@ class FleetFrontend:
         self.port = port  # 0 = ephemeral; replaced by the bound port
         self.poll_s = poll_s
         self.max_requests = max_requests
+        # Zero-arg callable returning the supervisor's per-replica
+        # health map (``FleetSupervisor.health``) for ``GET /status``.
+        # A callable, not the supervisor itself: the front-end must not
+        # grow a pool/process dependency — and check_fleet keeps
+        # proving it device-free either way.
+        self.health_provider = health_provider
         self._ids = itertools.count()
         self._prefix = request_prefix
         self._futures: dict[str, asyncio.Future] = {}
@@ -418,7 +425,7 @@ class FleetFrontend:
 
     # ---- reporting ---------------------------------------------------
     def status(self) -> dict[str, Any]:
-        return {
+        out: dict[str, Any] = {
             "requests_seen": self.requests_seen,
             "results_forwarded": self.results_forwarded,
             "pending": len(self._futures),
@@ -427,3 +434,9 @@ class FleetFrontend:
                 self.admission.summary() if self.admission is not None else None
             ),
         }
+        if self.health_provider is not None:
+            try:
+                out["replicas"] = self.health_provider()
+            except Exception as e:  # status must never take the socket down
+                out["replicas"] = {"error": str(e)}
+        return out

@@ -137,6 +137,8 @@ class ReplicaPool:
         poll_s: float = 0.05,
         platform: str | None = None,
         python: str | None = None,
+        max_respawns: int = 5,
+        respawn_backoff_s: float = 0.5,
     ) -> None:
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -152,8 +154,19 @@ class ReplicaPool:
         self.poll_s = poll_s
         self.platform = platform
         self.python = python or sys.executable
+        self.max_respawns = max_respawns
+        self.respawn_backoff_s = respawn_backoff_s
         self.replicas: list[Replica] = []
-        self.restarted: list[str] = []
+        #: Respawn audit trail: ``{replica_id, at, respawns}`` per
+        #: restart (wall-clock timestamp so post-mortems can correlate
+        #: with request latencies and the crash ledger).
+        self.restarted: list[dict[str, Any]] = []
+        #: Slots withdrawn from service: the crash-loop breaker
+        #: (supervisor) or the ``max_respawns`` cap benches a replica
+        #: id here; :meth:`respawn_dead` never revives a benched slot.
+        self.benched: set[str] = set()
+        self._respawns: dict[str, int] = {}  # replica_id -> count
+        self._next_respawn_at: dict[str, float] = {}  # monotonic gate
 
     def worker_argv(self, replica_id: str) -> list[str]:
         """The exact serve invocation a replica runs — the file-queue
@@ -221,6 +234,7 @@ class ReplicaPool:
                     for r in self.replicas
                 ],
                 "restarted": self.restarted,
+                "benched": sorted(self.benched),
             },
         )
 
@@ -234,22 +248,59 @@ class ReplicaPool:
         for r in self.replicas:
             if r.replica_id == replica_id and r.alive:
                 r.proc.send_signal(sig)
-                r.proc.wait(timeout=60)
+                try:
+                    r.proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    # A wedged zombie (e.g. stuck in an uninterruptible
+                    # device ioctl) must not raise out of a chaos/
+                    # supervisor kill — record what we know and move on.
+                    pass
                 r.returncode = r.proc.returncode
                 self._write_state()
                 return r.proc.pid
         raise ValueError(f"no live replica {replica_id!r}")
 
+    def bench(self, replica_id: str) -> bool:
+        """Withdraw one slot from service permanently (crash-loop
+        breaker): its dead process is never respawned again.  Returns
+        True if the slot was newly benched."""
+        if replica_id in self.benched:
+            return False
+        self.benched.add(replica_id)
+        self._write_state()
+        return True
+
     def respawn_dead(self) -> list[str]:
-        """Replace every dead replica with a fresh process under the
-        same id/env slot (the supervision loop for long-lived fleets;
-        chaos tests leave this off to prove reclaim alone suffices)."""
+        """Replace dead replicas with fresh processes under the same
+        id/env slot (the supervision loop for long-lived fleets; chaos
+        tests leave this off to prove reclaim alone suffices).
+
+        Guard rails against a bad device becoming a hot respawn loop:
+        the k-th respawn of a slot waits ``respawn_backoff_s * 2**(k-1)``
+        after the previous one (exponential backoff), and a slot that
+        has burned ``max_respawns`` respawns is benched for good.
+        Returns the ids actually respawned this call."""
         respawned = []
+        now = time.monotonic()
         for i, r in enumerate(self.replicas):
-            if not r.alive and r.replica_id not in respawned:
-                self.replicas[i] = self._spawn(i)
-                self.restarted.append(r.replica_id)
-                respawned.append(r.replica_id)
+            rid = r.replica_id
+            if r.alive or rid in respawned or rid in self.benched:
+                continue
+            k = self._respawns.get(rid, 0)
+            if k >= self.max_respawns:
+                self.bench(rid)
+                continue
+            if now < self._next_respawn_at.get(rid, 0.0):
+                continue  # still inside the backoff window
+            self.replicas[i] = self._spawn(i)
+            self._respawns[rid] = k + 1
+            self._next_respawn_at[rid] = (
+                now + self.respawn_backoff_s * (2 ** k)
+            )
+            self.restarted.append(
+                {"replica_id": rid, "at": time.time(), "respawns": k + 1}
+            )
+            respawned.append(rid)
         if respawned:
             self._write_state()
         return respawned
@@ -268,7 +319,10 @@ class ReplicaPool:
                 r.proc.wait(timeout=budget)
             except subprocess.TimeoutExpired:
                 r.proc.kill()
-                r.proc.wait(timeout=30)
+                try:
+                    r.proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pass  # zombie outlived SIGKILL; don't leak the rest
             codes[r.replica_id] = r.proc.returncode
         self._write_state()
         return codes

@@ -113,8 +113,15 @@ def fleet_summary(
     frontend_status: dict[str, Any] | None = None,
     elapsed_s: float | None = None,
     telemetry_dir: str | None = None,
+    self_healing: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
-    """Aggregate one fleet run's artifacts into a summary dict."""
+    """Aggregate one fleet run's artifacts into a summary dict.
+
+    ``self_healing`` is the supervisor's totals
+    (:meth:`~qba_tpu.serve.fleet.supervisor.FleetSupervisor.summary`);
+    independent of it, quarantined poison requests are totalled from
+    their crash-report results and the on-disk crash ledger, so the
+    summary stays truthful even for a run whose supervisor died."""
     paths = queue_paths(queue_dir)
     results = _load_results(paths["outbox"], paths["consumed"])
     ok = [r for r in results if not r.get("error")]
@@ -168,6 +175,32 @@ def fleet_summary(
             int(p.get("expired") or 0) for p in exit_summaries.values()
         ),
     }
+    # Poison-quarantine totals (KI-9): every dead-lettered request's
+    # structured crash report, keyed by request id.
+    crash_reports = {
+        str(r.get("request_id")): r["crash_report"]
+        for r in results
+        if r.get("crash_report")
+    }
+    summary["quarantined"] = len(crash_reports)
+    if crash_reports:
+        summary["crash_reports"] = crash_reports
+    try:
+        with open(paths["crash_ledger"]) as f:
+            ledger = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        ledger = None
+    if ledger is not None:
+        summary["crash_ledger"] = {
+            "blamed_requests": len(ledger.get("blame") or {}),
+            "quarantined": len(ledger.get("quarantined") or {}),
+            "deaths": len(ledger.get("deaths") or []),
+            "hung_killed": len(ledger.get("hung_killed") or []),
+            "benched": [
+                e.get("replica_id")
+                for e in (ledger.get("bench_events") or [])
+            ],
+        }
     if elapsed_s is not None and elapsed_s > 0:
         summary["elapsed_s"] = elapsed_s
         summary["requests_per_min"] = len(ok) / elapsed_s * 60.0
@@ -175,6 +208,8 @@ def fleet_summary(
         summary["admission"] = admission_summary
     if frontend_status is not None:
         summary["frontend"] = frontend_status
+    if self_healing is not None:
+        summary["self_healing"] = self_healing
     if telemetry_dir is not None:
         merged = merge_fleet_spans(telemetry_dir)
         summary["spans"] = {

@@ -17,6 +17,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 from typing import Any
 
 
@@ -30,6 +31,7 @@ def queue_paths(queue_dir: str) -> dict[str, str]:
         "consumed": os.path.join(queue_dir, "consumed"),
         "stop": os.path.join(queue_dir, "stop"),
         "summary": os.path.join(queue_dir, "summary.json"),
+        "crash_ledger": os.path.join(queue_dir, "crash_ledger.json"),
     }
 
 
@@ -81,3 +83,101 @@ def drop_request(inbox: str, payload: dict[str, Any], request_id: str) -> str:
     path = inbox_request_path(inbox, request_id)
     write_json_atomic(path, payload)
     return path
+
+
+# ---------------------------------------------------------------------------
+# Worker heartbeats (the self-healing layer's observation channel).
+
+HEARTBEAT_SCHEMA = "qba-tpu/heartbeat/v1"
+
+#: Lifecycle phases a worker reports, in rough hot-loop order.  The
+#: supervisor's watchdog is phase-aware: ``compile`` legitimately runs
+#: orders of magnitude longer than the others (cold XLA compiles), so
+#: a long compile is "busy", not "hung".
+HEARTBEAT_PHASES = ("idle", "claim", "compile", "dispatch", "readback")
+
+
+def heartbeat_path(queue_dir: str, replica_id: str) -> str:
+    return os.path.join(
+        queue_dir, f"heartbeat-{request_slug(replica_id)}.json"
+    )
+
+
+class HeartbeatWriter:
+    """Atomic-rename heartbeat file for one file-queue worker.
+
+    Written by the *worker side only* (transport claim loop + server
+    dispatch/readback transitions) — the supervisor and the rest of the
+    fleet front half may read heartbeats but never write them, which
+    :func:`qba_tpu.analysis.transfers.check_fleet` proves statically.
+    Like everything in this module the writer is jax-free by
+    construction: a heartbeat write can never sync a device, so beating
+    inside the dispatch hot loop costs one small ``os.replace``.
+
+    The stamp is ``time.monotonic()`` (CLOCK_MONOTONIC is machine-wide
+    on Linux, so the supervisor process can age it against its own
+    monotonic clock without wall-time step hazards).  ``seq`` increases
+    on every write as a second staleness witness.  Idle re-beats are
+    throttled to ``idle_rebeat_s`` so a quiet worker refreshes its
+    liveness without hammering the queue dir every poll tick.
+    """
+
+    def __init__(
+        self,
+        queue_dir: str,
+        replica_id: str,
+        *,
+        idle_rebeat_s: float = 1.0,
+    ) -> None:
+        self.path = heartbeat_path(queue_dir, replica_id)
+        self.replica_id = replica_id
+        self.idle_rebeat_s = idle_rebeat_s
+        self.seq = 0
+        self._last_phase: str | None = None
+        self._last_write = 0.0
+
+    def beat(self, phase: str, request_ids: tuple[str, ...] | list[str] = ()) -> bool:
+        """Record a phase transition; returns True if a file write
+        happened (idle->idle re-beats inside the throttle window are
+        skipped — the previous stamp is still fresh)."""
+        if phase not in HEARTBEAT_PHASES:
+            raise ValueError(
+                f"unknown heartbeat phase {phase!r}; one of {HEARTBEAT_PHASES}"
+            )
+        now = time.monotonic()
+        if (
+            phase == "idle"
+            and self._last_phase == "idle"
+            and now - self._last_write < self.idle_rebeat_s
+        ):
+            return False
+        self.seq += 1
+        payload = {
+            "schema": HEARTBEAT_SCHEMA,
+            "replica_id": self.replica_id,
+            "pid": os.getpid(),
+            "seq": self.seq,
+            "phase": phase,
+            "request_ids": list(request_ids),
+            "monotonic": now,
+            "stamp": time.time(),
+        }
+        try:
+            write_json_atomic(self.path, payload)
+        except OSError:
+            return False  # a missing queue dir must never kill the worker
+        self._last_phase = phase
+        self._last_write = now
+        return True
+
+
+def read_heartbeat(queue_dir: str, replica_id: str) -> dict[str, Any] | None:
+    """The last heartbeat one replica wrote, or None (never booted far
+    enough to beat, or the file is mid-rename — atomic writes mean a
+    readable file is always complete)."""
+    try:
+        with open(heartbeat_path(queue_dir, replica_id)) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return payload if isinstance(payload, dict) else None

@@ -155,6 +155,12 @@ class EvalResult:
     # Typed admission decision (qba_tpu.serve.fleet.admission), attached
     # by the front-end: action, reason, and the priced trial capacity.
     admission: dict[str, Any] | None = None
+    # Poison-request quarantine (qba_tpu.serve.fleet.supervisor): a
+    # request dead-lettered for killing workers carries the structured
+    # blame evidence — ``{blamed_replicas, phases, exit_codes,
+    # reclaim_count}`` — so the caller learns *why* it will never be
+    # retried, not just that it failed.
+    crash_report: dict[str, Any] | None = None
 
     def to_json(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)

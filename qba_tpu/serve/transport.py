@@ -32,12 +32,23 @@ from typing import IO, Any, Iterable
 
 from qba_tpu.serve.engine import QBAServer
 from qba_tpu.serve.queuefs import (
+    HeartbeatWriter,
     queue_paths,
     request_slug,
     result_path as _result_path_for,
     write_json_atomic,
 )
 from qba_tpu.serve.request import EvalResult, decode_request_line
+
+#: Test-only crash hook (the chaos harness's poison-request injector):
+#: when this env var is set, a worker that claims a request whose id
+#: contains the var's value hard-exits mid-claim — emulating a compile
+#: OOM / libtpu abort without needing one.  Unset (production) the
+#: check never runs.  examples/load_gen.py --chaos-poison and the CI
+#: chaos job set it; the supervisor's quarantine bounds the blast
+#: radius to poison_threshold workers (docs/KNOWN_ISSUES.md KI-9).
+CRASH_HOOK_ENV = "QBA_TEST_CRASH_HOOK"
+CRASH_HOOK_EXIT = 113
 
 
 def _emit_jsonl(out: IO[str], results: Iterable[EvalResult]) -> int:
@@ -188,6 +199,18 @@ def serve_file_queue(
     reclaim_attempts: dict[str, int] = {}
     reclaimed_total = 0
 
+    # Fleet workers (replica_id set) heartbeat their lifecycle phase at
+    # every transition so the supervisor can tell busy from hung and
+    # blame a crash on the in-flight request (docs/SERVING.md
+    # "Self-healing").  The writer lives in jax-free queuefs and also
+    # rides along on the server for the dispatch/readback phases.
+    hb = None
+    if server.replica_id is not None:
+        hb = HeartbeatWriter(queue_dir, server.replica_id)
+        server.heartbeat = hb
+        hb.beat("idle")
+    crash_token = os.environ.get(CRASH_HOOK_ENV)
+
     def settle(name: str) -> None:
         try:
             os.replace(
@@ -252,9 +275,20 @@ def serve_file_queue(
                     os.utime(claimed, (claim_t, claim_t))
                 except OSError:
                     pass  # raced away; the eventual result still wins
+                # The claim-phase heartbeat names the file slug BEFORE
+                # decode: if this worker dies anywhere past this point
+                # (decode, submit, dispatch), the supervisor knows
+                # which request to blame.
+                if hb is not None:
+                    hb.beat("claim", [os.path.splitext(name)[0]])
                 try:
                     with open(claimed) as f:
                         req = decode_request_line(f.read())
+                    if crash_token and crash_token in req.request_id:
+                        # Test-only poison hook: die like a compile OOM
+                        # would — no cleanup, no result, claim left in
+                        # claimed/ for the supervisor to attribute.
+                        os._exit(CRASH_HOOK_EXIT)
                     server.submit(req, queue_wait_s=queue_wait)
                 except ValueError as e:
                     emit([EvalResult.failure(os.path.splitext(name)[0], str(e))])
@@ -273,6 +307,8 @@ def serve_file_queue(
                 # lone request is never stuck behind an unfilled chunk.
                 if server.busy:
                     emit(server.flush())
+                if hb is not None:
+                    hb.beat("idle")
                 time.sleep(poll_s)
     finally:
         emit(server.flush())

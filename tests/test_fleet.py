@@ -1,6 +1,6 @@
 """Fleet-subsystem tests (docs/SERVING.md "Fleet").
 
-Five contracts:
+Six contracts:
 
 * **Pricing determinism** — :meth:`Target.planning_trials` is pure
   arithmetic: same target, same price; tighter precision prices more
@@ -19,6 +19,12 @@ Five contracts:
   union their resolver states and config shapes instead of clobbering
   (the property that makes a shared warm-start artifact safe for N
   replicas).
+* **Self-healing** (docs/KNOWN_ISSUES.md KI-9) — workers heartbeat
+  their lifecycle phase; the supervisor's phase-aware watchdog kills
+  hung workers, releases a dead worker's claim within one poll,
+  quarantines a request blamed for ``poison_threshold`` deaths with a
+  structured crash report, and benches a crash-looping slot while the
+  admission window shrinks to match.
 """
 
 import json
@@ -536,6 +542,516 @@ def test_check_fleet_is_clean_and_catches_violations(tmp_path):
     assert "imports jax" in messages
     assert "run_trials" in messages
     assert "worker_argv" in messages
+
+
+# ---- self-healing: heartbeats, watchdog, quarantine, breaker -----------
+
+
+def _write_hb(qdir, rid, pid, phase, monotonic, request_ids=()):
+    """Doctor a heartbeat file directly so tests control the stamp."""
+    from qba_tpu.serve.queuefs import heartbeat_path, write_json_atomic
+
+    write_json_atomic(heartbeat_path(str(qdir), rid), {
+        "schema": "qba-tpu/heartbeat/v1", "replica_id": rid, "pid": pid,
+        "seq": 1, "phase": phase, "request_ids": list(request_ids),
+        "monotonic": monotonic, "stamp": 0.0,
+    })
+
+
+class _FakeProc:
+    def __init__(self, pid, returncode=None):
+        self.pid = pid
+        self.returncode = returncode
+
+    def poll(self):
+        return self.returncode
+
+
+class _StubReplica:
+    def __init__(self, rid, pid, returncode=None):
+        self.replica_id = rid
+        self.proc = _FakeProc(pid, returncode)
+        self.env = {}
+        self.returncode = returncode
+
+    @property
+    def alive(self):
+        return self.proc.returncode is None
+
+
+class _StubPool:
+    """Duck-typed pool: real queue dir, fake processes."""
+
+    def __init__(self, queue_dir, replicas):
+        self.queue_dir = str(queue_dir)
+        self.replicas = replicas
+        self.benched = set()
+        self.restarted = []
+        self.killed = []
+
+    def kill(self, rid):
+        for r in self.replicas:
+            if r.replica_id == rid and r.alive:
+                self.killed.append(rid)
+                r.proc.returncode = -9
+                return r.proc.pid
+        raise ValueError(rid)
+
+    def bench(self, rid):
+        if rid in self.benched:
+            return False
+        self.benched.add(rid)
+        return True
+
+    def respawn_dead(self):
+        return []
+
+
+def test_heartbeat_writer_phases_throttle_and_read(tmp_path):
+    from qba_tpu.serve.queuefs import (
+        HEARTBEAT_PHASES, HeartbeatWriter, read_heartbeat,
+    )
+
+    qdir = str(_queue_dirs(tmp_path))
+    hb = HeartbeatWriter(qdir, "r0", idle_rebeat_s=60.0)
+    with pytest.raises(ValueError):
+        hb.beat("warp")
+    assert hb.beat("idle") is True
+    payload = read_heartbeat(qdir, "r0")
+    assert payload["schema"] == "qba-tpu/heartbeat/v1"
+    assert payload["replica_id"] == "r0"
+    assert payload["pid"] == os.getpid()
+    assert (payload["seq"], payload["phase"]) == (1, "idle")
+    assert payload["request_ids"] == []
+    assert payload["monotonic"] <= time.monotonic()
+    # idle -> idle inside the throttle window: no write, stamp unchanged.
+    assert hb.beat("idle") is False
+    assert read_heartbeat(qdir, "r0")["seq"] == 1
+    # Phase transitions always write, carrying the in-flight ids.
+    assert hb.beat("claim", ["w1"]) is True
+    assert read_heartbeat(qdir, "r0")["request_ids"] == ["w1"]
+    # idle after work always writes too (the throttle is idle->idle).
+    assert hb.beat("idle") is True
+    assert read_heartbeat(qdir, "r0")["seq"] == 3
+    assert read_heartbeat(qdir, "never-booted") is None
+    assert set(HEARTBEAT_PHASES) == {
+        "idle", "claim", "compile", "dispatch", "readback",
+    }
+    # A missing queue dir degrades the beat, never the worker.
+    gone = HeartbeatWriter(str(tmp_path / "nope" / "q"), "r9")
+    assert gone.beat("claim", ["x"]) is False
+
+
+def test_serve_loop_heartbeats_through_the_phases(tmp_path):
+    from qba_tpu.serve.queuefs import read_heartbeat
+
+    qdir = _queue_dirs(tmp_path)
+    req = _req("hb0", trials=3, seed=5)
+    (qdir / "inbox" / "hb0.json").write_text(json.dumps(req.to_json()))
+    server = QBAServer(chunk_trials=4, replica_id="r3")
+    serve_file_queue(server, str(qdir), poll_s=0.01, max_requests=1)
+    hb = read_heartbeat(str(qdir), "r3")
+    # The worker beat at claim, compile/dispatch, and readback at
+    # minimum — and every beat came from THIS process (the supervisor
+    # matches pids to tell a respawn from its predecessor's stale file).
+    assert hb is not None
+    assert hb["pid"] == os.getpid()
+    assert hb["seq"] >= 3
+    assert hb["phase"] in ("idle", "readback")
+
+
+def test_supervisor_classification_is_phase_aware(tmp_path):
+    from qba_tpu.serve.fleet import FleetSupervisor, WATCHDOG_PHASE_SCALE
+
+    qdir = _queue_dirs(tmp_path)
+    r0 = _StubReplica("r0", 100)
+    pool = _StubPool(qdir, [r0])
+    now = [1000.0]
+    sup = FleetSupervisor(pool, watchdog_s=10.0, clock=lambda: now[0])
+    with pytest.raises(ValueError):
+        FleetSupervisor(pool, watchdog_s=0.0)
+    with pytest.raises(ValueError):
+        FleetSupervisor(pool, poison_threshold=0)
+    # No heartbeat yet: booting, healthy inside the grace window
+    # (3x watchdog by default), hung beyond it.
+    v = sup.classify(r0)
+    assert (v["state"], v["phase"]) == ("healthy", "boot")
+    now[0] = 1031.0
+    assert sup.classify(r0)["state"] == "hung"
+    # A stale file from a previous pid is "no beat from THIS process".
+    _write_hb(qdir, "r0", pid=999, phase="dispatch", monotonic=1030.0)
+    assert sup.classify(r0)["phase"] == "boot"
+    # Fresh dispatch beat: busy now, hung once it ages past watchdog_s.
+    _write_hb(qdir, "r0", 100, "dispatch", 1031.0, ["w1"])
+    now[0] = 1036.0
+    v = sup.classify(r0)
+    assert (v["state"], v["phase"], v["request_ids"]) == (
+        "busy", "dispatch", ["w1"],
+    )
+    now[0] = 1042.0
+    assert sup.classify(r0)["state"] == "hung"
+    # The same age in a compile phase is still busy: cold XLA compiles
+    # get WATCHDOG_PHASE_SCALE x the base budget.
+    _write_hb(qdir, "r0", 100, "compile", 1031.0, ["w1"])
+    assert sup.classify(r0)["state"] == "busy"
+    now[0] = 1031.0 + 10.0 * WATCHDOG_PHASE_SCALE["compile"] + 1.0
+    assert sup.classify(r0)["state"] == "hung"
+    # Fresh idle beat: healthy.  Dead process: dead, with exit code.
+    _write_hb(qdir, "r0", 100, "idle", now[0])
+    assert sup.classify(r0)["state"] == "healthy"
+    r0.proc.returncode = -9
+    v = sup.classify(r0)
+    assert (v["state"], v["exit_code"]) == ("dead", -9)
+
+
+def test_supervisor_kills_hung_and_fast_releases_claim(tmp_path):
+    from qba_tpu.serve.fleet import FleetSupervisor
+
+    qdir = _queue_dirs(tmp_path)
+    (qdir / "claimed" / "w1.json").write_text(
+        json.dumps(_req("w1", trials=3).to_json())
+    )
+    r0 = _StubReplica("r0", 100)
+    pool = _StubPool(qdir, [r0, _StubReplica("r1", 101)])
+    now = [1000.0]
+    sup = FleetSupervisor(pool, watchdog_s=5.0, clock=lambda: now[0])
+    _write_hb(qdir, "r0", 100, "dispatch", 1000.0, ["w1"])
+    _write_hb(qdir, "r1", 101, "idle", 1000.0)
+    health = sup.health()
+    assert health["r0"]["state"] == "busy"
+    assert health["r1"] == {**health["r1"], "state": "healthy",
+                            "benched": False}
+    now[0] = 1006.0  # r0's beat is now stale; r1 is merely idle-aged
+    _write_hb(qdir, "r1", 101, "idle", 1005.5)
+    step = sup.poll()
+    # The wedged worker was killed and its death blamed on w1 (one
+    # blame < threshold), so the claim went straight back to the inbox
+    # — one supervisor poll, not one reclaim timeout.
+    assert step["hung_killed"] == ["r0"] and pool.killed == ["r0"]
+    assert [d["replica_id"] for d in step["deaths"]] == ["r0"]
+    assert (qdir / "inbox" / "w1.json").exists()
+    assert not (qdir / "claimed" / "w1.json").exists()
+    assert sup.ledger["w1"]["releases"] == 1
+    assert not sup.ledger["w1"]["quarantined"]
+    assert len(sup.hung_killed) == 1
+    ledger = json.loads((qdir / "crash_ledger.json").read_text())
+    assert ledger["schema"] == "qba-tpu/crash-ledger/v1"
+    assert "w1" in ledger["blame"] and len(ledger["deaths"]) == 1
+
+
+def test_supervisor_quarantines_poison_with_crash_report(tmp_path):
+    from qba_tpu.serve.fleet import FleetSupervisor
+
+    qdir = _queue_dirs(tmp_path)
+    (qdir / "claimed" / "p1.json").write_text(
+        json.dumps(_req("p1", trials=3).to_json())
+    )
+    r0 = _StubReplica("r0", 100, returncode=113)
+    r1 = _StubReplica("r1", 101)
+    ridle = _StubReplica("r2", 102, returncode=-9)
+    pool = _StubPool(qdir, [r0, r1, ridle])
+    now = [1000.0]
+    sup = FleetSupervisor(pool, watchdog_s=30.0, poison_threshold=2,
+                          clock=lambda: now[0])
+    _write_hb(qdir, "r0", 100, "dispatch", 1000.0, ["p1"])
+    _write_hb(qdir, "r1", 101, "idle", 1000.0)
+    # An idle death blames nobody — there was nothing in flight.
+    _write_hb(qdir, "r2", 102, "idle", 1000.0)
+    sup.poll()
+    assert sup.ledger["p1"]["releases"] == 1
+    assert (qdir / "inbox" / "p1.json").exists()
+    assert "r2" not in [
+        d["replica_id"] for e in sup.ledger.values() for d in e["deaths"]
+    ]
+    # The released claim kills its second worker: threshold reached.
+    r1.proc.returncode = 113
+    _write_hb(qdir, "r1", 101, "claim", 1001.0, ["p1"])
+    sup.poll()
+    entry = sup.ledger["p1"]
+    assert entry["quarantined"] and len(entry["deaths"]) == 2
+    # Dead-lettered NOW — not after the reclaim ladder.
+    assert (qdir / "dead" / "p1.json").exists()
+    assert not (qdir / "inbox" / "p1.json").exists()
+    res = json.loads((qdir / "outbox" / "p1.json").read_text())
+    assert "quarantined as poison" in res["error"]
+    report = res["crash_report"]
+    assert set(report) == {
+        "blamed_replicas", "phases", "exit_codes", "reclaim_count",
+    }
+    assert report["blamed_replicas"] == ["r0", "r1"]
+    assert report["phases"] == ["dispatch", "claim"]
+    assert report["exit_codes"] == [113, 113]
+    assert report["reclaim_count"] == 1
+    # Blast radius: the poison request cost exactly 2 workers.
+    assert len(report["blamed_replicas"]) == sup.poison_threshold
+    # The fleet summary totals the quarantine from the wire result AND
+    # the on-disk ledger, plus the supervisor's own self_healing block.
+    summary = fleet_summary(str(qdir), self_healing=sup.summary())
+    assert summary["quarantined"] == 1
+    assert summary["crash_reports"]["p1"] == report
+    assert summary["crash_ledger"]["blamed_requests"] == 1
+    assert summary["crash_ledger"]["quarantined"] == 1
+    assert summary["crash_ledger"]["deaths"] == 3
+    assert summary["self_healing"]["quarantined"]["p1"]["request_id"] == "p1"
+    assert summary["self_healing"]["releases"] == 1
+
+
+def test_breaker_benches_slot_and_releases_admission_capacity(tmp_path):
+    from qba_tpu.serve.fleet import FleetSupervisor
+
+    qdir = _queue_dirs(tmp_path)
+    r0 = _StubReplica("r0", 100, returncode=-9)
+    pool = _StubPool(qdir, [r0, _StubReplica("r1", 101)])
+    ac = _controller(replicas=2)  # capacity 2 * 2 * 8 = 32
+    now = [1000.0]
+    sup = FleetSupervisor(pool, admission=ac, watchdog_s=30.0,
+                          breaker_k=2, breaker_window_s=60.0,
+                          clock=lambda: now[0])
+    sup.poll()
+    assert pool.benched == set()  # one death is not a crash loop
+    # The slot's respawn dies too, inside the breaker window.
+    r0.proc = _FakeProc(102, returncode=-9)
+    now[0] = 1010.0
+    step = sup.poll()
+    assert step["benched"] == ["r0"]
+    assert pool.benched == {"r0"}
+    # Admission released the benched slot's share of the window...
+    assert ac.capacity_trials == 16
+    s = ac.summary()
+    assert s["base_capacity_trials"] == 32
+    assert s["benched_replicas"] == ["r0"]
+    # ...exactly once: further deaths of a benched slot are no-ops.
+    assert ac.bench_replica("r0") == 0
+    assert ac.capacity_trials == 16
+    assert sup.bench_events[0]["capacity_released"] == 16
+    assert sup.summary()["benched"] == ["r0"]
+    # Bench state is visible in /status health.
+    assert sup.health()["r0"]["benched"] is True
+
+
+def test_respawn_backoff_and_max_respawns_bench(tmp_path, monkeypatch):
+    qdir = _queue_dirs(tmp_path)
+    pool = ReplicaPool(str(qdir), replicas=1, max_respawns=2,
+                       respawn_backoff_s=60.0)
+    spawned = []
+
+    def fake_spawn(index):
+        r = _StubReplica(f"r{index}", 200 + len(spawned))
+        spawned.append(r)
+        return r
+
+    monkeypatch.setattr(pool, "_spawn", fake_spawn)
+    pool.replicas = [_StubReplica("r0", 100, returncode=-9)]
+    t0 = time.time()
+    assert pool.respawn_dead() == ["r0"]
+    assert len(spawned) == 1
+    [entry] = pool.restarted
+    assert entry["replica_id"] == "r0" and entry["respawns"] == 1
+    assert t0 <= entry["at"] <= time.time()  # timestamped audit trail
+    # The respawn dies immediately: the backoff gate holds the slot.
+    spawned[-1].proc.returncode = -9
+    assert pool.respawn_dead() == []
+    assert len(spawned) == 1
+    # Past the gate it respawns again — then hits max_respawns and is
+    # benched for good instead of becoming a hot respawn loop.
+    pool._next_respawn_at["r0"] = 0.0
+    assert pool.respawn_dead() == ["r0"]
+    spawned[-1].proc.returncode = -9
+    pool._next_respawn_at["r0"] = 0.0
+    assert pool.respawn_dead() == []
+    assert pool.benched == {"r0"}
+    assert [e["respawns"] for e in pool.restarted] == [1, 2]
+    state = json.loads((qdir / "replicas.json").read_text())
+    assert state["benched"] == ["r0"]
+    assert len(state["restarted"]) == 2
+
+
+def test_pool_kill_and_stop_survive_wedged_process(tmp_path):
+    import subprocess
+
+    class _WedgedProc:
+        pid = 4242
+        returncode = None
+
+        def poll(self):
+            return None
+
+        def send_signal(self, sig):
+            pass
+
+        def kill(self):
+            pass
+
+        def wait(self, timeout=None):
+            raise subprocess.TimeoutExpired(cmd="worker", timeout=timeout)
+
+    qdir = _queue_dirs(tmp_path)
+    pool = ReplicaPool(str(qdir), replicas=1)
+    stub = _StubReplica("r0", 4242)
+    stub.proc = _WedgedProc()
+    pool.replicas = [stub]
+    # A zombie stuck in an uninterruptible wait must not raise out of
+    # the chaos/supervisor kill path nor wedge pool shutdown.
+    assert pool.kill("r0") == 4242
+    codes = pool.stop(timeout_s=0.2)
+    assert codes == {"r0": None}
+
+
+def test_expired_request_releases_admission_capacity(tmp_path):
+    # Satellite of KI-9: a deadline-expired request comes back as an
+    # error result, and forwarding it must settle its priced capacity —
+    # otherwise expiries leak the admission window shut.
+    qdir = tmp_path / "q"
+    ac = AdmissionController(chunk_trials=4, replicas=1, window_chunks=2)
+    fe = FleetFrontend(str(qdir), ac, poll_s=0.01, max_requests=1)
+    worker = threading.Thread(target=_worker, args=(qdir, 1), daemon=True)
+    worker.start()
+    port = fe.start_in_thread()
+    req = _req("exp1", trials=8, seed=2, deadline_s=0.001)
+    conn = socket.create_connection(("127.0.0.1", port), timeout=120)
+    wire = conn.makefile("rw")
+    wire.write(json.dumps(req.to_json()) + "\n")
+    wire.flush()
+    conn.shutdown(socket.SHUT_WR)
+    [res] = [json.loads(line) for line in wire if line.strip()]
+    fe.stop_in_thread()
+    worker.join(timeout=120)
+    assert res["admission"]["action"] == ADMIT
+    assert res["admission"]["priced_trials"] == 8
+    assert res["error"] and "deadline exceeded" in res["error"]
+    # The expiry settled: nothing outstanding, the full price released.
+    assert ac.outstanding_trials == 0
+    assert ac.summary()["released_trials"] == 8
+    assert ac.summary()["outstanding_trials"] == 0
+
+
+@pytest.mark.slow
+def test_supervised_pool_quarantines_poison(tmp_path, monkeypatch):
+    """The CI chaos-poison story in miniature: a request that kills its
+    worker is dead-lettered with a crash report after exactly 2 deaths,
+    and every other request is still answered."""
+    from qba_tpu.serve.fleet import FleetSupervisor
+    from qba_tpu.serve.queuefs import drop_request
+    from qba_tpu.serve.transport import CRASH_HOOK_ENV, CRASH_HOOK_EXIT
+
+    # The hook must stay set for the whole run: supervisor respawns
+    # inherit it, and a respawn must be just as mortal.
+    monkeypatch.setenv(CRASH_HOOK_ENV, "poison")
+    qdir = str(tmp_path / "q")
+    pool = ReplicaPool(qdir, replicas=2, chunk_trials=4,
+                       reclaim_timeout_s=120.0, poll_s=0.02,
+                       respawn_backoff_s=0.2,
+                       cache_dir=str(tmp_path / "cache"))
+    sup = FleetSupervisor(pool, watchdog_s=30.0, poison_threshold=2)
+    pool.start()
+    stop = threading.Event()
+    thread = threading.Thread(target=sup.run, args=(stop, 0.1), daemon=True)
+    thread.start()
+    reqs = [_req(f"g{i}", trials=3, seed=i) for i in range(5)]
+    reqs.insert(2, _req("x-poison-x", trials=3, seed=9))
+    inbox = os.path.join(qdir, "inbox")
+    os.makedirs(inbox, exist_ok=True)
+    for r in reqs:
+        drop_request(inbox, r.to_json(), r.request_id)
+    outbox = os.path.join(qdir, "outbox")
+    deadline = time.time() + 540
+    while time.time() < deadline:
+        done = len(os.listdir(outbox)) if os.path.isdir(outbox) else 0
+        if done >= len(reqs):
+            break
+        time.sleep(0.2)
+    stop.set()
+    thread.join(timeout=30)
+    pool.stop()
+    results = {
+        name[:-5]: json.loads(open(os.path.join(outbox, name)).read())
+        for name in os.listdir(outbox)
+    }
+    assert set(results) == {r.request_id for r in reqs}  # zero lost
+    poison = results.pop("x-poison-x")
+    assert "quarantined as poison" in poison["error"]
+    report = poison["crash_report"]
+    assert set(report) == {
+        "blamed_replicas", "phases", "exit_codes", "reclaim_count",
+    }
+    # Bounded blast radius: exactly poison_threshold workers died for
+    # it (the reclaim ladder never got a turn), and the hook's exit
+    # code is what the supervisor recorded.
+    assert len(report["blamed_replicas"]) == 2
+    assert all(c == CRASH_HOOK_EXIT for c in report["exit_codes"])
+    assert all(r["error"] is None for r in results.values())
+    assert sup.summary()["deaths"] >= 2
+    summary = fleet_summary(qdir, self_healing=sup.summary())
+    assert summary["quarantined"] == 1
+    assert summary["crash_ledger"]["quarantined"] == 1
+
+
+@pytest.mark.slow
+def test_supervisor_watchdog_recovers_sigstop(tmp_path):
+    """A SIGSTOP'd worker never exits and never beats: only the
+    watchdog can catch it.  The frozen worker must be detected and
+    SIGKILLed off a stale beat, and the stream must still finish with
+    zero lost requests.
+
+    The victim is frozen once its heartbeat says ``idle`` — freezing
+    mid-compile would lawfully take 30x the watchdog budget to detect
+    (WATCHDOG_PHASE_SCALE), turning the test into a slow-clock test of
+    the wrong thing — and the wait loop requires BOTH stream
+    completion and the watchdog kill: a fast survivor finishing the
+    stream first must not let the test skip the detection proof."""
+    import signal as _signal
+
+    from qba_tpu.serve.fleet import FleetSupervisor
+    from qba_tpu.serve.queuefs import drop_request, read_heartbeat
+
+    qdir = str(tmp_path / "q")
+    pool = ReplicaPool(qdir, replicas=2, chunk_trials=4,
+                       reclaim_timeout_s=300.0, poll_s=0.02,
+                       respawn_backoff_s=0.2,
+                       cache_dir=str(tmp_path / "cache"))
+    sup = FleetSupervisor(pool, watchdog_s=5.0)
+    pool.start()
+    stop = threading.Event()
+    thread = threading.Thread(target=sup.run, args=(stop, 0.1), daemon=True)
+    thread.start()
+    reqs = [_req(f"h{i}", trials=3, seed=i) for i in range(8)]
+    inbox = os.path.join(qdir, "inbox")
+    os.makedirs(inbox, exist_ok=True)
+    for r in reqs:
+        drop_request(inbox, r.to_json(), r.request_id)
+    outbox = os.path.join(qdir, "outbox")
+    deadline = time.time() + 540
+    victim = pool.replicas[-1].replica_id
+    victim_pid = pool.replicas[-1].proc.pid
+    stopped = False
+    while time.time() < deadline:
+        if not stopped:
+            hb = read_heartbeat(qdir, victim)
+            if (
+                hb is not None
+                and hb.get("pid") == victim_pid
+                and hb.get("phase") == "idle"
+            ):
+                os.kill(victim_pid, _signal.SIGSTOP)
+                stopped = True
+        done = len(os.listdir(outbox)) if os.path.isdir(outbox) else 0
+        if done >= len(reqs) and stopped and sup.hung_killed:
+            break
+        time.sleep(0.2)
+    stop.set()
+    thread.join(timeout=30)
+    pool.stop()
+    assert stopped
+    # The watchdog caught the frozen worker off its stale idle beat.
+    [kill] = sup.hung_killed[:1]
+    assert kill["replica_id"] == victim and kill["pid"] == victim_pid
+    assert kill["beat_age_s"] >= 5.0
+    results = {
+        name[:-5]: json.loads(open(os.path.join(outbox, name)).read())
+        for name in os.listdir(outbox)
+    }
+    assert set(results) == {r.request_id for r in reqs}  # zero lost
+    assert all(r["error"] is None for r in results.values())
 
 
 @pytest.mark.slow
