@@ -210,6 +210,98 @@ def _mega_vs_fused(quick: bool) -> list[dict]:
     return rows
 
 
+def _multichip(quick: bool) -> dict:
+    """Multichip scenario (``QBA_BENCH_SCENARIO=multichip``): a dp×tp
+    sweep over the 8 emulated devices — (8,1), (4,2), (2,4), (1,8) —
+    timing the party-sharded engine under ring comms per shape, next
+    to the sharded KI-2 model's per-device/mesh trial ceilings for the
+    north-star shape at that tp width.  The rows are the CPU-fenced
+    template for the first real-TPU MULTICHIP_r06 capture: on hardware
+    the same sweep attributes ring remote-DMA hops instead of
+    ``ppermute`` and the ceilings become admissible batch sizes.
+
+    Runs in a subprocess so ``XLA_FLAGS=--xla_force_host_platform_
+    device_count=8`` lands before jax import regardless of what the
+    parent process already initialized.  Standing caveat (docs/PERF.md):
+    off-TPU, absolute rounds/s is interpret/CPU-fenced — valid for
+    RELATIVE shape-to-shape comparison only."""
+    import subprocess
+
+    trials = 8 if quick else 32
+    reps = 2 if quick else 4
+    code = f"""
+import json, statistics, time
+import jax
+from qba_tpu.config import QBAConfig
+from qba_tpu.analysis.memory import sharded_trial_ceiling
+from qba_tpu.benchmark import engine_description
+from qba_tpu.parallel import make_mesh, run_trials_spmd
+from qba_tpu.backends.jax_backend import trial_keys
+
+cfg = QBAConfig(n_parties=17, size_l=16, n_dishonest=4,
+                trials={trials}, seed=0)
+ns = QBAConfig(33, 64, 10)
+rows = []
+for dp, tp in ((8, 1), (4, 2), (2, 4), (1, 8)):
+    mesh = make_mesh({{"dp": dp, "tp": tp}})
+    keys = trial_keys(cfg)
+    run_trials_spmd(cfg, mesh, keys)  # warm the jit cache
+    times = []
+    for _ in range({reps}):
+        t0 = time.perf_counter()
+        res = run_trials_spmd(cfg, mesh, keys)
+        jax.block_until_ready(res.trials.success)
+        times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
+    model = sharded_trial_ceiling(ns, dp=dp, tp=tp, comms="ring")
+    model_ag = sharded_trial_ceiling(ns, dp=dp, tp=tp,
+                                     comms="all_gather")
+    rows.append({{
+        "mesh": {{"dp": dp, "tp": tp}},
+        "engine": engine_description(cfg, tp=tp) if tp > 1
+                  else engine_description(cfg),
+        "trials": cfg.trials,
+        "rounds_per_sec": round(cfg.trials * cfg.n_rounds / med, 2),
+        "rep_seconds": [round(t, 4) for t in times],
+        "northstar_per_device_ceiling": model["per_device_trials"],
+        "northstar_mesh_ceiling": model["mesh_trials"],
+        "northstar_all_gather_per_device": model_ag["per_device_trials"],
+    }})
+print(json.dumps(rows))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=1200,
+        env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"multichip subprocess failed: {proc.stderr[-800:]}"
+        )
+    rows = json.loads(proc.stdout.strip().splitlines()[-1])
+    for row in rows:
+        print(f"multichip {row['mesh']}: {row['rounds_per_sec']} "
+              f"rounds/s ({row['engine']})", file=sys.stderr)
+    return {
+        "metric": "multichip_rounds_per_sec_n17_l16_d4",
+        "scenario": "multichip",
+        "unit": "rounds/s",
+        "rows": rows,
+        "methodology": (
+            "8 emulated CPU devices (XLA_FLAGS force_host_platform_"
+            "device_count); ring comms via ppermute — relative "
+            "shape-to-shape comparison only, ceilings are the v5e "
+            "north-star model"
+        ),
+    }
+
+
 def main() -> None:
     from qba_tpu.compile_cache import enable_compile_cache
     from qba_tpu.config import QBAConfig
@@ -217,6 +309,15 @@ def main() -> None:
     from qba_tpu.obs.manifest import probe_stats_snapshot
 
     enable_compile_cache()
+
+    if os.environ.get("QBA_BENCH_SCENARIO") == "multichip":
+        # The dp×tp sweep replaces the single-device battery: its own
+        # JSON line is the whole artifact (CI uploads it as
+        # MULTICHIP_r*.json).
+        print(json.dumps(
+            _multichip(os.environ.get("QBA_BENCH_QUICK") == "1")
+        ))
+        return
 
     # Live dispatch-decision capture + probe-counter baseline for the
     # manifest embedded in the JSON line (docs/OBSERVABILITY.md).

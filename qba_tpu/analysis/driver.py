@@ -119,11 +119,16 @@ def _lint_config(
         report.extend(check_gf2_memory(cfg))
     if effects:
         from qba_tpu.analysis.effects import check_effects
-        from qba_tpu.analysis.launches import check_launches
+        from qba_tpu.analysis.launches import (
+            check_launches,
+            check_spmd_launches,
+        )
         from qba_tpu.analysis.transfers import check_jaxpr_transfers
 
         report.extend(check_effects(cfg, paths, engine_set))
         report.extend(check_launches(cfg, engine_set))
+        if "spmd" in engine_set:
+            report.extend(check_spmd_launches(cfg, engine_set))
         report.extend(check_jaxpr_transfers(paths))
     return report
 

@@ -22,6 +22,25 @@ engine                    launches per trial
 A drift in these counts is a perf regression the runtime would never
 surface (everything stays bit-identical), so the pin is a lint
 finding, tagged KI-5 with the donation/launch-discipline family.
+
+The party-sharded (tp) path has its own rows
+(:func:`check_spmd_launches`): per device-program the engine keeps its
+single-device launch count, and the comms transport adds
+
+========================  =======================================
+tp comms                  extra launches / collectives per trial
+========================  =======================================
+``ring`` off-TPU          0 launches; ``leaves x n_rounds x (tp-1)``
+                          ``ppermute`` hops (the schedule the lint
+                          counts and pins)
+``ring`` on TPU           ``leaves x n_rounds`` remote-DMA kernel
+                          launches (one per pool leaf per round,
+                          :mod:`qba_tpu.ops.ring_shuffle`) — the
+                          stated model :func:`spmd_launches_per_trial`
+                          closes from the counted hop schedule
+``all_gather``            0 launches, 0 ``ppermute`` (one XLA
+                          collective per leaf per round)
+========================  =======================================
 """
 
 from __future__ import annotations
@@ -42,23 +61,23 @@ LAUNCH_MODEL = {
 }
 
 
-def count_pallas_launches(jaxpr) -> int:
-    """Total ``pallas_call`` launches one evaluation of ``jaxpr``
-    performs: scans multiply their body's count by the trip count,
-    ``cond`` takes the max over branches, other sub-jaxprs add up.
-    Kernel bodies are not descended into (a kernel cannot launch a
-    kernel)."""
+def count_primitive(jaxpr, prim_names) -> int:
+    """Total evaluations of any primitive in ``prim_names`` one
+    evaluation of ``jaxpr`` performs: scans multiply their body's
+    count by the trip count, ``cond`` takes the max over branches,
+    other sub-jaxprs add up.  Kernel bodies are not descended into
+    (a kernel cannot launch a kernel)."""
     from qba_tpu.analysis.effects import _as_jaxprs
 
     jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
     total = 0
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
-        if name == "pallas_call":
+        if name in prim_names:
             total += 1
             continue
         subs = [
-            count_pallas_launches(s)
+            count_primitive(s, prim_names)
             for p in eqn.params.values()
             for s in _as_jaxprs(p)
         ]
@@ -71,6 +90,11 @@ def count_pallas_launches(jaxpr) -> int:
         else:
             total += sum(subs)
     return total
+
+
+def count_pallas_launches(jaxpr) -> int:
+    """``pallas_call`` launches per evaluation of ``jaxpr``."""
+    return count_primitive(jaxpr, ("pallas_call",))
 
 
 def _trace_trial(cfg: QBAConfig, engine: str | None):
@@ -150,4 +174,159 @@ def check_launches(cfg: QBAConfig, engines) -> Report:
                 "(= model)"
             )
     report.stats["launch_engines_checked"] = checked
+    return report
+
+
+#: Engines whose party-sharded variants get launch rows.  xla pins the
+#: pure-collective path; pallas_fused pins the spmd hot path (mega has
+#: no sharded variant — spmd demotes it to fused, so fused IS its row).
+SPMD_CHECK_ENGINES = ("xla", "pallas_fused")
+
+
+def spmd_launches_per_trial(
+    cfg: QBAConfig,
+    engine: str = "xla",
+    comms: str = "ring",
+    pool_leaves: int = 0,
+    tpu: bool = False,
+) -> int:
+    """The closed launch model for the party-sharded path: the
+    engine's single-device launches per trial (``pallas_mega`` demotes
+    to ``pallas_fused`` under the tp mesh) plus, on TPU under
+    ``comms="ring"``, one remote-DMA kernel launch per gathered pool
+    leaf per round.  Off-TPU the ring is ``ppermute`` hops and
+    ``all_gather`` is one XLA collective per leaf per round — neither
+    adds a ``pallas_call``.  ``pool_leaves`` comes from the counted
+    hop schedule (:func:`check_spmd_launches` derives it as
+    ``ppermute_hops / (n_rounds * (tp - 1))``)."""
+    resolved = "pallas_fused" if engine == "pallas_mega" else engine
+    base = LAUNCH_MODEL[resolved](cfg)
+    if comms == "ring" and tpu:
+        return base + pool_leaves * cfg.n_rounds
+    return base
+
+
+def check_spmd_launches(cfg: QBAConfig, engines, tp: int = 2) -> Report:
+    """Pin the party-sharded path's launch + hop schedule on an
+    emulated (dp=1, tp) mesh: per device-program the engine keeps its
+    single-device launch count for BOTH comms (off-TPU neither
+    transport may add a ``pallas_call``), the ring trace carries
+    exactly ``leaves x n_rounds x (tp - 1)`` ``ppermute`` hops, and
+    the all_gather trace carries none.  The derived leaf count closes
+    the TPU ring row of :func:`spmd_launches_per_trial` (noted, since
+    remote DMA cannot be traced off-TPU)."""
+    import jax
+
+    from qba_tpu.diagnostics import QBADemotionWarning
+
+    report = Report()
+    spmd_engines = [e for e in SPMD_CHECK_ENGINES if e in engines]
+    if not spmd_engines:
+        return report
+    if jax.device_count() < tp:
+        report.notes.append(
+            f"spmd-launches: {jax.device_count()} device(s) < tp={tp} — "
+            "pin skipped (the multichip CI job runs it on the emulated "
+            "8-device mesh)"
+        )
+        return report
+    if cfg.n_lieutenants % tp != 0:
+        report.notes.append(
+            f"spmd-launches: tp={tp} does not divide "
+            f"n_lieutenants={cfg.n_lieutenants}; pin skipped"
+        )
+        return report
+
+    from qba_tpu.parallel.mesh import make_mesh
+    from qba_tpu.parallel.spmd import _resolve_check_vma, _spmd_batch
+
+    mesh = make_mesh({"dp": 1, "tp": tp}, devices=jax.devices()[:tp])
+    keys = jax.random.split(jax.random.key(0), 1)
+    checked = 0
+    for engine in spmd_engines:
+        counts: dict[str, tuple[int, int]] = {}
+        demoted = False
+        for comms in ("ring", "all_gather"):
+            try:
+                with warnings.catch_warnings(record=True) as caught:
+                    warnings.simplefilter("always")
+                    closed = jax.make_jaxpr(
+                        lambda k: _spmd_batch(
+                            cfg, mesh, k, engine,
+                            _resolve_check_vma(engine), comms,
+                        )
+                    )(keys)
+            except Exception as exc:
+                report.notes.append(
+                    f"spmd-launches[tp={tp}]/{engine}/{comms}: trace "
+                    f"failed, pin skipped ({type(exc).__name__}: {exc})"
+                )
+                break
+            if any(
+                issubclass(w.category, QBADemotionWarning) for w in caught
+            ):
+                demoted = True
+            counts[comms] = (
+                count_pallas_launches(closed.jaxpr),
+                count_primitive(closed.jaxpr, ("ppermute",)),
+            )
+        if len(counts) < 2:
+            continue
+        if demoted:
+            report.notes.append(
+                f"spmd-launches[tp={tp}]/{engine}: demotion recorded "
+                "during trace — pin skipped (the demoted engine is "
+                "pinned under its own entry)"
+            )
+            continue
+        checked += 1
+        base = LAUNCH_MODEL[engine](cfg)
+        for comms, (pallas, _) in counts.items():
+            if pallas != base:
+                report.findings.append(Finding(
+                    ki="KI-5", check="spmd-launches",
+                    path=f"spmd[tp={tp}]/{engine}/{comms}",
+                    message=(
+                        f"{pallas} pallas_call launch(es) per trial "
+                        f"off-TPU, the engine's model says {base} — "
+                        "the comms path must add zero launches off-TPU "
+                        "(remote DMA exists only on hardware)"
+                    ),
+                ))
+        hops = cfg.n_rounds * (tp - 1)
+        ring_hops = counts["ring"][1]
+        ag_hops = counts["all_gather"][1]
+        if ag_hops != 0:
+            report.findings.append(Finding(
+                ki="KI-5", check="spmd-launches",
+                path=f"spmd[tp={tp}]/{engine}/all_gather",
+                message=(
+                    f"{ag_hops} ppermute hop(s) in the all_gather "
+                    "trace — the escape-hatch path regrew ring traffic"
+                ),
+            ))
+        if ring_hops == 0 or ring_hops % hops != 0:
+            report.findings.append(Finding(
+                ki="KI-5", check="spmd-launches",
+                path=f"spmd[tp={tp}]/{engine}/ring",
+                message=(
+                    f"{ring_hops} ppermute hop(s) per trial does not "
+                    f"match the ring schedule (a multiple of "
+                    f"n_rounds x (tp-1) = {hops}): the hop structure "
+                    "drifted and the TPU remote-DMA model no longer "
+                    "closes"
+                ),
+            ))
+        else:
+            leaves = ring_hops // hops
+            tpu_model = spmd_launches_per_trial(
+                cfg, engine, "ring", leaves, tpu=True
+            )
+            report.notes.append(
+                f"spmd-launches[tp={tp}]/{engine}: {base} launch(es) + "
+                f"{ring_hops} ppermute hops/trial (= {leaves} pool "
+                f"leaves x {cfg.n_rounds} rounds x {tp - 1} hops); "
+                f"TPU ring model closes at {tpu_model} launch(es)/trial"
+            )
+    report.stats["spmd_launch_engines_checked"] = checked
     return report

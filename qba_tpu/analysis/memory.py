@@ -51,10 +51,17 @@ NORTH_STAR = (33, 64, 10)
 NORTH_STAR_CEILING_BAND = (1088, 1151)
 
 #: Mesh shapes (dp, tp) the lint predicts per-device budgets for by
-#: default — the gate ROADMAP item 1's remote-DMA sharding lands
-#: behind.  dp replicates trials across data-parallel devices; tp
-#: shards the receiver axis of the mailbox pool.
-DEFAULT_MESH_SHAPES = ((2, 4),)
+#: default — the matrix the round-9 remote-DMA sharding landed behind.
+#: dp replicates trials across data-parallel devices; tp shards the
+#: receiver axis of the mailbox pool.  (1, 8) is the full-width shard
+#: of this container's 8 devices — the shape the 65p over-budget
+#: scenario runs on.
+DEFAULT_MESH_SHAPES = ((2, 4), (1, 8))
+
+#: Comms transports the sharded model prices (mirrors
+#: ``qba_tpu.parallel.ring.TP_COMMS_CHOICES``; "ring" is the round-9
+#: default the admission controller and the findings gate use).
+TP_COMMS_MODELED = ("ring", "all_gather")
 
 
 def trial_ceiling(cfg: QBAConfig, hbm_bytes: int = HBM_BYTES) -> int:
@@ -80,28 +87,58 @@ def sharded_pool_bytes(cfg: QBAConfig, tp: int) -> dict:
     return pool_bytes(cfg, n_recv=cfg.n_lieutenants // tp)
 
 
+def comms_buffer_bytes(cfg: QBAConfig, tp: int, comms: str = "ring") -> int:
+    """Per-trial comms transient resident NEXT to the local pool shard
+    during a voting round's gather, in shard-padded bytes.
+
+    * ``all_gather`` transiently materializes every remote shard at
+      once: ``(tp - 1) x shard`` — the term that eats the linear-in-tp
+      ceiling (the pre-round-9 KI-2 wall).
+    * ``ring`` keeps only the double-buffered slot pair of the
+      remote-DMA shuffle (:mod:`qba_tpu.ops.ring_shuffle`):
+      ``min(2, tp - 1) x shard`` — constant in tp.
+
+    Both are exactly 0 at tp=1 (no comms), which is what makes
+    :func:`sharded_trial_ceiling` reduce to :func:`trial_ceiling`."""
+    if comms not in TP_COMMS_MODELED:
+        raise ValueError(
+            f"unknown comms {comms!r}; expected one of {TP_COMMS_MODELED}"
+        )
+    if tp <= 1:
+        return 0
+    shard = sharded_pool_bytes(cfg, tp)["padded_bytes"]
+    hops_resident = (tp - 1) if comms == "all_gather" else min(2, tp - 1)
+    return hops_resident * shard
+
+
 def sharded_trial_ceiling(
     cfg: QBAConfig, dp: int = 1, tp: int = 1,
-    hbm_bytes: int = HBM_BYTES,
+    hbm_bytes: int = HBM_BYTES, comms: str = "ring",
 ) -> dict:
     """Per-device and whole-mesh trial ceilings for a (dp, tp) mesh.
 
     tp shards the receiver axis (each device holds a
     ``n_lieutenants // tp`` slice of the pool), dp replicates the
     tp-group over independent trials — so the per-device ceiling is
-    set by the *sharded* pool bytes against one device's HBM, and the
+    set by the *sharded* pool bytes plus the comms transient
+    (:func:`comms_buffer_bytes`) against one device's HBM, and the
     mesh ceiling is ``dp`` times that (trials never share state across
-    dp replicas).  (dp=1, tp=1) reduces exactly to
-    :func:`trial_ceiling`."""
+    dp replicas).  Under ``comms="ring"`` the per-trial footprint is
+    ``occupancy x shard + 2 x shard`` — constant multiplier, so the
+    ceiling scales ~linearly in tp; under ``all_gather`` the
+    multiplier grows with tp and the scaling flattens.  (dp=1, tp=1)
+    reduces exactly to :func:`trial_ceiling` for either comms."""
     per_device_pool = sharded_pool_bytes(cfg, tp)["padded_bytes"]
-    per_device = int(
-        (hbm_bytes - HBM_RESERVE) // (POOL_OCCUPANCY * per_device_pool)
-    )
+    comms_bytes = comms_buffer_bytes(cfg, tp, comms)
+    footprint = POOL_OCCUPANCY * per_device_pool + comms_bytes
+    per_device = int((hbm_bytes - HBM_RESERVE) // footprint)
     return {
         "dp": dp,
         "tp": tp,
+        "comms": comms,
         "n_recv": cfg.n_lieutenants // tp,
         "per_device_pool_bytes": per_device_pool,
+        "comms_buffer_bytes": comms_bytes,
         "per_device_trials": per_device,
         "mesh_trials": dp * per_device,
     }
@@ -375,12 +412,18 @@ def check_memory(cfg: QBAConfig) -> Report:
         if cfg.n_lieutenants // tp != cfg.n_lieutenants // 2:
             _audit_plans(cfg, cfg.n_lieutenants // tp, report,
                          prefix=f"spmd[tp={tp}]/")
-        sc = sharded_trial_ceiling(cfg, dp=dp, tp=tp)
+        sc = sharded_trial_ceiling(cfg, dp=dp, tp=tp, comms="ring")
+        sc_ag = sharded_trial_ceiling(cfg, dp=dp, tp=tp,
+                                      comms="all_gather")
         report.notes.append(
             f"sharded-hbm[dp={dp},tp={tp}]: per-device pool "
             f"{sc['per_device_pool_bytes']} B/trial "
-            f"(n_recv={sc['n_recv']}) -> ~{sc['per_device_trials']} "
-            f"trials/device, ~{sc['mesh_trials']} mesh trials on v5e"
+            f"(n_recv={sc['n_recv']}, ring comms "
+            f"+{sc['comms_buffer_bytes']} B) -> "
+            f"~{sc['per_device_trials']} trials/device, "
+            f"~{sc['mesh_trials']} mesh trials on v5e "
+            f"(all_gather comms would cap at "
+            f"~{sc_ag['per_device_trials']} trials/device)"
         )
         if sc["per_device_trials"] < 1:
             report.findings.append(Finding(
@@ -388,7 +431,8 @@ def check_memory(cfg: QBAConfig) -> Report:
                 path=f"spmd[dp={dp},tp={tp}]",
                 message=(
                     f"per-device pool {sc['per_device_pool_bytes']} "
-                    f"B/trial at n_recv={sc['n_recv']} cannot fit a "
+                    f"B/trial at n_recv={sc['n_recv']} (+ ring comms "
+                    f"{sc['comms_buffer_bytes']} B) cannot fit a "
                     f"single trial per device under the v5e model "
                     f"({HBM_BYTES} B HBM, {HBM_RESERVE} B reserve, "
                     f"occupancy {POOL_OCCUPANCY}) — this mesh shape "

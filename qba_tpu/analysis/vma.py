@@ -45,6 +45,7 @@ BUILDER_NAMES = (
     "build_verdict_kernel",
     "build_rebuild_kernel",
     "build_fused_round_kernel",
+    "build_ring_gather",
 )
 
 _SENTINEL = frozenset({"__qba_lint_axis__"})

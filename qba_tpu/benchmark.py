@@ -28,7 +28,7 @@ NORTHSTAR = dict(n_parties=33, size_l=64, n_dishonest=10, trials=1000)
 NORTHSTAR_CHUNK = 1000
 
 
-def kernel_plan(cfg: QBAConfig) -> dict:
+def kernel_plan(cfg: QBAConfig, tp: int | None = None) -> dict:
     """Resolved per-kernel execution plan for benchmark attribution.
 
     One dict per config, embedded in the ``BENCH_r*.json`` rows so a
@@ -54,6 +54,19 @@ def kernel_plan(cfg: QBAConfig) -> dict:
       ``2 * n_rounds`` tiled, 0 XLA); the lint launch pin
       (:mod:`qba_tpu.analysis.launches`) proves this model against the
       traced jaxpr.
+
+    With ``tp`` set (a party-sharded run on a dp×tp mesh) three more
+    fields attribute the comms path, lifting the spmd demotions that
+    used to live only in recorded warnings into the artifact:
+
+    - ``tp``: the tp mesh width the row ran at.
+    - ``tp_engine``: the engine the party-sharded dispatch resolves
+      (``pallas_mega`` has no sharded variant — it demotes to
+      ``pallas_fused``, and ``tp_demoted_from`` records the original).
+    - ``tp_comms``: the resolved comms transport (``ring`` /
+      ``all_gather``, :func:`qba_tpu.parallel.ring.resolve_tp_comms`).
+    - ``tp_demoted_from``: the forced engine the sharded path demoted
+      away from, or None.
 
     Every field is a cached compile-probe verdict (or a static plan
     off-TPU), so calling this after a measurement re-reads the memoized
@@ -122,10 +135,29 @@ def kernel_plan(cfg: QBAConfig) -> dict:
         plan["launches_per_round"] = (
             1 if plan["fused_block"] is not None else 2
         )
+    if tp is not None:
+        import warnings as _warnings
+
+        from qba_tpu.parallel.ring import resolve_tp_comms
+        from qba_tpu.parallel.spmd import _resolve_spmd_engine
+
+        with _warnings.catch_warnings():
+            # The mega->fused demotion is recorded at dispatch; here it
+            # is being ATTRIBUTED, not re-announced.
+            _warnings.simplefilter("ignore")
+            tp_engine = _resolve_spmd_engine(cfg, cfg.n_lieutenants // tp)
+        plan["tp"] = tp
+        plan["tp_engine"] = tp_engine
+        plan["tp_comms"] = resolve_tp_comms(cfg)
+        plan["tp_demoted_from"] = (
+            cfg.round_engine
+            if cfg.round_engine not in ("auto", tp_engine)
+            else None
+        )
     return plan
 
 
-def engine_description(cfg: QBAConfig) -> str:
+def engine_description(cfg: QBAConfig, tp: int | None = None) -> str:
     """Engine attribution string for benchmark artifacts: the resolved
     round engine, plus the verdict-kernel variant when a tiled-family
     engine runs, plus the trial-packing factor on the fused path (e.g.
@@ -133,7 +165,20 @@ def engine_description(cfg: QBAConfig) -> str:
     ``BENCH_r*.json`` row can be tied to the kernel path that produced
     it (the round-6 accept-path split and the round-7 fusion/packing
     split make the engine name alone ambiguous across machines: both
-    are per-machine compile probes)."""
+    are per-machine compile probes).
+
+    With ``tp`` set the string names the party-sharded path instead —
+    ``"spmd[tp=4]/pallas_fused/ring"`` — including the lifted
+    mega demotion (``"spmd[tp=4]/pallas_fused(from mega)/ring"``), so
+    multichip rows attribute the comms transport, not just the
+    kernel."""
+    if tp is not None:
+        plan = kernel_plan(cfg, tp=tp)
+        tp_engine = plan["tp_engine"]
+        if plan["tp_demoted_from"] is not None:
+            short = plan["tp_demoted_from"].removeprefix("pallas_")
+            tp_engine = f"{tp_engine}(from {short})"
+        return f"spmd[tp={tp}]/{tp_engine}/{plan['tp_comms']}"
     plan = kernel_plan(cfg)
     engine = plan["engine"]
     if engine == "pallas_mega":

@@ -480,6 +480,22 @@ def _parser() -> argparse.ArgumentParser:
         "window",
     )
     fleet.add_argument(
+        "--mesh-dp", type=int, default=None,
+        help="dp width of the per-replica device mesh; with --mesh-tp, "
+        "admission prices against the SHARDED KI-2 ceiling "
+        "(default: single-chip pricing, or the mesh recorded in the "
+        "cache dir's plans.json)",
+    )
+    fleet.add_argument(
+        "--mesh-tp", type=int, default=None,
+        help="tp (party-sharding) width of the per-replica device mesh",
+    )
+    fleet.add_argument(
+        "--tp-comms", default="ring", choices=("ring", "all_gather"),
+        help="comms transport the sharded admission ceiling prices "
+        "(ring = the round-9 remote-DMA default)",
+    )
+    fleet.add_argument(
         "--poll-s", type=float, default=0.05,
         help="worker inbox poll interval (the front-end outbox poll "
         "runs at a fixed 20ms)",
@@ -1183,6 +1199,24 @@ def _cmd_fleet(args: argparse.Namespace, out) -> int:
         write_fleet_summary,
     )
 
+    # Mesh for sharded admission pricing: explicit flags win; otherwise
+    # the mesh recorded in the warm-start artifact (the plans were
+    # captured under it, so the priced ceiling matches what dispatch
+    # will actually see).
+    mesh_shape = None
+    tp_comms = args.tp_comms
+    if args.mesh_dp is not None or args.mesh_tp is not None:
+        mesh_shape = (args.mesh_dp or 1, args.mesh_tp or 1)
+    elif args.cache_dir:
+        from qba_tpu.serve.persist import saved_mesh
+
+        recorded = saved_mesh(args.cache_dir)
+        if recorded is not None:
+            mesh_shape = (
+                int(recorded.get("dp", 1)), int(recorded.get("tp", 1))
+            )
+            tp_comms = recorded.get("tp_comms", tp_comms)
+
     admission = None
     if not args.no_admission:
         admission = AdmissionController(
@@ -1190,6 +1224,8 @@ def _cmd_fleet(args: argparse.Namespace, out) -> int:
             replicas=args.replicas,
             capacity_trials=args.capacity_trials,
             window_chunks=args.window_chunks,
+            mesh_shape=mesh_shape,
+            tp_comms=tp_comms,
         )
     pool = ReplicaPool(
         args.queue_dir,
@@ -1271,6 +1307,20 @@ def _cmd_fleet(args: argparse.Namespace, out) -> int:
         self_healing=supervisor.summary() if supervisor else None,
     )
     summary["replica_exit_codes"] = codes
+    if args.cache_dir and mesh_shape is not None:
+        # Record the pricing mesh in the warm-start artifact so the
+        # next boot admits against the same sharded ceiling without
+        # re-passing the flags.
+        from qba_tpu.serve.persist import save_plans
+
+        save_plans(
+            args.cache_dir,
+            mesh={
+                "dp": mesh_shape[0],
+                "tp": mesh_shape[1],
+                "tp_comms": tp_comms,
+            },
+        )
     path = write_fleet_summary(args.queue_dir, summary)
     print(json.dumps({"fleet_summary": path}), file=sys.stderr)
     return 0

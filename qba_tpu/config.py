@@ -73,6 +73,16 @@ class QBAConfig:
         tests/test_round_kernel_tiled.py,
         tests/test_round_kernel_fused.py,
         tests/test_trial_megakernel.py).
+      tp_comms: per-round communication path of the party-sharded
+        (dp × tp) engine (:mod:`qba_tpu.parallel.spmd`): "auto"
+        (default — the double-buffered neighbor-ring shuffle, the
+        KI-2-friendly hot path since round 9), "ring" (force the ring:
+        ``pltpu.make_async_remote_copy`` remote DMA on TPU, a masked
+        ``lax.ppermute`` ring off-TPU — bit-identical by construction),
+        or "all_gather" (force the legacy one-collective w-wide gather
+        — the escape hatch, and the bit-identity reference the ring is
+        pinned against in tests/test_parallel.py).  Ignored outside
+        ``run_trials_spmd``.
       tiled_block: explicit packet-block size for the tiled engine
         (must divide ``n_lieutenants * slots``); None = probe-chosen.
       trial_pack: explicit trial-pack factor ``k`` for the fused round
@@ -165,6 +175,7 @@ class QBAConfig:
     p_depolarize: float = 0.0
     p_measure_flip: float = 0.0
     racy_mode: str = "loss"
+    tp_comms: str = "auto"
     tiled_block: int | None = None
     trial_pack: int | None = None
     max_evidence_rows: int | None = None
@@ -205,6 +216,11 @@ class QBAConfig:
             "pallas_mega",
         ):
             raise ValueError(f"unknown round_engine {self.round_engine!r}")
+        if self.tp_comms not in ("auto", "ring", "all_gather"):
+            raise ValueError(
+                f"unknown tp_comms {self.tp_comms!r}; expected 'auto', "
+                "'ring', or 'all_gather'"
+            )
         if self.tiled_block is not None:
             n_pool = self.n_lieutenants * self.slots
             if self.tiled_block < 1 or n_pool % self.tiled_block:

@@ -1,0 +1,158 @@
+"""Remote-DMA ring all-gather kernel for the party-sharded engine.
+
+The TPU transport behind ``tp_comms="ring"``
+(:mod:`qba_tpu.parallel.ring` holds the schedule contract and the
+off-TPU ``ppermute`` twin): one ``pallas_call`` per round moves every
+device's pool shard around the tp ring as ``tp - 1`` asynchronous
+neighbor hops (``pltpu.make_async_remote_copy``), double-buffered
+through a 2-slot VMEM scratch so hop ``k+1``'s send can overlap hop
+``k``'s consumption.  Only ``min(2, tp - 1)`` remote shards are ever
+resident next to the local pool — the comms term the sharded KI-2
+budget model prices (:func:`qba_tpu.analysis.memory.comms_buffer_bytes`)
+— where the ``all_gather`` path transiently materializes all
+``tp - 1`` remote shards at once.
+
+Hop schedule (the neighbor-ring pattern of SNIPPETS.md [1]/[2] and the
+accelerator guide): at step ``k`` every device forwards the shard it
+received at step ``k - 1`` (its own at ``k = 0``) to the right
+neighbor ``(my + 1) % tp`` and deposits the shard arriving from the
+left — which originated at device ``(my - k - 1) % tp`` — at that
+owner's global offset.  The assembled output is the shards
+concatenated in tp order, i.e. bit-identical to
+``jax.lax.all_gather(x, "tp", tiled=True)``.
+
+This module is TPU-only by construction: remote DMA has no interpret
+path across an emulated CPU mesh, so :mod:`qba_tpu.parallel.spmd`
+builds it only when ``jax.default_backend() == "tpu"`` and CPU tests
+exercise the ``ppermute`` twin instead.  A dispatch-time failure under
+``tp_comms="auto"`` demotes to the ``all_gather`` escape hatch with a
+recorded warning (``run_trials_spmd``), never silently.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from qba_tpu.ops.round_kernel import CompilerParams, vma_struct
+
+
+def _ring_kernel_body(
+    local_ref, out_ref, comm_ref, send_sem, recv_sem,
+    *, n_tp: int, axis_name: str, mesh_axes: tuple[str, ...],
+):
+    """One device's side of the ring: barrier with both neighbors (their
+    comm slots must exist before anyone starts a remote write), then
+    ``n_tp - 1`` double-buffered hops."""
+    my_tp = jax.lax.axis_index(axis_name)
+    chunk = local_ref.shape[0]
+
+    def coords(tp_idx):
+        # Mesh-coordinate device id: every non-tp axis keeps this
+        # device's own index (the ring never leaves its tp row).
+        return tuple(
+            tp_idx if a == axis_name else jax.lax.axis_index(a)
+            for a in mesh_axes
+        )
+
+    right = jax.lax.rem(my_tp + 1, n_tp)
+    left = jax.lax.rem(my_tp + n_tp - 1, n_tp)
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=coords(left),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=coords(right),
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+    # Own shard: straight into the output at this device's offset, and
+    # into the first send slot.
+    out_ref[pl.ds(my_tp * chunk, chunk)] = local_ref[...]
+    comm_ref[0] = local_ref[...]
+
+    for step in range(n_tp - 1):
+        send_slot = step % 2
+        recv_slot = (step + 1) % 2
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_ref.at[send_slot],
+            dst_ref=comm_ref.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=coords(right),
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+        rdma.start()
+        rdma.wait()
+        # The shard now in recv_slot originated step+1 hops to the left.
+        src_dev = jax.lax.rem(my_tp + n_tp - step - 1, n_tp)
+        out_ref[pl.ds(src_dev * chunk, chunk)] = comm_ref[recv_slot]
+
+
+def build_ring_gather(
+    n_tp: int,
+    *,
+    axis_name: str = "tp",
+    mesh_axes: tuple[str, ...] = ("dp", "tp"),
+    out_vma: frozenset | None = None,
+    collective_id: int = 0,
+):
+    """Build ``gather(x, axis=0)``: the remote-DMA ring equivalent of
+    ``jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)``.
+
+    ``out_vma`` follows the KI-1 threading contract
+    (:mod:`qba_tpu.analysis.vma`): the gathered output varies over the
+    mesh axes it names (value-replicated over tp, but only the psum
+    recombination downstream *proves* replication to the checker).
+    Booleans ride as int32 (remote DMA moves word-aligned planes) and
+    are cast back on arrival.  One launch gathers one array; the spmd
+    round body calls it per pool leaf, so the KI-5 launch model counts
+    ``leaves x n_rounds`` ring launches per trial
+    (:func:`qba_tpu.analysis.launches.spmd_launches_per_trial`).
+    """
+    if n_tp < 1:
+        raise ValueError(f"n_tp must be >= 1, got {n_tp}")
+    if axis_name not in mesh_axes:
+        raise ValueError(
+            f"axis_name {axis_name!r} not in mesh_axes {mesh_axes!r}"
+        )
+
+    def gather(x: jax.Array, axis: int = 0) -> jax.Array:
+        if n_tp == 1:
+            return x
+        moved = jnp.moveaxis(x, axis, 0)
+        was_bool = moved.dtype == jnp.bool_
+        work = moved.astype(jnp.int32) if was_bool else moved
+        chunk = work.shape[0]
+        out_dims = (n_tp * chunk,) + work.shape[1:]
+        ring = pl.pallas_call(
+            lambda lr, orf, cr, ss, rs: _ring_kernel_body(
+                lr, orf, cr, ss, rs,
+                n_tp=n_tp, axis_name=axis_name, mesh_axes=mesh_axes,
+            ),
+            # No grid and no explicit block specs: the shard and the
+            # gathered output are whole-array VMEM residents (the
+            # kernel stores into out_ref directly; shard sizes are
+            # MB-scale at every planned shape — the KI-2 plan audit
+            # prices them via comms_buffer_bytes).
+            out_shape=vma_struct(out_vma, out_dims, work.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((2, chunk) + work.shape[1:], work.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            compiler_params=CompilerParams(
+                has_side_effects=True, collective_id=collective_id,
+            ),
+        )
+        out = ring(work)
+        if was_bool:
+            out = out != 0
+        return jnp.moveaxis(out, 0, axis)
+
+    return gather

@@ -10,9 +10,11 @@ hardware:
   reduction.
 * ``tp`` — protocol parties (lieutenants): the round-engine analog of
   tensor parallelism; each device owns a contiguous block of lieutenants
-  and the per-round mailbox exchange is an ``all_gather`` over this axis
-  (see :mod:`qba_tpu.parallel.spmd`) — the collective that replaces the
-  reference's point-to-point ``Isend``/``Irecv`` traffic
+  and the per-round mailbox exchange is a neighbor-ring shuffle over
+  this axis (remote DMA on TPU, ``ppermute`` off-TPU — see
+  :mod:`qba_tpu.parallel.ring`; ``tp_comms="all_gather"`` keeps the
+  one-shot collective as the escape hatch) — the traffic that replaces
+  the reference's point-to-point ``Isend``/``Irecv`` exchange
   (``tfg.py:199-263``).
 * ``sp`` — list positions (``sizeL``, the protocol's sequence axis,
   SURVEY §5 "Long-context"): i.i.d. positions shard cleanly; XLA inserts

@@ -1,0 +1,82 @@
+"""Neighbor-ring comms for the party-sharded (dp × tp) engine.
+
+The round-9 KI-2 story (docs/PERF.md round 9, docs/KNOWN_ISSUES.md
+KI-2): the per-round traffic of :mod:`qba_tpu.parallel.spmd` used to be
+one ``jax.lax.all_gather`` over ``tp`` — every device transiently
+materializes the FULL mailbox pool, so the per-device footprint carried
+a ``(tp - 1) x shard`` comms term that eats the linear-in-tp ceiling
+the sharding buys.  The ring shuffle replaces it with ``tp - 1``
+neighbor hops through a double-buffered pair of shard-sized slots:
+each step every device forwards the shard it last received to its
+right neighbor and consumes the one arriving from the left, so at any
+instant only ``min(2, tp - 1)`` remote shards are resident next to the
+local pool.
+
+Two transports realize the same schedule:
+
+* **TPU** — the Pallas ``pltpu.make_async_remote_copy`` remote-DMA
+  kernel (:mod:`qba_tpu.ops.ring_shuffle`), the hot path;
+* **off-TPU** (CPU-mesh tests, the multichip dryrun) — the masked
+  ``jax.lax.ppermute`` ring in :func:`ring_gather` below, which stages
+  the identical hop schedule through XLA collectives.
+
+Both are BIT-IDENTICAL to ``jax.lax.all_gather(x, "tp", tiled=True)``
+by construction: hop ``k`` delivers the shard of device
+``(my_id - k - 1) mod tp`` and writes it at that device's global
+offset, so the assembled buffer is the shards concatenated in tp
+order — exactly the tiled gather.  tests/test_parallel.py pins the
+equality across engines, party counts, strategies and noise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from qba_tpu.config import QBAConfig
+
+#: The resolved comms vocabulary ("auto" resolves to one of these).
+TP_COMMS_CHOICES = ("ring", "all_gather")
+
+
+def resolve_tp_comms(cfg: QBAConfig) -> str:
+    """The comms path the party-sharded engine will use: forced values
+    pass through; ``auto`` picks the ring (the KI-2-friendly hot path
+    since round 9 — remote DMA on TPU, the ``ppermute`` ring off-TPU).
+    ``all_gather`` stays available as the explicit escape hatch and as
+    the bit-identity reference."""
+    if cfg.tp_comms in TP_COMMS_CHOICES:
+        return cfg.tp_comms
+    return "ring"
+
+
+def ring_gather(x: jax.Array, n_tp: int, axis: int = 0,
+                axis_name: str = "tp") -> jax.Array:
+    """All-gather over ``axis_name`` as ``n_tp - 1`` neighbor ring hops.
+
+    Runs inside ``shard_map``.  Each hop forwards the previously
+    received shard to the right neighbor (``ppermute`` with the masked
+    cyclic permutation) while depositing the arriving shard at its
+    owner's global offset, double-buffer style: the carry holds exactly
+    one in-flight shard next to the assembled output.  The result
+    equals ``jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)``
+    bit-for-bit (the property tests/test_parallel.py pins), it is just
+    staged as neighbor traffic — which is what the TPU remote-DMA
+    kernel (:mod:`qba_tpu.ops.ring_shuffle`) turns into overlap-able
+    ICI hops with O(shard) resident comms buffers.
+    """
+    if n_tp == 1:
+        return x
+    my_id = jax.lax.axis_index(axis_name)
+    shard = x.shape[axis]
+    out_shape = list(x.shape)
+    out_shape[axis] = shard * n_tp
+    out = jnp.zeros(tuple(out_shape), x.dtype)
+    out = jax.lax.dynamic_update_slice_in_dim(out, x, my_id * shard, axis)
+    perm = [(i, (i + 1) % n_tp) for i in range(n_tp)]
+    buf = x
+    for step in range(n_tp - 1):
+        buf = jax.lax.ppermute(buf, axis_name, perm)
+        src = jax.lax.rem(my_id - step - 1 + n_tp, n_tp)
+        out = jax.lax.dynamic_update_slice_in_dim(out, buf, src * shard, axis)
+    return out

@@ -5,11 +5,23 @@ packet triggers ``nParties-2`` tagged ``Isend`` chains and every
 lieutenant drains its queue with ``Iprobe`` (``tfg.py:199-263,337-348``).
 Here the lieutenants themselves shard over the mesh's ``tp`` axis: each
 device owns a contiguous block of lieutenants (their particle lists,
-accepted-sets, and outgoing mailbox rows), and one ``jax.lax.all_gather``
-over ``tp`` per voting round materializes the full mailbox on every
-device — the entire round's traffic as a single XLA collective riding ICI
-instead of O(nParties²) tagged messages.  Trials shard over ``dp`` as
-usual.
+accepted-sets, and outgoing mailbox rows), and per-round communication
+assembles the full mailbox on every device.  Two comms paths realize
+that assembly (``cfg.tp_comms``; :mod:`qba_tpu.parallel.ring`):
+
+* ``"ring"`` (the default since round 9) — a double-buffered neighbor
+  ring shuffle: ``tp - 1`` hops through 2 shard-sized slots, remote
+  DMA on TPU (:mod:`qba_tpu.ops.ring_shuffle`), a masked
+  ``jax.lax.ppermute`` ring off-TPU.  Only O(shard) comms bytes are
+  resident per hop, which is what makes the KI-2 trial ceiling scale
+  ~linearly in tp (docs/KNOWN_ISSUES.md KI-2).
+* ``"all_gather"`` — one ``jax.lax.all_gather`` over ``tp`` per voting
+  round: a single XLA collective riding ICI instead of O(nParties²)
+  tagged messages, but every device transiently materializes all
+  ``tp - 1`` remote shards at once.  The escape hatch, and the
+  bit-identity reference the ring is pinned against.
+
+Trials shard over ``dp`` as usual, composing the true 2-D dp × tp mesh.
 
 Numerically identical to the single-device engine for the same keys
 (enforced by tests/test_parallel.py): the per-round attack draws are the
@@ -32,6 +44,7 @@ from qba_tpu.backends.jax_backend import MonteCarloResult, aggregate, trial_keys
 from qba_tpu.config import QBAConfig
 from qba_tpu.diagnostics import QBADemotionWarning, warn_and_record
 from qba_tpu.parallel.mesh import axis_sizes, require_divisible
+from qba_tpu.parallel.ring import resolve_tp_comms, ring_gather
 from qba_tpu.rounds import Mailbox, TrialResult
 from qba_tpu.rounds.engine import (
     ProtocolCounters,
@@ -75,6 +88,45 @@ def _tiled_check_vma() -> bool:
     return jax.default_backend() == "tpu"  # interpret mode: off
 
 
+def _make_gather_tp(
+    n_tp: int,
+    comms: str,
+    vma_axes: frozenset | None,
+    mesh_axes: tuple[str, ...],
+):
+    """The per-round tp assembly primitive, resolved once per trace:
+    ``gather_tp(x, axis)`` == ``all_gather(x, "tp", axis, tiled=True)``
+    bit-for-bit on every path — only the traffic pattern differs.
+
+    ``"ring"`` on TPU is the remote-DMA kernel
+    (:mod:`qba_tpu.ops.ring_shuffle`; one launch per pool leaf per
+    round, counted by the KI-5 launch model); off-TPU it is the
+    ``ppermute`` ring — bit-identical, and the only transport an
+    emulated CPU mesh can execute (remote DMA has no interpret path).
+    """
+    if comms == "ring" and jax.default_backend() == "tpu":
+        from qba_tpu.ops.ring_shuffle import build_ring_gather
+
+        ring = build_ring_gather(
+            n_tp, axis_name="tp", mesh_axes=mesh_axes, out_vma=vma_axes,
+        )
+
+        def gather_tp(x, axis=0):
+            return ring(x, axis=axis)
+
+    elif comms == "ring":
+
+        def gather_tp(x, axis=0):
+            return ring_gather(x, n_tp, axis=axis)
+
+    else:
+
+        def gather_tp(x, axis=0):
+            return jax.lax.all_gather(x, "tp", axis=axis, tiled=True)
+
+    return gather_tp
+
+
 def _trial_party_sharded(
     cfg: QBAConfig,
     n_tp: int,
@@ -82,6 +134,8 @@ def _trial_party_sharded(
     engine: str = "xla",
     vma_axes: frozenset | None = None,
     tiled_out_vma: frozenset | None = None,
+    comms: str = "all_gather",
+    mesh_axes: tuple[str, ...] = ("dp", "tp"),
 ) -> TrialResult:
     """One trial with lieutenants sharded over the bound ``tp`` mesh axis.
 
@@ -114,12 +168,12 @@ def _trial_party_sharded(
     )
     mb_local = Mailbox(*out_cells)
 
-    def gather_tp(x, axis=0):
-        return jax.lax.all_gather(x, "tp", axis=axis, tiled=True)
+    gather_tp = _make_gather_tp(n_tp, comms, vma_axes, mesh_axes)
 
-    # Step 3b (tfg.py:337-348): each round's traffic = one all_gather of
-    # the local mailbox rows over tp (replaces the reference's Isend
-    # storm + Iprobe drain + Barrier).  Four bit-identical engines,
+    # Step 3b (tfg.py:337-348): each round's traffic = one tp assembly
+    # of the local mailbox rows (ring shuffle or all_gather — see
+    # _make_gather_tp; both replace the reference's Isend storm +
+    # Iprobe drain + Barrier).  Four bit-identical engines,
     # like the single-device path: vectorized XLA, the fused monolithic
     # Pallas round kernel, the packet-tiled kernel pair, or the fused
     # single-launch round kernel — each in a party-sharded variant
@@ -210,7 +264,8 @@ def _trial_party_sharded(
                 n_local=n_local,
             )
             return _trial_party_sharded(
-                cfg, n_tp, key, "pallas_tiled", vma_axes, tiled_out_vma
+                cfg, n_tp, key, "pallas_tiled", vma_axes, tiled_out_vma,
+                comms, mesh_axes,
             )
         fused = build_fused_round_kernel(
             cfg, blk_d, blk_v, interpret=interpret, n_recv=n_local,
@@ -428,30 +483,35 @@ def _merge_counters_tp(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 3, 4))
+@functools.partial(jax.jit, static_argnums=(0, 1, 3, 4, 5))
 def _spmd_batch(
     cfg: QBAConfig,
     mesh: Mesh,
     keys: jax.Array,
     engine: str = "xla",
     check_vma: bool = True,
+    comms: str = "all_gather",
 ) -> TrialResult:
     """``check_vma`` must be resolved by the CALLER (see
     :func:`_resolve_check_vma`) so it participates in the jit cache
     key: resolved inside the traced body, toggling the
     ``QBA_TILED_CHECK_VMA`` escape hatch after a first compile would be
     silently ignored by the cache — which would, among other things,
-    turn the hardware canary's decisive step into a false pass."""
+    turn the hardware canary's decisive step into a false pass.
+    ``comms`` is resolved by the caller too (same cache-key argument;
+    :func:`qba_tpu.parallel.ring.resolve_tp_comms`)."""
     n_tp = axis_sizes(mesh)["tp"]
     key_spec = P("dp") if "dp" in mesh.axis_names else P()
 
     vma_axes = frozenset(mesh.axis_names)
     tiled_out_vma = vma_axes if check_vma else None
+    mesh_axes = tuple(mesh.axis_names)
 
     def body(local_keys):
         return jax.vmap(
             lambda k: _trial_party_sharded(
-                cfg, n_tp, k, engine, vma_axes, tiled_out_vma
+                cfg, n_tp, k, engine, vma_axes, tiled_out_vma, comms,
+                mesh_axes,
             )
         )(local_keys)
 
@@ -521,33 +581,46 @@ def run_trials_spmd(
     require_divisible(keys.shape[0], dp, "trials", "dp")
     require_divisible(cfg.n_lieutenants, tp, "n_lieutenants", "tp")
     engine = _resolve_spmd_engine(cfg, cfg.n_lieutenants // tp)
+    comms = resolve_tp_comms(cfg)
     try:
         return aggregate(
-            _spmd_batch(cfg, mesh, keys, engine, _resolve_check_vma(engine))
+            _spmd_batch(
+                cfg, mesh, keys, engine, _resolve_check_vma(engine), comms
+            )
         )
     except Exception as e:
         # The residual probe-context gap (ADVICE r2 item 1): the kernel
         # probes compile standalone, not under the vma-annotated
         # shard_map context the real call uses, so a probe-pass /
-        # shard_map-fail config can still surface here.  When the
-        # engine was AUTO-selected, degrade loudly to the XLA branch;
-        # an explicitly forced engine re-raises (an explicit knob never
-        # silently means something weaker, docs/DIVERGENCES.md D1).
-        if engine == "xla" or cfg.round_engine != "auto":
+        # shard_map-fail config can still surface here — and the ring
+        # kernel adds a comms dimension to the same gap (remote DMA has
+        # no compile probe at all).  AUTO-selected knobs degrade loudly
+        # to their conservative values — the XLA engine, the all_gather
+        # collective; an explicitly forced knob re-raises (an explicit
+        # knob never silently means something weaker,
+        # docs/DIVERGENCES.md D1).
+        fb_engine = engine if cfg.round_engine != "auto" else "xla"
+        fb_comms = comms if cfg.tp_comms != "auto" else "all_gather"
+        if (fb_engine, fb_comms) == (engine, comms):
             raise
         warn_and_record(
-            f"party-sharded '{engine}' round engine failed under "
-            f"shard_map despite a passing compile probe; falling back "
-            f"to the XLA spmd engine: {e!r:.500}",
+            f"party-sharded ({engine!r}, {comms!r}) dispatch failed "
+            f"under shard_map; falling back to ({fb_engine!r}, "
+            f"{fb_comms!r}): {e!r:.500}",
             QBADemotionWarning,
             site="parallel.spmd.run_trials_spmd",
             stacklevel=2,
             engine_from=engine,
-            engine_to="xla",
+            engine_to=fb_engine,
+            comms_from=comms,
+            comms_to=fb_comms,
             error=repr(e)[:500],
         )
         return aggregate(
-            _spmd_batch(cfg, mesh, keys, "xla", _resolve_check_vma("xla"))
+            _spmd_batch(
+                cfg, mesh, keys, fb_engine, _resolve_check_vma(fb_engine),
+                fb_comms,
+            )
         )
 
 

@@ -83,11 +83,27 @@ class AdmissionController:
         capacity_trials: int | None = None,
         window_chunks: int = 8,
         hbm_bytes: int | None = None,
+        mesh_shape: tuple[int, int] | None = None,
+        tp_comms: str = "ring",
     ) -> None:
         if chunk_trials < 1:
             raise ValueError(f"chunk_trials must be >= 1, got {chunk_trials}")
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if mesh_shape is not None:
+            dp, tp = mesh_shape
+            if dp < 1 or tp < 1:
+                raise ValueError(
+                    f"mesh_shape must be (dp >= 1, tp >= 1), got {mesh_shape}"
+                )
+            mesh_shape = (dp, tp)
+        if tp_comms not in ("ring", "all_gather"):
+            raise ValueError(
+                f"unknown tp_comms {tp_comms!r}; expected 'ring' or "
+                "'all_gather'"
+            )
+        self.mesh_shape = mesh_shape
+        self.tp_comms = tp_comms
         self.chunk_trials = chunk_trials
         self.replicas = replicas
         self.capacity_trials = (
@@ -131,19 +147,39 @@ class AdmissionController:
 
     def _ceiling(self, req) -> int:
         """KI-2 trial ceiling for the request's shape bucket, memoized
-        per bucket label (the ceiling is pure shape arithmetic)."""
-        from qba_tpu.analysis.memory import HBM_BYTES, trial_ceiling
+        per bucket label (the ceiling is pure shape arithmetic).
+
+        On a dp×tp mesh the admissible batch is the SHARDED ceiling
+        (:func:`qba_tpu.analysis.memory.sharded_trial_ceiling` at this
+        controller's comms transport): a shape whose full pool busts
+        one chip may still be servable party-sharded, and conversely
+        the comms transient makes the per-device number smaller than
+        the naive ``trial_ceiling / tp`` split.  A shape tp does not
+        divide falls back to the single-chip price (the scheduler runs
+        it unsharded)."""
+        from qba_tpu.analysis.memory import (
+            HBM_BYTES,
+            sharded_trial_ceiling,
+            trial_ceiling,
+        )
         from qba_tpu.serve.scheduler import bucket_config, bucket_label
 
         bucket = bucket_config(req.config(), self.chunk_trials)
         label = bucket_label(bucket)
         if label not in self._ceilings:
-            self._ceilings[label] = trial_ceiling(
-                bucket,
-                hbm_bytes=(
-                    self.hbm_bytes if self.hbm_bytes is not None else HBM_BYTES
-                ),
-            )
+            hbm = self.hbm_bytes if self.hbm_bytes is not None else HBM_BYTES
+            if (
+                self.mesh_shape is not None
+                and self.mesh_shape[1] > 1
+                and bucket.n_lieutenants % self.mesh_shape[1] == 0
+            ):
+                dp, tp = self.mesh_shape
+                self._ceilings[label] = sharded_trial_ceiling(
+                    bucket, dp=dp, tp=tp, hbm_bytes=hbm,
+                    comms=self.tp_comms,
+                )["mesh_trials"]
+            else:
+                self._ceilings[label] = trial_ceiling(bucket, hbm_bytes=hbm)
         return self._ceilings[label]
 
     # ---- the decision ------------------------------------------------
@@ -172,12 +208,18 @@ class AdmissionController:
                 REJECT, "invalid_request", rid, detail=str(e), record=record
             )
         if ceiling < self.chunk_trials:
+            where = (
+                f"the (dp={self.mesh_shape[0]}, tp={self.mesh_shape[1]}) "
+                f"mesh under {self.tp_comms} comms"
+                if self.mesh_shape is not None
+                else "one device"
+            )
             return self._decide(
                 REJECT, "unservable_shape", rid, bucket=label, priced=priced,
                 detail=(
                     f"KI-2 trial ceiling {ceiling} < chunk_trials "
-                    f"{self.chunk_trials}: one device chunk of this shape "
-                    "exhausts HBM"
+                    f"{self.chunk_trials}: one chunk of this shape "
+                    f"exhausts HBM on {where}"
                 ),
                 record=record,
             )
@@ -281,4 +323,8 @@ class AdmissionController:
             "outstanding_trials": self.outstanding_trials,
             "released_trials": self.released_trials,
             "bucket_ceilings": dict(self._ceilings),
+            "mesh_shape": (
+                list(self.mesh_shape) if self.mesh_shape is not None else None
+            ),
+            "tp_comms": self.tp_comms,
         }

@@ -126,12 +126,21 @@ def _merge_states(
 
 
 def save_plans(
-    cache_dir: str | None, configs: list[QBAConfig] | None = None
+    cache_dir: str | None,
+    configs: list[QBAConfig] | None = None,
+    mesh: dict[str, Any] | None = None,
 ) -> str:
     """Write ``plans.json`` under ``cache_dir`` from the live resolver
     caches, merged with whatever is already on disk (lock + unique
     temp + atomic rename: concurrent replica flushes interleave to the
-    union, never a torn or clobbered file).  Returns the path written."""
+    union, never a torn or clobbered file).  Returns the path written.
+
+    ``mesh`` (e.g. ``{"dp": 2, "tp": 4, "tp_comms": "ring"}``) records
+    the fleet mesh the plans were captured under, so the next boot's
+    admission controller prices against the SHARDED KI-2 ceiling the
+    warm-started plans assume rather than the single-chip one.  A save
+    without ``mesh`` preserves whatever the artifact already
+    records."""
     from qba_tpu.ops.round_kernel_tiled import export_resolver_state
 
     path = plans_path(cache_dir)
@@ -148,10 +157,13 @@ def save_plans(
             for entry in prior.get("configs", []):
                 if entry not in seen:
                     seen.append(entry)
+            if mesh is None:
+                mesh = prior.get("mesh")
         payload = {
             "schema": PLANS_SCHEMA,
             "resolver_state": state,
             "configs": seen,
+            "mesh": mesh,
         }
         # Writer-unique temp name: two processes racing a shared
         # ".tmp" would interleave writes into one file before the
@@ -207,3 +219,16 @@ def saved_configs(path: str) -> list[QBAConfig]:
     for entry in payload.get("configs", []):
         configs.append(QBAConfig(**entry))
     return configs
+
+
+def saved_mesh(cache_dir: str | None) -> dict[str, Any] | None:
+    """The fleet mesh recorded in ``cache_dir``'s ``plans.json``
+    (``{"dp": ..., "tp": ..., "tp_comms": ...}``), or None when the
+    artifact is absent, pre-mesh, or unreadable — warm-start metadata
+    is best-effort like :func:`load_plans`."""
+    with plans_lock(cache_dir):
+        payload = _read_payload(plans_path(cache_dir))
+    if payload is None:
+        return None
+    mesh = payload.get("mesh")
+    return mesh if isinstance(mesh, dict) else None

@@ -239,31 +239,66 @@ def test_serve_dispatch_proof_flags_reordered(tmp_path):
 
 def test_sharded_ceiling_reduces_to_single_chip():
     ns = QBAConfig(33, 64, 10)
-    sc = sharded_trial_ceiling(ns, dp=1, tp=1)
-    assert sc["per_device_trials"] == trial_ceiling(ns)
-    assert sc["mesh_trials"] == trial_ceiling(ns)
+    for comms in ("ring", "all_gather"):
+        sc = sharded_trial_ceiling(ns, dp=1, tp=1, comms=comms)
+        assert sc["comms_buffer_bytes"] == 0
+        assert sc["per_device_trials"] == trial_ceiling(ns)
+        assert sc["mesh_trials"] == trial_ceiling(ns)
 
 
 def test_sharded_north_star_budgets():
-    """Pins BOTH bands: the measured single-chip north-star band and
-    the (dp=2, tp=4) per-device prediction derived from it."""
+    """Pins the bands: the measured single-chip north-star band and
+    the sharded per-device predictions derived from it, for both
+    comms transports (the ring's constant-multiplier footprint is THE
+    round-9 KI-2 claim — at tp=8 it more than doubles the all_gather
+    ceiling)."""
     ns = QBAConfig(33, 64, 10)
     lo, hi = NORTH_STAR_CEILING_BAND
     assert lo <= trial_ceiling(ns) <= hi
     sc = sharded_trial_ceiling(ns, dp=2, tp=4)
+    assert sc["comms"] == "ring"
     assert sc["n_recv"] == 8
     assert sc["per_device_pool_bytes"] == 2228224
-    assert sc["per_device_trials"] == 4577
-    assert sc["mesh_trials"] == 9154
+    assert sc["comms_buffer_bytes"] == 2 * 2228224
+    assert sc["per_device_trials"] == 1961
+    assert sc["mesh_trials"] == 3922
+    ag = sharded_trial_ceiling(ns, dp=2, tp=4, comms="all_gather")
+    assert ag["comms_buffer_bytes"] == 3 * 2228224
+    assert ag["per_device_trials"] == 1525
+    # Full-width shard of this container's 8 devices.
+    r8 = sharded_trial_ceiling(ns, dp=1, tp=8)
+    ag8 = sharded_trial_ceiling(ns, dp=1, tp=8, comms="all_gather")
+    assert r8["per_device_trials"] == 3923
+    assert ag8["per_device_trials"] == 1615
+
+
+def test_sharded_ring_ceiling_scales_linearly():
+    """Acceptance pin: above the comms floor (tp >= 3, where the
+    ring's resident slot pair saturates at 2 shards) the per-device
+    ceiling under the ring model scales ~linearly in tp — doubling tp
+    doubles trials/device within 10%."""
+    ns = QBAConfig(33, 64, 10)
+    c4 = sharded_trial_ceiling(ns, tp=4)["per_device_trials"]
+    c8 = sharded_trial_ceiling(ns, tp=8)["per_device_trials"]
+    assert abs(c8 / c4 - 2.0) <= 0.2
+    # all_gather does NOT scale: its transient grows with tp.
+    a4 = sharded_trial_ceiling(ns, tp=4, comms="all_gather")
+    a8 = sharded_trial_ceiling(ns, tp=8, comms="all_gather")
+    assert a8["per_device_trials"] / a4["per_device_trials"] < 1.5
 
 
 def test_sharded_budget_notes_emitted():
     report = check_memory(CHEAP)
     assert report.ok, report.render()
-    assert report.stats["sharded_meshes_checked"] == 1
+    assert report.stats["sharded_meshes_checked"] == 2
     assert any("sharded-hbm[dp=2,tp=4]" in n for n in report.notes)
-    # The per-device plan audit ran at the tp=4 shard.
+    assert any("sharded-hbm[dp=1,tp=8]" in n for n in report.notes)
+    # Every sharded note carries the all_gather counterfactual.
+    assert all("all_gather comms would cap" in n
+               for n in report.notes if "sharded-hbm[" in n)
+    # The per-device plan audit ran at the tp=4 and tp=8 shards.
     assert any(n.startswith("spmd[tp=4]/") for n in report.notes)
+    assert any(n.startswith("spmd[tp=8]/") for n in report.notes)
 
 
 def test_sharded_mesh_skip_note_when_indivisible():

@@ -132,6 +132,51 @@ def test_admission_decision_sequence_is_deterministic():
     assert first[-1]["outstanding_trials"] == 8
 
 
+def test_admission_mesh_pricing_breaks_the_memory_wall():
+    # Round 9: a 65p (w=128) shape the KI-2 model proves cannot fit one
+    # emulated chip is REJECTED by a single-chip controller but ADMITTED
+    # by one pricing against the (dp=1, tp=8) ring-sharded ceiling —
+    # admission and execution agree on what the mesh can hold.
+    from qba_tpu.analysis.memory import HBM_RESERVE
+
+    hbm = HBM_RESERVE + (16 << 20)
+    big = _req("big", n=65, L=32, d=2, trials=2)
+    flat = _controller(chunk_trials=2, hbm_bytes=hbm)
+    dec = flat.try_admit(big)
+    assert (dec.action, dec.reason) == (REJECT, "unservable_shape")
+    assert "one device" in dec.detail
+
+    sharded = _controller(
+        chunk_trials=2, hbm_bytes=hbm, mesh_shape=(1, 8), tp_comms="ring"
+    )
+    dec = sharded.try_admit(_req("big", n=65, L=32, d=2, trials=2))
+    assert (dec.action, dec.reason) == (ADMIT, "capacity_available")
+    s = sharded.summary()
+    assert s["mesh_shape"] == [1, 8]
+    assert s["tp_comms"] == "ring"
+
+    # Oversharded even on the mesh: the reject detail names the mesh
+    # and comms the shape was priced against, not "one device".
+    tiny = _controller(
+        chunk_trials=2, hbm_bytes=HBM_RESERVE + (1 << 20),
+        mesh_shape=(1, 8), tp_comms="ring",
+    )
+    dec = tiny.try_admit(_req("big", n=65, L=32, d=2, trials=2))
+    assert (dec.action, dec.reason) == (REJECT, "unservable_shape")
+    assert "(dp=1, tp=8)" in dec.detail and "ring" in dec.detail
+
+
+def test_admission_mesh_indivisible_falls_back_to_single_chip():
+    # 4 parties -> 3 lieutenants: tp=2 does not divide, so the shape is
+    # priced (and run) unsharded — same ceiling as a meshless controller.
+    meshed = _controller(chunk_trials=2, mesh_shape=(4, 2))
+    flat = _controller(chunk_trials=2)
+    for ac in (meshed, flat):
+        d = ac.try_admit(_req("odd", n=4, L=4, trials=2))
+        assert d.action == ADMIT
+    assert meshed._ceilings == flat._ceilings
+
+
 def test_admission_prices_targets_below_budget():
     ac = _controller(window_chunks=64)
     dec = ac.try_admit(_req("T", trials=4096, target="decide vs 1/3"))

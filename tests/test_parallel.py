@@ -144,13 +144,16 @@ class TestPartySharded:
         ref = run_trials(cfg)
 
         real_batch = spmd_mod._spmd_batch
-        engines_tried = []
+        attempts = []
 
-        def failing_batch(cfg_, mesh_, keys_, engine="xla", check_vma=True):
-            engines_tried.append(engine)
+        def failing_batch(
+            cfg_, mesh_, keys_, engine="xla", check_vma=True,
+            comms="all_gather",
+        ):
+            attempts.append((engine, comms))
             if engine != "xla":
                 raise RuntimeError("forced shard_map compile failure")
-            return real_batch(cfg_, mesh_, keys_, engine, check_vma)
+            return real_batch(cfg_, mesh_, keys_, engine, check_vma, comms)
 
         monkeypatch.setattr(spmd_mod, "_spmd_batch", failing_batch)
         # Auto path: force the resolver to pick a kernel engine.
@@ -160,7 +163,9 @@ class TestPartySharded:
         with _warnings.catch_warnings(record=True) as caught:
             _warnings.simplefilter("always")
             out = spmd_mod.run_trials_spmd(cfg, mesh)
-        assert engines_tried == ["pallas_tiled", "xla"]
+        # BOTH auto knobs degrade in the single fallback step: the
+        # engine to xla AND the comms to the all_gather escape hatch.
+        assert attempts == [("pallas_tiled", "ring"), ("xla", "all_gather")]
         assert any("falling back" in str(w.message) for w in caught)
         assert_trials_equal(out, ref)
 
@@ -168,10 +173,13 @@ class TestPartySharded:
         import dataclasses
 
         cfg_forced = dataclasses.replace(cfg, round_engine="pallas_tiled")
-        engines_tried.clear()
+        attempts.clear()
         with pytest.raises(RuntimeError, match="forced shard_map"):
             spmd_mod.run_trials_spmd(cfg_forced, mesh)
-        assert engines_tried == ["pallas_tiled"]
+        # Forced engine + auto comms: one retry with the comms knob
+        # degraded, then the engine failure re-raises.
+        assert attempts == [("pallas_tiled", "ring"),
+                            ("pallas_tiled", "all_gather")]
 
     def test_indivisible_lieutenants_rejected(self, n_devices):
         cfg = QBAConfig(n_parties=4, size_l=4, trials=n_devices)  # 3 lieutenants
@@ -279,6 +287,138 @@ class TestPartyShardedTiled:
         # equivalence vacuous.
         assert not any("falling back" in str(w.message) for w in caught)
         assert_trials_equal(out, self._ref(cfg))
+
+
+class TestRingComms:
+    """Round 9 (KI-2 memory wall): the neighbor-ring comms schedule
+    that replaces the broadcast all_gather must be *placement, not
+    semantics* — bit-identical to the all_gather escape hatch AND to
+    the single-device engine at every tp width, shape, strategy, and
+    noise knob.  The ring is what makes the per-device footprint
+    constant in tp (docs/KNOWN_ISSUES.md KI-2)."""
+
+    def _triple(self, cfg, tp, n_devices):
+        """spmd(auto->ring) == spmd(all_gather) == single-device."""
+        import dataclasses
+
+        if n_devices < tp:
+            pytest.skip(f"needs >= {tp} devices")
+        mesh = make_mesh({"dp": n_devices // tp, "tp": tp})
+        ring = run_trials_spmd(cfg, mesh)
+        ag = run_trials_spmd(
+            dataclasses.replace(cfg, tp_comms="all_gather"), mesh
+        )
+        assert_trials_equal(ring, ag)
+        assert_trials_equal(ring, run_trials(cfg))
+
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_ring_matches_all_gather_17p(self, n_devices, tp):
+        cfg = QBAConfig(
+            n_parties=17, size_l=8, n_dishonest=4, trials=4, seed=21
+        )
+        self._triple(cfg, tp, n_devices)
+
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_ring_matches_all_gather_33p(self, n_devices, tp):
+        cfg = QBAConfig(
+            n_parties=33, size_l=8, n_dishonest=2, trials=4, seed=22
+        )
+        self._triple(cfg, tp, n_devices)
+
+    def test_ring_split_strategy(self, n_devices):
+        # The split strategy's worst-case forgery masks ride the same
+        # shared draw arrays, so the ring shuffle cannot perturb them.
+        cfg = QBAConfig(
+            n_parties=17, size_l=8, n_dishonest=4, trials=4, seed=23,
+            strategy="split",
+        )
+        self._triple(cfg, 4, n_devices)
+
+    def test_ring_with_noise(self, n_devices):
+        # Noise keys are indexed by global (trial, qubit) coordinates —
+        # party sharding must not shift the noise stream either.
+        cfg = QBAConfig(
+            n_parties=17, size_l=8, n_dishonest=4, trials=4, seed=24,
+            p_depolarize=0.05, p_measure_flip=0.02,
+        )
+        self._triple(cfg, 2, n_devices)
+
+    def test_ring_path_check_vma_replication(self, n_devices):
+        # Replication pin: the ring gather declares its output
+        # tp-varying (out_vma) and recombination is psum-only, so the
+        # static replication checker must PROVE the per-trial outputs
+        # tp-replicated with check_vma=True — tracing is where an
+        # under-replicated output would error out.
+        import qba_tpu.parallel.spmd as spmd_mod
+
+        assert spmd_mod._resolve_check_vma("xla") is True
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        cfg = QBAConfig(
+            n_parties=5, size_l=8, n_dishonest=2, trials=n_devices // 2,
+            seed=1,
+        )
+        keys = jax.random.split(jax.random.key(cfg.seed), cfg.trials)
+        jax.make_jaxpr(
+            lambda k: spmd_mod._spmd_batch(cfg, mesh, k, "xla", True, "ring")
+        )(keys)
+
+    def test_ring_gather_unit_matches_all_gather(self, n_devices):
+        # The schedule itself, outside the protocol: hop k delivers the
+        # shard of device (i-k-1) mod tp at that owner's global offset,
+        # so the assembled array equals the tiled all_gather exactly.
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from qba_tpu.parallel.ring import ring_gather
+        from qba_tpu.parallel.spmd import _shard_map
+
+        tp = 4
+        if n_devices < tp:
+            pytest.skip("needs >= 4 devices")
+        mesh = make_mesh({"dp": n_devices // tp, "tp": tp})
+        x = jnp.arange(tp * 6, dtype=jnp.int32).reshape(tp * 3, 2)
+
+        def body(xs):
+            ring = ring_gather(xs, tp)
+            gathered = jax.lax.all_gather(xs, "tp", axis=0, tiled=True)
+            return ring, gathered
+
+        ring, gathered = _shard_map(
+            body, mesh=mesh,
+            in_specs=P("tp"), out_specs=(P(), P()),
+            check_vma=False,  # gather equality, not replication proof
+        )(x)
+        np.testing.assert_array_equal(np.asarray(ring), np.asarray(gathered))
+        np.testing.assert_array_equal(np.asarray(ring), np.asarray(x))
+
+    @pytest.mark.slow
+    def test_65p_beyond_single_chip_budget(self, n_devices):
+        # THE round-9 acceptance shape: a 65-party (w=128) pool the
+        # KI-2 model PROVES cannot fit one emulated chip (ceiling 0 at
+        # a reserve+16MiB budget) completes party-sharded over tp=8,
+        # where the ring model prices >= 2 trials/device — the memory
+        # wall broken by placement alone, bit-identically.
+        if n_devices < 8:
+            pytest.skip("needs >= 8 devices")
+        from qba_tpu.analysis.memory import (
+            HBM_RESERVE,
+            sharded_trial_ceiling,
+            trial_ceiling,
+        )
+
+        cfg = QBAConfig(
+            n_parties=65, size_l=32, n_dishonest=2, trials=2, seed=9,
+            round_engine="xla",
+        )
+        emu_hbm = HBM_RESERVE + (16 << 20)
+        assert trial_ceiling(cfg, hbm_bytes=emu_hbm) == 0
+        sc = sharded_trial_ceiling(
+            cfg, dp=1, tp=8, hbm_bytes=emu_hbm, comms="ring"
+        )
+        assert sc["per_device_trials"] >= cfg.trials
+        mesh = make_mesh({"dp": 1, "tp": 8})
+        spmd = run_trials_spmd(cfg, mesh)
+        assert_trials_equal(spmd, run_trials(cfg))
 
 
 class TestMeshHelpers:

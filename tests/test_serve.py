@@ -302,6 +302,31 @@ def test_save_plans_is_atomic_and_idempotent(tmp_path):
     assert not (tmp_path / "plans.json.tmp").exists()
 
 
+def test_saved_mesh_round_trips_and_persists(tmp_path):
+    # Round 9: the fleet mesh rides the plans.json artifact so the next
+    # boot's admission prices against the sharded KI-2 ceiling the
+    # warm-started plans assume.
+    from qba_tpu.serve.persist import saved_mesh
+
+    assert saved_mesh(str(tmp_path)) is None  # absent artifact
+    cfg = QBAConfig(4, 8, 1, trials=3)
+    save_plans(
+        str(tmp_path), [cfg], mesh={"dp": 2, "tp": 4, "tp_comms": "ring"}
+    )
+    assert saved_mesh(str(tmp_path)) == {"dp": 2, "tp": 4, "tp_comms": "ring"}
+    # A later save WITHOUT a mesh preserves the recorded one (a plain
+    # resolver flush must not erase the fleet's placement metadata)...
+    save_plans(str(tmp_path), [dataclasses.replace(cfg, seed=9)])
+    assert saved_mesh(str(tmp_path)) == {"dp": 2, "tp": 4, "tp_comms": "ring"}
+    # ...and an explicit new mesh replaces it.
+    save_plans(
+        str(tmp_path), mesh={"dp": 1, "tp": 8, "tp_comms": "all_gather"}
+    )
+    assert saved_mesh(str(tmp_path)) == {
+        "dp": 1, "tp": 8, "tp_comms": "all_gather"
+    }
+
+
 # ---- LRU bound ---------------------------------------------------------
 
 

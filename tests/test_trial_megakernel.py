@@ -246,6 +246,35 @@ class TestLaunchModel:
         assert plan["mega_block"] is not None
         assert engine_description(cfg).startswith("pallas_mega/")
 
+    def test_kernel_plan_tp_attribution(self):
+        # Round 9: on a tp mesh the plan names the SPMD engine and the
+        # comms transport, and a mega demotion (the in-kernel round
+        # loop cannot drain a sharded mailbox) is attributed, never
+        # silent.
+        import dataclasses
+
+        from qba_tpu.benchmark import engine_description, kernel_plan
+
+        cfg = QBAConfig(n_parties=17, size_l=16, n_dishonest=4)
+        plan = kernel_plan(cfg, tp=4)
+        assert plan["tp"] == 4
+        assert plan["tp_comms"] == "ring"
+        assert plan["tp_demoted_from"] is None
+        desc = engine_description(cfg, tp=4)
+        assert desc.startswith("spmd[tp=4]/")
+        assert desc.endswith("/ring")
+
+        cfg_mega = dataclasses.replace(cfg, round_engine="pallas_mega")
+        plan_mega = kernel_plan(cfg_mega, tp=4)
+        assert plan_mega["tp_engine"] == "pallas_fused"
+        assert plan_mega["tp_demoted_from"] == "pallas_mega"
+        assert "(from mega)" in engine_description(cfg_mega, tp=4)
+
+        cfg_ag = dataclasses.replace(cfg, tp_comms="all_gather")
+        assert kernel_plan(cfg_ag, tp=2)["tp_comms"] == "all_gather"
+        # tp=None keeps the single-device attribution unchanged.
+        assert "tp" not in kernel_plan(cfg)
+
 
 class TestServeWarmStart:
     def test_mega_plan_round_trips_zero_probe(self):
