@@ -302,6 +302,98 @@ print(json.dumps(rows))
     }
 
 
+def _targeted(quick: bool) -> dict:
+    """Targeted scenario (``QBA_BENCH_SCENARIO=targeted``): time-to-
+    decision for the same precision target under the host per-chunk
+    loop vs the device-resident single-dispatch loop (ROADMAP item 3),
+    at the headline shape.  The two runs consume identical keys and by
+    the stop-table construction stop at the same chunk boundary — the
+    row records both the p50 wall seconds and the dispatch counts
+    (host: one per executed chunk; device: exactly one), which is the
+    actual quantity the device loop collapses.  Standing caveat
+    (docs/PERF.md): off-TPU the wall numbers are CPU/interpret-fenced —
+    valid for host-vs-device RELATIVE comparison at the same shape,
+    not absolute latency."""
+    import statistics
+    import time
+
+    from qba_tpu.config import QBAConfig
+    from qba_tpu.sweep import run_sweep
+
+    cfg = QBAConfig(
+        n_parties=11,
+        size_l=16 if quick else 64,
+        n_dishonest=3,
+        trials=8 if quick else 64,  # chunk_trials
+        seed=0,
+    )
+    n_chunks = 8 if quick else 32
+    reps = 2 if quick else 4
+    specs = [
+        "decide vs 1/3 @ 95%",
+        "ci_width<=0.25" if quick else "ci_width<=0.12",
+    ]
+    rows = []
+    for spec in specs:
+        row: dict = {
+            "target": spec,
+            "budget_chunks": n_chunks,
+            "chunk_trials": cfg.trials,
+        }
+        try:
+            per: dict = {}
+            for mode in ("host", "device"):
+                run_sweep(  # warm the jit cache for this mode
+                    cfg, n_chunks=n_chunks, chunk_trials=cfg.trials,
+                    target=spec, dispatch=mode,
+                )
+                times = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    res = run_sweep(
+                        cfg, n_chunks=n_chunks, chunk_trials=cfg.trials,
+                        target=spec, dispatch=mode,
+                    )
+                    times.append(time.perf_counter() - t0)
+                per[mode] = {
+                    "p50_time_to_decision_s": round(
+                        statistics.median(times), 4
+                    ),
+                    "rep_seconds": [round(t, 4) for t in times],
+                    # Host pays one dispatch + one fenced readback per
+                    # executed chunk; the device loop is one dispatch
+                    # and one readback regardless of where it stops.
+                    "dispatches": 1 if mode == "device" else len(res.chunks),
+                    "stop_chunk": len(res.chunks),
+                    "stop_reason": res.stop.reason if res.stop else None,
+                    "n_trials": res.stop.n_trials if res.stop else None,
+                }
+                row[mode] = per[mode]
+            row["stop_chunk_agrees"] = (
+                per["host"]["stop_chunk"] == per["device"]["stop_chunk"]
+                and per["host"]["stop_reason"] == per["device"]["stop_reason"]
+            )
+        except Exception as e:  # a row must never sink the artifact
+            row["error"] = repr(e)[:300]
+        rows.append(row)
+        print(f"targeted {spec}: {row}", file=sys.stderr)
+    return {
+        "metric": (
+            f"targeted_time_to_decision_n{cfg.n_parties}_l{cfg.size_l}"
+            f"_d{cfg.n_dishonest}"
+        ),
+        "scenario": "targeted",
+        "unit": "s",
+        "rows": rows,
+        "methodology": (
+            "host loop (dispatch+fenced readback per chunk) vs "
+            "device-resident while_loop (one dispatch), identical keys "
+            "and stop boundary by construction; off-TPU wall times are "
+            "CPU-fenced — relative comparison only"
+        ),
+    }
+
+
 def main() -> None:
     from qba_tpu.compile_cache import enable_compile_cache
     from qba_tpu.config import QBAConfig
@@ -316,6 +408,15 @@ def main() -> None:
         # MULTICHIP_r*.json).
         print(json.dumps(
             _multichip(os.environ.get("QBA_BENCH_QUICK") == "1")
+        ))
+        return
+
+    if os.environ.get("QBA_BENCH_SCENARIO") == "targeted":
+        # Host-vs-device time-to-decision at the headline shape: its
+        # own JSON line is the whole artifact (CI uploads it as
+        # TARGETED_r*.json next to BENCH_r*.json).
+        print(json.dumps(
+            _targeted(os.environ.get("QBA_BENCH_QUICK") == "1")
         ))
         return
 

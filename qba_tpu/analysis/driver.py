@@ -160,8 +160,15 @@ def run_lint(
         sitewide = False
     if effects:
         from qba_tpu.analysis.effects import check_jit_donation
-        from qba_tpu.analysis.transfers import check_transfers
+        from qba_tpu.analysis.transfers import (
+            check_device_loop,
+            check_transfers,
+        )
 
         report.extend(check_transfers())
         report.extend(check_jit_donation())
+        # ROADMAP item 3: the device-resident targeted loop must stay a
+        # single transfer-free dispatch (per-chunk readbacks eliminated,
+        # not fenced) — proven from its traced jaxpr, sitewide.
+        report.extend(check_device_loop())
     return report

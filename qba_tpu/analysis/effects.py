@@ -592,16 +592,22 @@ def check_jit_donation(source_paths=None) -> Report:
     if source_paths is None:
         import qba_tpu.backends.jax_backend as jb
         import qba_tpu.parallel.spmd as spmd_mod
+        import qba_tpu.sweep as sweep_mod
 
-        source_paths = [jb.__file__, spmd_mod.__file__]
+        # sweep.py carries the device-resident loop jits, whose
+        # while-carry donation (KI-5) must stay sound.
+        source_paths = [jb.__file__, spmd_mod.__file__, sweep_mod.__file__]
     jits = 0
     claims = 0
+    path_jits: dict[str, int] = {}
+    path_claims: dict[str, int] = {}
     for path in source_paths:
         with open(path) as fh:
             tree = ast.parse(fh.read(), filename=path)
         rel = os.path.basename(path)
         for call, kws in _jit_calls(tree):
             jits += 1
+            path_jits[rel] = path_jits.get(rel, 0) + 1
             where = f"{path}:{call.lineno}"
             donate = kws.get("donate_argnums") or kws.get(
                 "donate_argnames"
@@ -609,6 +615,7 @@ def check_jit_donation(source_paths=None) -> Report:
             if donate is None:
                 continue
             claims += 1
+            path_claims[rel] = path_claims.get(rel, 0) + 1
             dset = _int_set(kws.get("donate_argnums"))
             sset = _int_set(kws.get("static_argnums"))
             if dset is None or sset is None:
@@ -644,13 +651,24 @@ def check_jit_donation(source_paths=None) -> Report:
                 "module layout"
             ),
         ))
-    elif claims == 0:
-        report.notes.append(
-            f"effects/jit: {jits} dispatch jits, zero donate_argnums "
-            "claims (policy: trial keys are reused across repeat "
-            "dispatches by bench/serve; carry donation lives in the "
-            "kernel input_output_aliases)"
+    else:
+        # Per-module policy: the dispatch modules (jax_backend, spmd)
+        # keep zero donation claims — trial keys are reused across
+        # repeat dispatches by bench/serve and carry donation lives in
+        # the kernel input_output_aliases.  The device-loop jits in
+        # sweep.py are the recorded exception (each donates its
+        # while-carry; claims noted above).
+        zero_jits = sum(
+            n for rel, n in path_jits.items()
+            if path_claims.get(rel, 0) == 0
         )
+        if zero_jits:
+            report.notes.append(
+                f"effects/jit: {zero_jits} dispatch jits, zero "
+                "donate_argnums claims (policy: trial keys are reused "
+                "across repeat dispatches by bench/serve; carry "
+                "donation lives in the kernel input_output_aliases)"
+            )
     report.stats["jits_audited"] = jits
     return report
 

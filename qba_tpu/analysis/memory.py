@@ -283,6 +283,46 @@ def _audit_plans(cfg: QBAConfig, n_recv: int | None, report: Report,
         )
 
 
+def device_loop_carry_bytes(
+    n_chunks: int, chunk_trials: int, n_cells: int = 1,
+    per_trial_bits: bool = False,
+) -> dict:
+    """KI-2 footprint model of the device-resident sequential loop's
+    while-carry (docs/STATS.md "Device-resident stopping",
+    KNOWN_ISSUES "Device-loop while-carry residency").
+
+    The carry is deliberately integer-thin — the engine's one-chunk
+    working set (pool, mailbox, verdicts) is identical to what the
+    host loop dispatches per chunk, so the device loop's *additional*
+    residency is exactly what this model prices:
+
+    * per cell: cumulative count + chunk cursor + done flag
+      (scalars), per-chunk counts (``int32[n_chunks]``) and overflow
+      flags (``bool[n_chunks]``) kept for the host's checkpoint-parity
+      replay;
+    * shared: the stop tables (``2 x int32[n_chunks+1]``) and, for the
+      adaptive surface, the schedule/tier logs
+      (``2 x int32[n_cells*n_chunks]``);
+    * ``per_trial_bits``: the serve early-finish loop also carries the
+      per-trial success bits (``bool[n_chunks*chunk_trials]``) and the
+      request's key table (``uint32[2][n_chunks*chunk_trials]``).
+    """
+    per_cell = 4 + 4 + 1 + n_chunks * 4 + n_chunks * 1
+    shared = 2 * (n_chunks + 1) * 4
+    if n_cells > 1:
+        shared += 2 * n_cells * n_chunks * 4  # sched + tier logs
+    if per_trial_bits:
+        per_cell += n_chunks * chunk_trials * (1 + 8)
+    return {
+        "n_chunks": n_chunks,
+        "chunk_trials": chunk_trials,
+        "n_cells": n_cells,
+        "per_cell_bytes": per_cell,
+        "shared_bytes": shared,
+        "total_bytes": n_cells * per_cell + shared,
+    }
+
+
 def gf2_tableau_bytes(cfg: QBAConfig) -> dict:
     """Packed-tableau working set of the batched GF(2) sampler, per
     shot (one list position): x + z packed word planes ``[2n, W]``
@@ -396,6 +436,29 @@ def check_memory(cfg: QBAConfig) -> Report:
         f"roofline: {rf['per_round_per_trial_bytes']} B/round/trial "
         f"upper bound, pool share {rf['pool_share']}"
     )
+
+    # Device-resident loop carry (ROADMAP item 3): the while-carry the
+    # single-dispatch targeted paths keep resident across chunks, at a
+    # representative 64-chunk budget.  The engine's per-chunk working
+    # set is unchanged from the host loop; the carry is the delta.
+    dl = device_loop_carry_bytes(64, cfg.trials)
+    dl_serve = device_loop_carry_bytes(64, cfg.trials, per_trial_bits=True)
+    report.notes.append(
+        f"device-loop-carry: {dl['total_bytes']} B resident across a "
+        f"64-chunk targeted sweep (serve early-finish with per-trial "
+        f"bits + key table: {dl_serve['total_bytes']} B) — negligible "
+        "next to the per-trial pool; the chunk working set is the host "
+        "loop's own"
+    )
+    if dl_serve["total_bytes"] > HBM_BYTES - HBM_RESERVE:
+        report.findings.append(Finding(
+            ki="KI-2", check="device-loop-carry", path="sweep/device",
+            message=(
+                f"device-loop carry {dl_serve['total_bytes']} B at a "
+                "64-chunk budget no longer fits the v5e model — the "
+                "carry has stopped being integer-thin"
+            ),
+        ))
 
     # Sharded per-device budgets (ROADMAP item 1): for each default
     # mesh shape, re-run the plan audit at the per-device receiver

@@ -268,6 +268,15 @@ def _parser() -> argparse.ArgumentParser:
         "ceiling (docs/STATS.md)",
     )
     sweep.add_argument(
+        "--dispatch", choices=("host", "device"), default="host",
+        help="'host': per-chunk dispatch with the stopping rule consulted "
+        "between chunks (PR 10 behaviour).  'device': compile the "
+        "stopping predicate into a single on-device while_loop — one "
+        "dispatch for the whole targeted run, stopping at the same "
+        "chunk boundary as the host loop for identical keys; requires "
+        "--target (docs/STATS.md \"Device-resident stopping\")",
+    )
+    sweep.add_argument(
         "--resume-force", action="store_true",
         help="when the checkpoint's chunk_trials disagree with this "
         "run's, discard it (with a QBACheckpointMismatch warning) and "
@@ -964,11 +973,17 @@ def _cmd_sweep(args: argparse.Namespace, out) -> int:
             timers=timers,
             target=args.target,
             resume_force=args.resume_force,
+            dispatch=args.dispatch,
         )
         # Wall time for throughput = dispatch + readback (the two phases
         # are disjoint: dispatch returns at async-enqueue, readback
-        # blocks).
-        seconds = (timers.total("dispatch") + timers.total("readback")) or None
+        # blocks).  A device-resident run has neither — its one fenced
+        # loop span covers compile+run+readback end to end.
+        seconds = (
+            timers.total("dispatch")
+            + timers.total("readback")
+            + timers.total("device_loop")
+        ) or None
         print(
             render_sweep(cfg, res.success_rate, res.n_trials, seconds),
             file=out,

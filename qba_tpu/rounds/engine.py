@@ -1256,6 +1256,41 @@ def resolve_round_engine(cfg: QBAConfig) -> str:
     return "xla"
 
 
+def run_chunk_counts(
+    cfg: QBAConfig, keys: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """One chunk's verdicts reduced ON DEVICE: ``(successes int32,
+    overflow bool)`` scalars from a vmapped :func:`run_trial` batch.
+
+    This is the loop body of the device-resident sequential paths
+    (``sweep.run_sweep(dispatch="device")`` and the single-dispatch
+    adaptive surface): the same per-trial program the host runner
+    dispatches (:func:`qba_tpu.backends.jax_backend.batched_trials` is
+    the identical ``vmap(run_trial)``), but reduced to the two scalars
+    the stopping predicate needs before anything leaves the device —
+    so per-chunk counts are bit-identical to the host loop's readback
+    for identical keys, and the ``lax.while_loop`` carry stays a few
+    words per chunk (the KI-2 carry model,
+    analysis/memory.py::device_loop_carry_bytes)."""
+    res = jax.vmap(lambda k: run_trial(cfg, k))(keys)
+    return (
+        jnp.sum(res.success.astype(jnp.int32)),
+        jnp.any(res.overflow),
+    )
+
+
+def run_chunk_outcomes(
+    cfg: QBAConfig, keys: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Like :func:`run_chunk_counts` but keeps the per-trial success
+    bits: ``(success bool[len(keys)], overflow bool)``.  The serve
+    device early-finish loop carries these so a device-served result
+    reports the same per-trial ``success`` list the host serve path
+    assembles from its segment readbacks (docs/SERVING.md)."""
+    res = jax.vmap(lambda k: run_trial(cfg, k))(keys)
+    return (res.success, jnp.any(res.overflow))
+
+
 def run_trial(
     cfg: QBAConfig, key: jax.Array, hints: PartitionHints | None = None
 ) -> TrialResult:

@@ -80,6 +80,12 @@ class _Active:
     # rule sees exactly the trials [0, filled), in order.
     target: Any = None  # qba_tpu.stats.Target | None
     rule: Any = None  # live stopping rule | None
+    # Device early-finish (docs/STATS.md "Device-resident stopping"):
+    # "device" requests bypass the bucket scheduler and run their whole
+    # targeted budget as ONE on-device while_loop; key_data holds the
+    # request's full key table until that dispatch.
+    dispatch: str = "host"
+    key_data: np.ndarray | None = None
 
     @property
     def overdue(self) -> bool:
@@ -104,14 +110,27 @@ class QBAServer:
         warm_start: bool = True,
         deadline_s: float | None = None,
         replica_id: str | None = None,
+        dispatch: str = "host",
     ) -> None:
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if dispatch not in ("host", "device"):
+            raise ValueError(
+                f"dispatch must be 'host' or 'device', got {dispatch!r}"
+            )
         self.scheduler = BucketScheduler(chunk_trials)
         self.depth = depth
         self.deadline_s = deadline_s
+        # "device": precision-targeted requests run their whole budget
+        # as a single on-device while_loop (stopping predicate compiled
+        # in, docs/STATS.md) instead of riding the per-chunk bucket
+        # stream.  Untargeted requests — and targeted ones that need
+        # per-trial decisions or are smaller than one chunk — still
+        # take the host path on a device server.
+        self.dispatch = dispatch
+        self._device_pending: list[str] = []
         # Fleet attribution: set when this server is one worker of a
         # replica pool — stamped on every result, manifest, and request
         # span so cross-replica aggregation can tell the workers apart.
@@ -182,9 +201,24 @@ class QBAServer:
             span_args["replica_id"] = self.replica_id
         if queue_wait_s is not None:
             span_args["queue_wait_s"] = queue_wait_s
+        # Device early-finish eligibility: a targeted request with at
+        # least one whole chunk of budget and no per-trial decision
+        # payload.  Everything else falls back to the host bucket
+        # stream even on a device server (docs/SERVING.md).
+        device_mode = (
+            self.dispatch == "device"
+            and target is not None
+            and not req.return_decisions
+            and cfg.trials >= self.scheduler.chunk_trials
+        )
+        if device_mode:
+            span_args["dispatch"] = "device"
         root_ctx = recorder.span(REQUEST_SPAN, cat="serve", **span_args)
         root_span = root_ctx.__enter__()
-        self.scheduler.enqueue(req.request_id, cfg, key_data)
+        if device_mode:
+            self._device_pending.append(req.request_id)
+        else:
+            self.scheduler.enqueue(req.request_id, cfg, key_data)
         if bucket not in self._served_buckets:
             self._served_buckets.append(bucket)
         self._active[req.request_id] = _Active(
@@ -205,6 +239,8 @@ class QBAServer:
             queue_wait_s=queue_wait_s,
             target=target,
             rule=rule,
+            dispatch="device" if device_mode else "host",
+            key_data=key_data if device_mode else None,
         )
 
     # ---- dispatch / drain --------------------------------------------
@@ -213,6 +249,7 @@ class QBAServer:
         fills; returns requests completed along the way.  Partial
         chunks wait for more same-bucket traffic until :meth:`flush`."""
         done: list[EvalResult] = self.expire_overdue()
+        done.extend(self._pump_device())
         while self.scheduler.has_full_chunk():
             chunk = self.scheduler.next_chunk()
             assert chunk is not None
@@ -223,6 +260,7 @@ class QBAServer:
         """Dispatch all pending trials (padding partial chunks), drain
         every in-flight chunk, and persist the resolver plans."""
         done: list[EvalResult] = self.expire_overdue()
+        done.extend(self._pump_device())
         while True:
             chunk = self.scheduler.next_chunk()
             if chunk is None:
@@ -319,17 +357,126 @@ class QBAServer:
     @property
     def busy(self) -> bool:
         """True while any trial is queued or any chunk is in flight."""
-        return bool(self._in_flight) or self.scheduler.pending_trials() > 0
+        return (
+            bool(self._in_flight)
+            or bool(self._device_pending)
+            or self.scheduler.pending_trials() > 0
+        )
 
     @property
     def backlog_trials(self) -> int:
         """Trials accepted but not yet read back: queued in the
-        scheduler plus in-flight chunks (chunks are fixed-size, padded).
-        The file-queue transport uses this as its work-sharing
-        watermark — claim more only while the pipeline has room."""
+        scheduler plus in-flight chunks (chunks are fixed-size, padded)
+        plus device-pending targeted budgets.  The file-queue transport
+        uses this as its work-sharing watermark — claim more only while
+        the pipeline has room."""
+        device_pending = sum(
+            self._active[rid].cfg.trials
+            for rid in self._device_pending
+            if rid in self._active
+        )
         return (
             self.scheduler.pending_trials()
             + len(self._in_flight) * self.scheduler.chunk_trials
+            + device_pending
+        )
+
+    # ---- device early-finish -----------------------------------------
+    def _pump_device(self) -> list[EvalResult]:
+        """Run every device-pending targeted request to its stop chunk,
+        one single-dispatch while_loop each (requests already expired by
+        the deadline sweep are skipped — their ids are simply gone from
+        the active table)."""
+        done: list[EvalResult] = []
+        pending, self._device_pending = self._device_pending, []
+        for rid in pending:
+            ar = self._active.get(rid)
+            if ar is not None:
+                done.append(self._run_device(ar))
+        return done
+
+    def _run_device(self, ar: _Active) -> EvalResult:
+        """One targeted request as ONE dispatch: the stopping predicate
+        rides the on-device while_loop (qba_tpu.sweep._device_loop_prefix)
+        over the request's own prefix key table, so the device decides
+        when to stop and the host reads back counts + per-trial success
+        bits exactly once.  The budget is floor-quantized to whole
+        chunks (``trials // chunk_trials`` — docs/SERVING.md); the host
+        replay of the per-chunk counts through the request's rule
+        produces the same StopDecision the host segment stream would
+        have reached at that chunk boundary."""
+        import jax
+        import jax.numpy as jnp
+
+        from qba_tpu.diagnostics import record_decisions, warn_and_record
+        from qba_tpu.diagnostics import QBAWarning
+        from qba_tpu.stats.device import stop_tables
+        from qba_tpu.sweep import (
+            _device_carry_prefix,
+            _device_loop_prefix,
+        )
+
+        ct = self.scheduler.chunk_trials
+        n_chunks = ar.cfg.trials // ct
+        label = bucket_label(ar.bucket)
+        assert ar.key_data is not None
+        keys = jax.random.wrap_key_data(
+            jnp.asarray(ar.key_data[: n_chunks * ct])
+        )
+        lo, hi = stop_tables(ar.target, n_chunks, ct)
+        carry = _device_carry_prefix(n_chunks, ct)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(
+                "compile"
+                if ar.bucket not in self._bucket_decisions
+                else "dispatch",
+                [ar.req.request_id],
+            )
+        span_args = dict(
+            bucket=label, budget_chunks=n_chunks, chunk_trials=ct,
+        )
+        first = ar.bucket not in self._bucket_decisions
+        with record_decisions() as decisions:
+            with ar.recorder.span(
+                "serve.device_loop", cat="serve", **span_args
+            ) as sp:
+                i_stop, _, counts, ovf, succ = _device_loop_prefix(
+                    ar.bucket, n_chunks, ct, carry,
+                    jnp.asarray(lo), jnp.asarray(hi), keys,
+                )
+                # The single loop-level readback barrier of the whole
+                # request — the device already decided where to stop.
+                i_stop = int(i_stop)
+                counts_h = np.asarray(counts)
+                ovf_h = np.asarray(ovf)
+                succ_h = np.asarray(succ)
+                sp.fenced = True
+        if first:
+            self._bucket_decisions[ar.bucket] = list(decisions)
+        dec = None
+        for c in range(i_stop):
+            ar.success[c * ct : (c + 1) * ct] = succ_h[c * ct : (c + 1) * ct]
+            ar.overflow[c * ct : (c + 1) * ct] = bool(ovf_h[c])
+            ar.filled += ct
+            ar.chunks += 1
+            ar.rule.observe(int(counts_h[c]), ct)
+            dec = ar.rule.decision()
+            if dec is not None:
+                break
+        # A decision landing exactly on the final budget chunk is
+        # consistent: the loop exits on i == n_chunks either way.
+        if ar.chunks != i_stop or (dec is None and i_stop < n_chunks):
+            warn_and_record(
+                "serve device stop diverged from the host rule: device "
+                f"stopped after {i_stop} chunks, host replay after "
+                f"{ar.chunks}",
+                QBAWarning,
+                site="serve._run_device",
+                device_stop=i_stop,
+                host_stop=ar.chunks,
+            )
+        return self._finish(
+            ar, stop=dec if dec is not None else ar.rule.exhausted()
         )
 
     def _dispatch(self, chunk: Chunk) -> list[EvalResult]:
@@ -465,6 +612,10 @@ class QBAServer:
         if ar.target is not None:
             stats_block["target"] = ar.target.to_json()
             stats_block["stop"] = stop.to_json() if stop is not None else None
+        if ar.dispatch == "device":
+            # Distinguish the single-dispatch loop from the host chunk
+            # stream in the manifest (docs/OBSERVABILITY.md).
+            stats_block["dispatch"] = "device"
         manifest = validate_manifest(
             collect_manifest(
                 ar.cfg,
@@ -485,7 +636,10 @@ class QBAServer:
         )
         if self.telemetry_dir is not None:
             self._write_telemetry(ar, manifest)
-        assert ar.decisions is not None
+        # The device loop reduces on device and never materializes
+        # per-trial decisions — its eligibility gate already excluded
+        # return_decisions requests.
+        assert ar.decisions is not None or not ar.req.return_decisions
         return EvalResult(
             request_id=ar.req.request_id,
             n_trials=n_done,
@@ -499,7 +653,7 @@ class QBAServer:
             success=[bool(x) for x in ar.success[:n_done]],
             decisions=(
                 ar.decisions[:n_done].tolist()
-                if ar.req.return_decisions
+                if ar.req.return_decisions and ar.decisions is not None
                 else None
             ),
             manifest=manifest,
@@ -562,6 +716,7 @@ class QBAServer:
 
         return {
             "replica_id": self.replica_id,
+            "dispatch": self.dispatch,
             "completed": self._completed,
             "expired": self._expired,
             "in_flight_chunks": len(self._in_flight),

@@ -6,9 +6,13 @@ The host-side statistics engine every Monte-Carlo path feeds:
 anytime-valid stopping rules, :mod:`~qba_tpu.stats.targets` parses the
 shared ``target=`` grammar, and :mod:`~qba_tpu.stats.allocate` spends a
 shared chunk budget across a cell grid where the answer is least known.
+:mod:`~qba_tpu.stats.device` compiles the stopping predicate into the
+integer threshold tables the device-resident ``lax.while_loop`` consults
+(docs/STATS.md "Device-resident stopping").
 """
 
 from qba_tpu.stats.allocate import AdaptiveAllocator
+from qba_tpu.stats.device import stop_tables
 from qba_tpu.stats.estimators import (
     RateEstimate,
     StreamingRate,
@@ -35,6 +39,7 @@ __all__ = [
     "parse_target",
     "rate_estimate",
     "round_histogram",
+    "stop_tables",
     "success_rate",
     "wilson_ci",
 ]

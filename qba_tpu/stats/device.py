@@ -1,0 +1,197 @@
+"""Host-exact stop tables for the device-resident sequential loop.
+
+The device-resident targeted sweep (``run_sweep(dispatch="device")``,
+docs/STATS.md "Device-resident stopping") carries only integer counts
+through a ``lax.while_loop`` — the stopping predicate must therefore be
+expressible over ``(cumulative successes K, chunks completed i)`` with
+nothing but integer compares.  Both PR 10 rules allow it, because their
+decisions are pure functions of the totals:
+
+* :class:`~qba_tpu.stats.sequential.SPRT` — the aggregate LLR
+  ``K·s + (N−K)·f`` is monotone nondecreasing in ``K`` (``s>0>f``), so
+  each boundary crossing is a single integer threshold on ``K``;
+* :class:`~qba_tpu.stats.sequential.MixtureMartingaleCI` — the interval
+  width at ``(K, N)`` is unimodal in ``K`` (widest near ``N/2``,
+  pinned per-``N`` by tests/test_device_loop.py), so the fire set
+  ``{K : width ≤ target}`` is a pair of end intervals.
+
+:func:`stop_tables` precomputes, for every possible chunk count
+``i ∈ [0, n_chunks]`` with ``N = i·chunk_trials``, the thresholds
+``lo[i]``/``hi[i]`` such that the host rule fires at totals ``(K, N)``
+iff ``K <= lo[i]`` or ``K >= hi[i]``.  Each threshold is found by
+bisection over ``K`` **evaluating the host rule's own float
+arithmetic** (:meth:`SPRT.llr_at` / :meth:`MixtureMartingaleCI.width_at`),
+so the device predicate agrees with the host loop's ``rule.decision()``
+at every reachable count — the bit-identity bar of ROADMAP item 3.
+
+Sentinels: ``lo[i] = -1`` / ``hi[i] = N+1`` mean "never fires at this
+``i``" (no cumulative count can be ``<= -1`` or ``>= N+1``).  Index 0
+always holds sentinels — a rule with zero observations never fires,
+and the device loop must run at least one chunk, like the host loop.
+
+Also here: :func:`device_ci_interval`, the traced float32 mixture-CI
+bisection the device allocator uses to ORDER cells (widest-first
+tiering).  Scheduling order tolerates float32 — per-cell STOP decisions
+always go through the exact integer tables above (docs/STATS.md).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from qba_tpu.stats.sequential import SPRT, MixtureMartingaleCI
+from qba_tpu.stats.targets import Target
+
+__all__ = ["stop_tables", "device_ci_interval"]
+
+
+def _bisect_threshold(fires, lo_k: int, hi_k: int, first_true: bool) -> int:
+    """Boundary of a monotone indicator over the integer range
+    ``[lo_k, hi_k]``.  ``first_true=True``: smallest K with
+    ``fires(K)`` given the indicator is nondecreasing in K (caller has
+    checked ``fires(hi_k)``); ``first_true=False``: largest K with
+    ``fires(K)`` given it is nonincreasing (caller has checked
+    ``fires(lo_k)``)."""
+    lo, hi = lo_k, hi_k
+    if first_true:
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if fires(mid):
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if fires(mid):
+            lo = mid
+        else:
+            hi = mid - 1
+    return lo
+
+
+def _decide_thresholds(rule: SPRT, n: int) -> tuple[int, int]:
+    """(lo, hi) stop thresholds for the SPRT at total trials ``n``.
+    ``llr_at(K, n)`` is monotone nondecreasing in K, and float rounding
+    preserves monotonicity (each term is a monotone product), so both
+    crossings are clean bisections on the host's own arithmetic."""
+    lo, hi = -1, n + 1
+    if rule.llr_at(n, n) >= rule.log_a:
+        hi = _bisect_threshold(
+            lambda k: rule.llr_at(k, n) >= rule.log_a, 0, n, first_true=True
+        )
+    if rule.llr_at(0, n) <= rule.log_b:
+        lo = _bisect_threshold(
+            lambda k: rule.llr_at(k, n) <= rule.log_b, 0, n, first_true=False
+        )
+    return lo, hi
+
+
+def _width_thresholds(rule: MixtureMartingaleCI, n: int) -> tuple[int, int]:
+    """(lo, hi) stop thresholds for the width rule at total trials
+    ``n``: fire iff ``width_at(K, n) <= target_width``.  Width is
+    unimodal in K (widest near n/2), so the fire set is the two end
+    intervals; each boundary is a bisection on the half-range."""
+    w = rule.target_width
+    mid = n // 2
+    if rule.width_at(mid, n) <= w and rule.width_at(mid + (n % 2), n) <= w:
+        # Fires even at the widest counts: every K stops.
+        return n, 0
+    lo, hi = -1, n + 1
+    if rule.width_at(0, n) <= w:
+        lo = _bisect_threshold(
+            lambda k: rule.width_at(k, n) <= w, 0, mid, first_true=False
+        )
+    if rule.width_at(n, n) <= w:
+        hi = _bisect_threshold(
+            lambda k: rule.width_at(k, n) <= w, mid, n, first_true=True
+        )
+    return lo, hi
+
+
+def stop_tables(
+    target: Target, n_chunks: int, chunk_trials: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Integer stop thresholds on cumulative successes, one row per
+    possible chunk count: after ``i`` chunks (``N = i·chunk_trials``
+    trials) the host rule fires iff ``K <= lo[i]`` or ``K >= hi[i]``.
+
+    Exact by construction: every threshold is located by bisection over
+    the host rule's own decision arithmetic at those totals (monotone
+    in K for the SPRT LLR; unimodal for the CI width), so the device
+    ``while_loop`` condition stops at exactly the chunk boundary the
+    host loop's per-chunk ``rule.decision()`` would
+    (tests/test_device_loop.py pins the full-table equivalence).
+    """
+    if n_chunks < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+    if chunk_trials < 1:
+        raise ValueError(f"chunk_trials must be >= 1, got {chunk_trials}")
+    rule = target.make_rule()
+    lo = np.full(n_chunks + 1, -1, dtype=np.int32)
+    hi = np.zeros(n_chunks + 1, dtype=np.int32)
+    hi[0] = 1  # sentinel: N = 0, no count reaches K >= 1
+    for i in range(1, n_chunks + 1):
+        n = i * chunk_trials
+        if target.kind == "decide":
+            lo_i, hi_i = _decide_thresholds(rule, n)
+        else:
+            lo_i, hi_i = _width_thresholds(rule, n)
+        lo[i], hi[i] = lo_i, hi_i
+    return lo, hi
+
+
+def device_ci_interval(k, n, confidence: float, iters: int = 60):
+    """Traced float32 mixture-martingale interval at totals ``(k, n)``
+    — the same Beta(½,½) mixture and MLE-outward bisection as
+    :meth:`MixtureMartingaleCI.interval`, expressed in jnp so the
+    device allocator can order cells widest-first **on device**.
+
+    Used ONLY for scheduling priority inside the single-dispatch
+    adaptive surface: float32 endpoints may differ from the host's
+    float64 interval in the last ulps, which can reorder near-tied
+    cells but never changes a stop decision (those go through the
+    exact integer :func:`stop_tables`).  Returns ``(lo, hi)`` scalars;
+    ``n == 0`` yields the vacuous ``(0, 1)``.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.scipy.special import gammaln
+
+    k = jnp.asarray(k, jnp.float32)
+    n = jnp.asarray(n, jnp.float32)
+    a = b = 0.5
+    crit = float(np.log(1.0 / (1.0 - confidence)))
+    lbeta = gammaln(k + a) + gammaln(n - k + b) - gammaln(n + a + b)
+    lbeta0 = float(np.log(np.pi))  # log B(1/2, 1/2)
+
+    def log_mixture(p):
+        p = jnp.clip(p, 1e-7, 1.0 - 1e-7)
+        return lbeta - lbeta0 - (k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+    p_hat = jnp.where(n > 0, k / jnp.maximum(n, 1.0), 0.5)
+
+    def boundary(lo0, hi0, rising_at_hi):
+        def step(_, bounds):
+            lo, hi = bounds
+            mid = 0.5 * (lo + hi)
+            cross = (log_mixture(mid) >= crit) == rising_at_hi
+            return (jnp.where(cross, lo, mid), jnp.where(cross, mid, hi))
+
+        lo, hi = lax.fori_loop(0, iters, step, (lo0, hi0))
+        return 0.5 * (lo + hi)
+
+    lower = jnp.where(
+        log_mixture(jnp.float32(0.0)) < crit,
+        jnp.float32(0.0),
+        boundary(jnp.float32(0.0), p_hat, False),
+    )
+    upper = jnp.where(
+        log_mixture(jnp.float32(1.0)) < crit,
+        jnp.float32(1.0),
+        boundary(p_hat, jnp.float32(1.0), True),
+    )
+    degenerate = (n == 0) | (log_mixture(p_hat) >= crit)
+    lower = jnp.where(degenerate, jnp.float32(0.0), lower)
+    upper = jnp.where(degenerate, jnp.float32(1.0), upper)
+    return lower, upper

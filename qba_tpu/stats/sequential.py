@@ -120,6 +120,30 @@ class MixtureMartingaleCI:
         self.k += int(k)
         self.n += int(n)
 
+    def width_at(self, k: int, n: int) -> float:
+        """Interval width at totals ``(k, n)`` without touching this
+        rule's state — the probe the device stop tables are built from
+        (stats/device.py).  Evaluates exactly the arithmetic
+        :meth:`decision` consults, so "``width_at(k, n) <=
+        target_width``" IS the host stopping predicate at those totals.
+        """
+        probe = MixtureMartingaleCI(
+            confidence=self.confidence, target_width=self.target_width
+        )
+        probe.k, probe.n = int(k), int(n)
+        lo, hi = probe.interval()
+        return hi - lo
+
+    def interval_at(self, k: int, n: int) -> tuple[float, float]:
+        """The running interval at totals ``(k, n)``, state-free (the
+        straddle probe used by the device allocator's verification
+        tests)."""
+        probe = MixtureMartingaleCI(
+            confidence=self.confidence, target_width=self.target_width
+        )
+        probe.k, probe.n = int(k), int(n)
+        return probe.interval()
+
     def _log_mixture(self, p: float) -> float:
         """log M_n(p) for the current counts."""
         a = b = 0.5
@@ -245,6 +269,8 @@ class SPRT:
         self.p1 = _clip_p(threshold + delta)
         self.log_a = math.log((1.0 - beta) / alpha)  # accept H1 above this
         self.log_b = math.log(beta / (1.0 - alpha))  # accept H0 below this
+        self._s = math.log(self.p1 / self.p0)  # per-success increment
+        self._f = math.log((1.0 - self.p1) / (1.0 - self.p0))  # per-failure
         self.llr = 0.0
         self.n = 0
         self.k = 0
@@ -252,16 +278,24 @@ class SPRT:
             confidence=confidence if confidence is not None else 1.0 - alpha
         )
 
+    def llr_at(self, k: int, n: int) -> float:
+        """The LLR at totals ``(k, n)`` — a pure function of the counts.
+        :meth:`observe` keeps ``self.llr`` in exactly this totals form
+        (not a per-chunk float accumulation), so the host stopping
+        predicate is path-independent and the device stop tables
+        (stats/device.py) can reproduce it exactly."""
+        return k * self._s + (n - k) * self._f
+
     def observe(self, k: int, n: int) -> None:
         """Fold a chunk's counts into the running LLR (the per-trial LLR
-        is linear in the success count, so chunk aggregation is exact)."""
+        is linear in the success count, so chunk aggregation is exact;
+        the stored value is recomputed from totals — see
+        :meth:`llr_at`)."""
         if not 0 <= k <= n:
             raise ValueError(f"need 0 <= k <= n, got k={k}, n={n}")
-        self.llr += k * math.log(self.p1 / self.p0) + (n - k) * math.log(
-            (1.0 - self.p1) / (1.0 - self.p0)
-        )
         self.n += int(n)
         self.k += int(k)
+        self.llr = self.llr_at(self.k, self.n)
         self.ci.observe(k, n)
 
     def decision(self) -> StopDecision | None:

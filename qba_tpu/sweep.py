@@ -11,16 +11,22 @@ chunk's key tree is a pure function of ``(seed, chunk_index)``.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import os
 import tempfile
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from qba_tpu.config import QBAConfig
-from qba_tpu.diagnostics import QBACheckpointMismatch, warn_and_record
+from qba_tpu.diagnostics import (
+    QBACheckpointMismatch,
+    QBAWarning,
+    warn_and_record,
+)
 from qba_tpu.obs.events import EventLog
 from qba_tpu.obs.timers import PhaseTimers
 from qba_tpu.stats.estimators import SweepEstimators
@@ -54,6 +60,11 @@ class SweepResult:
     # the trial data is the identity; a targeted run that executed the
     # same chunks as a fixed-budget run compares equal to it.
     stop: StopDecision | None = dataclasses.field(default=None, compare=False)
+    # Which control loop produced the chunks: "host" (per-chunk fenced
+    # readbacks) or "device" (one lax.while_loop dispatch, one loop-level
+    # fenced readback).  compare=False for the same reason as ``stop`` —
+    # both modes execute bit-identical chunks (docs/STATS.md).
+    dispatch: str = dataclasses.field(default="host", compare=False)
 
     @property
     def n_trials(self) -> int:
@@ -88,6 +99,7 @@ class SweepResult:
         stop decision rides along on targeted runs."""
         out = self.estimators(method=method, confidence=confidence).summary()
         out["n_trials"] = self.n_trials
+        out["dispatch"] = self.dispatch
         if self.stop is not None:
             out["stop"] = self.stop.to_json()
         return out
@@ -291,6 +303,219 @@ def _replay_prefix(
     return replayed, None
 
 
+# ---------------------------------------------------------------------------
+# Device-resident sequential decisions (ROADMAP item 3, docs/STATS.md
+# "Device-resident stopping"): the stopping predicate IS the condition of
+# a lax.while_loop, so a targeted run performs exactly ONE dispatch — no
+# per-chunk fenced readback, no host-side rule update in the hot loop.
+# The loop carries only integer counts; the typed StopDecision is
+# produced on the host by replaying the readback counts through the same
+# rule the host loop uses, so the surfaced decision, the executed
+# chunks, and the checkpoint payload are identical across dispatch modes.
+
+
+def _device_while(cfg, n_chunks, chunk_trials, carry, lo, hi, keys_for):
+    """The shared while_loop: condition = budget AND NOT stop-table hit.
+
+    Carry is ``(i, k_total, counts[n_chunks], overflow[n_chunks])`` —
+    ``i`` counts completed chunks, the tables are indexed by it, and
+    per-chunk counts are kept so the host can replay the rule chunk by
+    chunk (checkpoint parity across dispatch modes)."""
+    from qba_tpu.rounds.engine import run_chunk_counts
+
+    def cond(c):
+        i, k_total, _, _ = c
+        return (i < n_chunks) & ~((k_total <= lo[i]) | (k_total >= hi[i]))
+
+    def body(c):
+        i, k_total, counts, ovf = c
+        k, o = run_chunk_counts(cfg, keys_for(i))
+        return (i + 1, k_total + k, counts.at[i].set(k), ovf.at[i].set(o))
+
+    return jax.lax.while_loop(cond, body, carry)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(3,))
+def _device_loop_foldin(cfg, n_chunks, chunk_trials, carry, lo, hi):
+    """Device-resident targeted sweep loop with the sweep key
+    discipline: chunk ``i``'s keys are re-derived IN the loop body as
+    ``split(fold_in(key(seed), i), chunk_trials)`` — exactly
+    :func:`chunk_keys` — so the device run consumes randomness
+    bit-identical to the host loop's chunk ``i``.  The carry is donated
+    (KI-5): the loop state buffers are reused across iterations instead
+    of re-allocated per dispatch."""
+
+    def keys_for(i):
+        root = jax.random.fold_in(jax.random.key(cfg.seed), i)
+        return jax.random.split(root, chunk_trials)
+
+    return _device_while(cfg, n_chunks, chunk_trials, carry, lo, hi, keys_for)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(3,))
+def _device_loop_prefix(cfg, n_chunks, chunk_trials, carry, lo, hi, keys):
+    """Device-resident loop over PRE-ASSIGNED per-trial keys (leading
+    axis ``n_chunks * chunk_trials``): chunk ``i`` consumes rows
+    ``[i*chunk_trials, (i+1)*chunk_trials)``.  This is the serve key
+    discipline (``split(key(seed), trials)`` prefix semantics) — the
+    device early-finish path reads the same per-trial keys the host
+    serve scheduler would have fed its segments.
+
+    Unlike the sweep loop this carry also keeps the per-trial success
+    bits (``succ bool[n_chunks*chunk_trials]``): a served result
+    reports the per-trial ``success`` list, not just chunk counts."""
+    from qba_tpu.rounds.engine import run_chunk_outcomes
+
+    def cond(c):
+        i, k_total, _, _, _ = c
+        return (i < n_chunks) & ~((k_total <= lo[i]) | (k_total >= hi[i]))
+
+    def body(c):
+        i, k_total, counts, ovf, succ = c
+        ks = jax.lax.dynamic_slice_in_dim(
+            keys, i * chunk_trials, chunk_trials
+        )
+        s, o = run_chunk_outcomes(cfg, ks)
+        k = jnp.sum(s.astype(jnp.int32))
+        succ = jax.lax.dynamic_update_slice_in_dim(
+            succ, s, i * chunk_trials, axis=0
+        )
+        return (i + 1, k_total + k, counts.at[i].set(k), ovf.at[i].set(o), succ)
+
+    return jax.lax.while_loop(cond, body, carry)
+
+
+def _device_carry(n_chunks: int, start_chunk: int, k_start: int):
+    return (
+        jnp.int32(start_chunk),
+        jnp.int32(k_start),
+        jnp.zeros(n_chunks, jnp.int32),
+        jnp.zeros(n_chunks, jnp.bool_),
+    )
+
+
+def _device_carry_prefix(n_chunks: int, chunk_trials: int):
+    return _device_carry(n_chunks, 0, 0) + (
+        jnp.zeros(n_chunks * chunk_trials, jnp.bool_),
+    )
+
+
+def _run_sweep_targeted_device(
+    cfg: QBAConfig,
+    target: Target,
+    n_chunks: int,
+    chunk_trials: int,
+    checkpoint: str | None,
+    log: EventLog | None,
+    timers: PhaseTimers,
+    resume_force: bool,
+) -> SweepResult:
+    """The ``dispatch="device"`` targeted path: ONE dispatch of
+    :func:`_device_loop_foldin`, one loop-level fenced readback, then a
+    host replay of the per-chunk counts through ``target``'s rule —
+    yielding the same executed chunks, the same :class:`StopDecision`,
+    and the same checkpoint payload as :func:`_run_sweep_targeted` for
+    identical keys (tests/test_device_loop.py pins the triad)."""
+    from qba_tpu.stats.device import stop_tables
+
+    rule = target.make_rule()
+    loaded = (
+        load_checkpoint(checkpoint, cfg, chunk_trials, force=resume_force)
+        if checkpoint
+        else []
+    )
+    chunks, decision = _replay_prefix(loaded, rule, n_chunks)
+    resumed = len(chunks)
+    extra = [c for c in loaded if c.chunk >= len(chunks)]
+    if log and resumed:
+        log.info(
+            "sweep",
+            "resumed targeted run from checkpoint",
+            chunks=resumed,
+            path=checkpoint,
+            dispatch="device",
+        )
+
+    start = len(chunks)
+    if decision is None and start < n_chunks:
+        lo, hi = stop_tables(target, n_chunks, chunk_trials)
+        k_start = sum(c.successes for c in chunks)
+        carry = _device_carry(n_chunks, start, k_start)
+        with timers.time(
+            "device_loop",
+            budget_chunks=n_chunks - start,
+            chunk_trials=chunk_trials,
+        ) as sp:
+            i_stop, _, counts, ovf = _device_loop_foldin(
+                cfg, n_chunks, chunk_trials, carry,
+                jnp.asarray(lo), jnp.asarray(hi),
+            )
+            # The single loop-level readback barrier: the device decided
+            # when to stop; these reads are the only device->host
+            # transfer of the whole targeted run.
+            i_stop = int(i_stop)
+            counts_h = np.asarray(counts)
+            ovf_h = np.asarray(ovf)
+            sp.fenced = True
+        for c in range(start, i_stop):
+            cr = ChunkResult(
+                chunk=c,
+                trials=chunk_trials,
+                successes=int(counts_h[c]),
+                overflow=bool(ovf_h[c]),
+            )
+            chunks.append(cr)
+            rule.observe(cr.successes, cr.trials)
+            decision = rule.decision()
+            if decision is not None:
+                break
+        executed = len(chunks)
+        # A decision landing exactly on the final budget chunk is
+        # consistent: the loop exits on i == n_chunks either way.
+        if executed != i_stop or (decision is None and i_stop < n_chunks):
+            # The stop tables are built by bisection over the rule's own
+            # arithmetic, so a divergence means a real bug — surface it
+            # loudly but keep the (valid) executed chunks.
+            warn_and_record(
+                "device stop table diverged from the host rule: device "
+                f"stopped after {i_stop} chunks, host replay after "
+                f"{executed}",
+                QBAWarning,
+                site="sweep._run_sweep_targeted_device",
+                device_stop=i_stop,
+                host_stop=executed,
+            )
+        if checkpoint:
+            save_checkpoint(
+                checkpoint,
+                cfg,
+                chunk_trials,
+                chunks + extra,
+                stats={
+                    "target": target.to_json(),
+                    "stop": decision.to_json() if decision else None,
+                    "dispatch": "device",
+                },
+            )
+
+    stop = decision if decision is not None else rule.exhausted()
+    if log:
+        log.info(
+            "sweep",
+            "targeted sweep stopped",
+            reason=stop.reason,
+            n_trials=stop.n_trials,
+            dispatch="device",
+        )
+    return SweepResult(
+        cfg=cfg,
+        chunks=tuple(chunks),
+        resumed_chunks=resumed,
+        stop=stop,
+        dispatch="device",
+    )
+
+
 def _run_sweep_targeted(
     cfg: QBAConfig,
     target: Target,
@@ -412,6 +637,319 @@ def _surface_grid(
                     )
                 grid.append((strat, p_dep, p_mf, size_l, cfg_cell, ckpt))
     return grid
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=(5,)
+)
+def _device_surface_loop(
+    cfgs, steps, chunk_trials, confidence, threshold, carry, lo, hi
+):
+    """The single-dispatch adaptive SURFACE: one ``lax.while_loop``
+    carrying the allocator's largest-uncertainty-first tiering across
+    every grid cell (``cfgs``, a static tuple — one traced branch per
+    cell under ``lax.switch``).
+
+    Per step the loop scores every unresolved cell exactly like
+    :meth:`AdaptiveAllocator._priority` — tier 0 bootstrap in index
+    order, tier 1 straddling / tier 2 undecided widest-CI-first, ties
+    by index (``argmin`` returns the first minimum) — then switches
+    into the chosen cell's chunk program.  Cell widths come from the
+    traced float32 mixture-CI bisection
+    (:func:`qba_tpu.stats.device.device_ci_interval`); stop decisions
+    always go through the exact integer tables, so float32 can only
+    reorder near-tied *scheduling*, never change a cell's decision
+    (docs/STATS.md).  ``threshold`` is the decide boundary, or None for
+    width targets (every open cell straddles by definition).
+
+    Carry: ``(step, k_cell, i_cell, done, counts[n_cells, budget],
+    ovf[n_cells, budget], sched[steps], tier[steps])`` — donated
+    (KI-5).  ``sched``/``tier`` record the device's allocation order so
+    the host replay reconstructs the exact trace.
+    """
+    from qba_tpu.rounds.engine import run_chunk_counts
+    from qba_tpu.stats.device import device_ci_interval
+
+    n_cells = len(cfgs)
+    branches = [
+        (lambda keys, c=c: run_chunk_counts(c, keys)) for c in cfgs
+    ]
+    seed = cfgs[0].seed  # chunk keys are seed+index pure; seed is shared
+
+    def cond(c):
+        s, _, _, done, _, _, _, _ = c
+        return (s < steps) & ~jnp.all(done)
+
+    def body(c):
+        s, kc, ic, done, counts, ovf, sched, tier_log = c
+        ci_lo, ci_hi = jax.vmap(
+            lambda k, n: device_ci_interval(k, n, confidence)
+        )(kc, ic * chunk_trials)
+        width = ci_hi - ci_lo
+        boot = ic == 0
+        if threshold is None:
+            straddle = jnp.ones(n_cells, bool)
+        else:
+            straddle = (ci_lo <= threshold) & (threshold <= ci_hi)
+        tier = jnp.where(boot, 0, jnp.where(straddle, 1, 2))
+        # Lexicographic (tier, -width, index) as one float score: tiers
+        # are 2 apart, 1-width is in [0, 1], bootstrap ignores width
+        # (host sorts bootstrap cells purely by index); argmin takes
+        # the first minimum, which IS the index tie-break.
+        score = jnp.where(
+            done,
+            jnp.float32(1e9),
+            tier.astype(jnp.float32) * 2.0
+            + jnp.where(boot, 0.0, 1.0 - width),
+        )
+        chosen = jnp.argmin(score)
+        i_cur = ic[chosen]
+        root = jax.random.fold_in(jax.random.key(seed), i_cur)
+        keys = jax.random.split(root, chunk_trials)
+        k, o = jax.lax.switch(chosen, branches, keys)
+        k_new = kc[chosen] + k
+        i_new = i_cur + 1
+        stopped = (k_new <= lo[i_new]) | (k_new >= hi[i_new])
+        return (
+            s + 1,
+            kc.at[chosen].set(k_new),
+            ic.at[chosen].set(i_new),
+            done.at[chosen].set(stopped),
+            counts.at[chosen, i_cur].set(k),
+            ovf.at[chosen, i_cur].set(o),
+            sched.at[s].set(chosen),
+            tier_log.at[s].set(tier[chosen]),
+        )
+
+    return jax.lax.while_loop(cond, body, carry)
+
+
+def _run_surface_targeted_device(
+    cfg: QBAConfig,
+    strategies,
+    noise_points,
+    size_ls,
+    target: Target,
+    budget_chunks: int,
+    chunk_trials: int,
+    checkpoint_dir: str | None,
+    log: EventLog | None,
+    with_manifest: bool,
+    resume_force: bool,
+) -> list[SurfaceCell]:
+    """The ``dispatch="device"`` surface: the whole adaptive grid runs
+    as ONE dispatch of :func:`_device_surface_loop`; the host replays
+    the readback (schedule order + per-cell counts) through the same
+    per-cell rules to surface typed :class:`StopDecision`\\ s, the
+    allocator trace, per-cell checkpoints and manifests — identical
+    artifact shapes to :func:`_run_surface_targeted`."""
+    from qba_tpu.diagnostics import record_decisions
+    from qba_tpu.obs.manifest import collect_manifest
+    from qba_tpu.stats.device import stop_tables
+
+    grid = _surface_grid(cfg, strategies, noise_points, size_ls, checkpoint_dir)
+    labels = [
+        f"{strat}_p{p_dep}_q{p_mf}_L{size_l}"
+        for strat, p_dep, p_mf, size_l, _, _ in grid
+    ]
+    n_cells = len(grid)
+    timers = PhaseTimers()
+    rules = [target.make_rule() for _ in grid]
+    cell_chunks: list[list[ChunkResult]] = [[] for _ in grid]
+    cell_decision: list[StopDecision | None] = [None] * n_cells
+    cell_resumed = [0] * n_cells
+    trace: list[dict[str, Any]] = []
+
+    # Resume: replay each cell's checkpointed contiguous prefix, in
+    # cell-index order — same rule state and budget accounting as the
+    # host allocator's preload.
+    spent = 0
+    for idx, (_, _, _, _, cfg_cell, ckpt) in enumerate(grid):
+        if not ckpt:
+            continue
+        loaded = load_checkpoint(
+            ckpt, cfg_cell, chunk_trials, force=resume_force
+        )
+        replayed, dec = _replay_prefix(loaded, rules[idx], budget_chunks)
+        cell_chunks[idx] = replayed
+        cell_decision[idx] = dec
+        cell_resumed[idx] = len(replayed)
+        for _ in replayed:
+            trace.append(
+                {
+                    "step": spent,
+                    "cell": idx,
+                    "label": labels[idx],
+                    "reason": "resume",
+                    "ci_width": None,
+                }
+            )
+            spent += 1
+        if log and cell_resumed[idx]:
+            log.info(
+                "surface",
+                "cell resumed from checkpoint",
+                cell=labels[idx],
+                chunks=cell_resumed[idx],
+            )
+
+    steps = max(0, budget_chunks - spent)
+    open_cells = any(d is None for d in cell_decision)
+    decisions_log: list[dict] = []
+    if steps > 0 and open_cells:
+        lo, hi = stop_tables(target, budget_chunks, chunk_trials)
+        carry = (
+            jnp.int32(0),
+            jnp.asarray([r.k for r in rules], jnp.int32),
+            jnp.asarray([len(c) for c in cell_chunks], jnp.int32),
+            jnp.asarray([d is not None for d in cell_decision], bool),
+            jnp.zeros((n_cells, budget_chunks), jnp.int32),
+            jnp.zeros((n_cells, budget_chunks), jnp.bool_),
+            jnp.zeros(steps, jnp.int32),
+            jnp.zeros(steps, jnp.int32),
+        )
+        cfgs = tuple(g[4] for g in grid)
+        threshold = target.threshold if target.kind == "decide" else None
+        with record_decisions() as decisions_log:
+            with timers.time(
+                "device_loop",
+                budget_chunks=steps,
+                cells=n_cells,
+                chunk_trials=chunk_trials,
+            ) as sp:
+                out = _device_surface_loop(
+                    cfgs, steps, chunk_trials,
+                    target.confidence, threshold, carry,
+                    jnp.asarray(lo), jnp.asarray(hi),
+                )
+                s_final = int(out[0])
+                counts_h = np.asarray(out[4])
+                ovf_h = np.asarray(out[5])
+                sched_h = np.asarray(out[6])
+                tier_h = np.asarray(out[7])
+                sp.fenced = True
+
+        # Host replay of the device schedule: exact rule state, exact
+        # decisions, manifest-grade trace.
+        for s in range(s_final):
+            idx = int(sched_h[s])
+            chunk_index = len(cell_chunks[idx])
+            est_width = (
+                rules[idx].estimate().width if chunk_index else None
+            )
+            cr = ChunkResult(
+                chunk=chunk_index,
+                trials=chunk_trials,
+                successes=int(counts_h[idx, chunk_index]),
+                overflow=bool(ovf_h[idx, chunk_index]),
+            )
+            cell_chunks[idx].append(cr)
+            rules[idx].observe(cr.successes, cr.trials)
+            trace.append(
+                {
+                    "step": spent,
+                    "cell": idx,
+                    "label": labels[idx],
+                    "reason": (
+                        "bootstrap", "straddling", "undecided"
+                    )[int(tier_h[s])],
+                    "ci_width": est_width,
+                }
+            )
+            spent += 1
+            dec = rules[idx].decision()
+            if dec is not None and cell_decision[idx] is None:
+                cell_decision[idx] = dec
+            if log:
+                log.info(
+                    "surface",
+                    "allocated chunk done",
+                    cell=labels[idx],
+                    chunk=chunk_index,
+                    successes=cr.successes,
+                    decided=dec is not None,
+                    dispatch="device",
+                )
+
+    for idx, (_, _, _, _, cfg_cell, ckpt) in enumerate(grid):
+        if ckpt and len(cell_chunks[idx]) > cell_resumed[idx]:
+            save_checkpoint(
+                ckpt,
+                cfg_cell,
+                chunk_trials,
+                cell_chunks[idx],
+                stats={
+                    "target": target.to_json(),
+                    "stop": (
+                        cell_decision[idx].to_json()
+                        if cell_decision[idx]
+                        else None
+                    ),
+                    "dispatch": "device",
+                },
+            )
+
+    decisions = [
+        cell_decision[i]
+        if cell_decision[i] is not None
+        else rules[i].exhausted()
+        for i in range(n_cells)
+    ]
+    alloc_summary = {
+        "target": target.to_json(),
+        "budget_chunks": budget_chunks,
+        "spent_chunks": spent,
+        "dispatch": "device",
+        "cells": [
+            {
+                "index": i,
+                "label": labels[i],
+                "chunks_run": len(cell_chunks[i]),
+                "decision": decisions[i].to_json(),
+            }
+            for i in range(n_cells)
+        ],
+        "trace": trace,
+    }
+    cells: list[SurfaceCell] = []
+    for idx, (strat, p_dep, p_mf, size_l, cfg_cell, _) in enumerate(grid):
+        res = SweepResult(
+            cfg=cfg_cell,
+            chunks=tuple(cell_chunks[idx]),
+            resumed_chunks=cell_resumed[idx],
+            stop=decisions[idx],
+            dispatch="device",
+        )
+        manifest = None
+        if with_manifest:
+            stats_block = res.stats_summary(confidence=target.confidence)
+            stats_block["target"] = target.to_json()
+            stats_block["allocator"] = alloc_summary
+            manifest = collect_manifest(
+                cfg_cell,
+                command="surface",
+                decisions=list(decisions_log),
+                extra={"stats": stats_block},
+            )
+        cells.append(
+            SurfaceCell(
+                strategy=strat,
+                p_depolarize=p_dep,
+                p_measure_flip=p_mf,
+                size_l=size_l,
+                result=res,
+                manifest=manifest,
+            )
+        )
+        if log:
+            log.info(
+                "surface",
+                "cell resolved",
+                cell=labels[idx],
+                reason=decisions[idx].reason,
+                n_trials=res.n_trials,
+            )
+    return cells
 
 
 def _run_surface_targeted(
@@ -563,6 +1101,7 @@ def run_surface(
     target: Target | str | None = None,
     budget_chunks: int | None = None,
     resume_force: bool = False,
+    dispatch: str = "host",
 ) -> list[SurfaceCell]:
     """The (strategy × noise × sizeL) adversary surface as ONE sharded
     Monte-Carlo: every cell is a :func:`run_sweep` over the same runner
@@ -586,16 +1125,54 @@ def run_surface(
     the grid, largest-uncertainty-first, until every cell's stopping
     rule resolves or the budget runs out.  ``resume_force`` forwards to
     :func:`load_checkpoint`.
+
+    ``dispatch="device"`` (targeted runs only) moves the allocator loop
+    itself onto the device: the whole grid becomes ONE
+    ``lax.while_loop`` dispatch carrying the uncertainty tiering across
+    cells (docs/STATS.md "Device-resident stopping").  Per-cell chunk
+    contents and stop decisions match the host allocator's rules
+    exactly; the *schedule* may reorder near-tied cells (float32 width
+    ordering on device vs float64 on host).
     """
     from qba_tpu.diagnostics import record_decisions
     from qba_tpu.obs.manifest import collect_manifest
 
+    if dispatch not in ("host", "device"):
+        raise ValueError(
+            f"dispatch must be 'host' or 'device', got {dispatch!r}"
+        )
+    if dispatch == "device" and target is None:
+        raise ValueError(
+            "dispatch='device' needs a target: the device surface loop's "
+            "condition is the all-cells-resolved predicate"
+        )
+    if dispatch == "device" and runner is not None:
+        raise ValueError(
+            "dispatch='device' cannot take a custom runner: the loop "
+            "body switches into each cell's traced chunk program"
+        )
     if chunk_trials is None:
         chunk_trials = cfg.trials
     if target is not None:
         if isinstance(target, str):
             target = parse_target(target)
         n_cells = len(strategies) * len(noise_points) * len(size_ls)
+        if dispatch == "device":
+            return _run_surface_targeted_device(
+                cfg,
+                strategies,
+                noise_points,
+                size_ls,
+                target,
+                budget_chunks
+                if budget_chunks is not None
+                else n_chunks * n_cells,
+                chunk_trials,
+                checkpoint_dir,
+                log,
+                with_manifest,
+                resume_force,
+            )
         return _run_surface_targeted(
             cfg,
             strategies,
@@ -667,6 +1244,7 @@ def run_sweep(
     runner=None,
     target: Target | str | None = None,
     resume_force: bool = False,
+    dispatch: str = "host",
 ) -> SweepResult:
     """Run ``n_chunks`` batches of ``chunk_trials`` trials each.
 
@@ -689,7 +1267,34 @@ def run_sweep(
     bit-identical to the fixed-budget run's prefix (docs/STATS.md).
     ``resume_force`` forwards to :func:`load_checkpoint` (re-chunk
     instead of refusing on a chunk_trials mismatch).
+
+    ``dispatch`` selects the targeted run's control loop: ``"host"``
+    (the PR 10 per-chunk loop — dispatch, fenced readback, host rule
+    update, repeat) or ``"device"`` (the whole budget in ONE
+    ``lax.while_loop`` whose condition is the stopping predicate; one
+    loop-level fenced readback).  Both execute bit-identical chunks and
+    stop at the same chunk boundary (docs/STATS.md "Device-resident
+    stopping").  ``"device"`` requires ``target`` and runs the built-in
+    engine batch — it cannot take a custom ``runner`` (the loop body is
+    the traced program itself).
     """
+    if dispatch not in ("host", "device"):
+        raise ValueError(
+            f"dispatch must be 'host' or 'device', got {dispatch!r}"
+        )
+    if dispatch == "device":
+        if target is None:
+            raise ValueError(
+                "dispatch='device' needs a target: the device loop's "
+                "condition IS the stopping predicate (a fixed-budget "
+                "sweep has nothing to decide on device — use the "
+                "double-buffered host path)"
+            )
+        if runner is not None:
+            raise ValueError(
+                "dispatch='device' cannot take a custom runner: the "
+                "loop body is the traced vmap(run_trial) chunk program"
+            )
     if chunk_trials is None:
         chunk_trials = cfg.trials
 
@@ -709,6 +1314,17 @@ def run_sweep(
     if target is not None:
         if isinstance(target, str):
             target = parse_target(target)
+        if dispatch == "device":
+            return _run_sweep_targeted_device(
+                cfg,
+                target,
+                n_chunks,
+                chunk_trials,
+                checkpoint,
+                log,
+                timers or PhaseTimers(),
+                resume_force,
+            )
         return _run_sweep_targeted(
             cfg,
             target,

@@ -1,0 +1,64 @@
+"""Seeded KI-6 violation: a host callback inside a device loop body.
+
+``leaky_loop`` builds the same shape of sequential program as the
+shipped ``sweep._device_loop_foldin`` — a ``lax.while_loop`` whose
+condition is the stopping predicate — but its body reports progress
+through ``jax.debug.callback``, a host round trip per chunk.  That is
+exactly the failure mode the ``check_device_loop`` obligations exist
+to catch: the single-dispatch contract is void if any iteration can
+re-enter the host, fenced or not.
+
+``clean_loop`` is the shipped discipline: the body stays
+transfer-free and the host reads the carry back exactly once, after
+the loop returns.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_body(seed, i, chunk_trials):
+    """A stand-in engine chunk: a round ``scan`` over folded-in keys,
+    reduced to a success count — structurally what the real loop body
+    dispatches."""
+    key = jax.random.fold_in(jax.random.key(seed), i)
+    bits = jax.random.bernoulli(key, 0.5, (chunk_trials,))
+
+    def round_step(carry, b):
+        return carry + b.astype(jnp.int32), None
+
+    k, _ = jax.lax.scan(round_step, jnp.int32(0), bits)
+    return k
+
+
+def leaky_loop(seed, n_chunks, chunk_trials, lo, hi):
+    """KI-6 device-loop finding: per-chunk host callback in the body."""
+
+    def cond(c):
+        i, k_total, _ = c
+        return (i < n_chunks) & ~((k_total <= lo[i]) | (k_total >= hi[i]))
+
+    def body(c):
+        i, k_total, counts = c
+        k = _chunk_body(seed, i, chunk_trials)
+        jax.debug.callback(lambda kk: None, k)  # the leak
+        return (i + 1, k_total + k, counts.at[i].set(k))
+
+    carry = (jnp.int32(0), jnp.int32(0), jnp.zeros(n_chunks, jnp.int32))
+    return jax.lax.while_loop(cond, body, carry)
+
+
+def clean_loop(seed, n_chunks, chunk_trials, lo, hi):
+    """The shipped form: a transfer-free body; one readback after."""
+
+    def cond(c):
+        i, k_total, _ = c
+        return (i < n_chunks) & ~((k_total <= lo[i]) | (k_total >= hi[i]))
+
+    def body(c):
+        i, k_total, counts = c
+        k = _chunk_body(seed, i, chunk_trials)
+        return (i + 1, k_total + k, counts.at[i].set(k))
+
+    carry = (jnp.int32(0), jnp.int32(0), jnp.zeros(n_chunks, jnp.int32))
+    return jax.lax.while_loop(cond, body, carry)

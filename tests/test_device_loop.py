@@ -1,0 +1,480 @@
+"""Device-resident sequential stopping (ROADMAP item 3, docs/STATS.md
+"Device-resident stopping").
+
+Five contracts:
+
+* **Table exactness** — :func:`qba_tpu.stats.device.stop_tables` agrees
+  with the host rule's own ``decision()`` at EVERY reachable
+  ``(successes, chunks)`` total, by brute force; the width rule's
+  unimodality (the structural assumption behind the two-ended table) is
+  pinned per ``n``.
+* **Stop-boundary bit-identity** — the triad: the host targeted loop,
+  the device ``lax.while_loop``, and the fixed-budget run's prefix all
+  execute bit-identical chunks and stop at the same chunk boundary, on
+  every round engine and across shapes, strategies and noise.
+* **Checkpoint interop** — a checkpoint written by either dispatch mode
+  resumes under the other with identical chunks and stop decision.
+* **KI-6 single-dispatch proof** — the shipped loop's traced jaxpr
+  carries zero host callbacks/infeed/outfeed and exactly one
+  ``while`` holding the engine program; the seeded bad fixture is
+  flagged.
+* **Serve parity** — a device-dispatch server returns EvalResults
+  bit-identical to the host server's, and ineligible requests fall back
+  to the host bucket path.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from qba_tpu.config import QBAConfig
+from qba_tpu.obs.timers import PhaseTimers
+from qba_tpu.stats import parse_target
+from qba_tpu.stats.device import stop_tables
+from qba_tpu.sweep import run_surface, run_sweep
+
+DECIDE = "decide vs 1/3 @ 95%"
+
+
+def _fires_host(target, k, n):
+    """The host rule's own verdict at totals (k, n): fresh rule, one
+    aggregate observation (both PR 10 rules are totals-pure)."""
+    rule = target.make_rule()
+    rule.observe(k, n)
+    return rule.decision() is not None
+
+
+def _triad(cfg, target, n_chunks, chunk_trials):
+    """Host loop vs device loop vs fixed-budget prefix; returns
+    (host, device) results after asserting the bit-identity bar."""
+    host = run_sweep(
+        cfg, n_chunks=n_chunks, chunk_trials=chunk_trials, target=target
+    )
+    dev = run_sweep(
+        cfg,
+        n_chunks=n_chunks,
+        chunk_trials=chunk_trials,
+        target=target,
+        dispatch="device",
+    )
+    # Same executed chunks (ChunkResult equality ignores timings), same
+    # stop boundary, same typed decision — including the anytime-valid
+    # estimate surfaced at stop.
+    assert dev.chunks == host.chunks
+    assert dev.stop == host.stop
+    assert dev.dispatch == "device" and host.dispatch == "host"
+    # The fixed-budget run's prefix is the same trial data: stopping
+    # early never changes what was computed, only how much.
+    fixed = run_sweep(cfg, n_chunks=n_chunks, chunk_trials=chunk_trials)
+    assert host.chunks == fixed.chunks[: len(host.chunks)]
+    return host, dev
+
+
+class TestStopTables:
+    @pytest.mark.parametrize(
+        "spec",
+        [
+            "decide vs 1/3 @ 95%",
+            "decide vs 0.5 @ 99%",
+            "ci_width<=0.28",
+            "ci_width<=0.5",
+        ],
+    )
+    def test_brute_force_equivalence(self, spec):
+        # The defining property: at every reachable (K, N = i*ct) the
+        # table fires iff the host rule's decision() fires.
+        target = parse_target(spec)
+        n_chunks, ct = 6, 7
+        lo, hi = stop_tables(target, n_chunks, ct)
+        for i in range(1, n_chunks + 1):
+            n = i * ct
+            for k in range(n + 1):
+                table_fires = bool(k <= lo[i] or k >= hi[i])
+                assert table_fires == _fires_host(target, k, n), (
+                    spec,
+                    i,
+                    k,
+                )
+
+    def test_row_zero_is_sentinel(self):
+        # Zero observations never fire: the device loop, like the host
+        # loop, must run at least one chunk.
+        lo, hi = stop_tables(parse_target(DECIDE), 4, 8)
+        assert lo[0] == -1 and hi[0] == 1
+        assert lo.dtype == np.int32 and hi.dtype == np.int32
+        assert lo.shape == hi.shape == (5,)
+
+    @pytest.mark.parametrize("n", [8, 16, 41])
+    def test_width_unimodal_in_k(self, n):
+        # The structural assumption behind the two-ended width table:
+        # width_at(., n) rises to a single peak then falls — once the
+        # sequence turns down it never turns back up.
+        rule = parse_target("ci_width<=0.1").make_rule()
+        w = [rule.width_at(k, n) for k in range(n + 1)]
+        turned_down = False
+        for a, b in zip(w, w[1:]):
+            if b < a:
+                turned_down = True
+            elif b > a:
+                assert not turned_down, (n, w)
+
+    def test_validation(self):
+        t = parse_target(DECIDE)
+        with pytest.raises(ValueError, match="n_chunks"):
+            stop_tables(t, 0, 8)
+        with pytest.raises(ValueError, match="chunk_trials"):
+            stop_tables(t, 4, 0)
+
+
+class TestDeviceSweepTriad:
+    @pytest.mark.parametrize(
+        "engine,p,l,d,ct",
+        [
+            ("xla", 11, 64, 3, 8),
+            ("pallas_fused", 11, 64, 3, 8),
+            ("pallas_mega", 11, 64, 3, 8),
+            ("xla", 17, 16, 4, 16),
+            ("pallas_fused", 17, 16, 4, 16),
+            ("pallas_mega", 17, 16, 4, 16),
+        ],
+    )
+    def test_triad_engines(self, engine, p, l, d, ct):
+        # ISSUE 15 acceptance: host loop, device loop, and fixed-budget
+        # prefix stop at the same chunk boundary with bit-identical
+        # chunks — at 11p/64 and 17p/16 on all three engines.
+        cfg = QBAConfig(
+            n_parties=p,
+            size_l=l,
+            n_dishonest=d,
+            trials=ct,
+            seed=5,
+            round_engine=engine,
+        )
+        host, dev = _triad(cfg, DECIDE, 3, ct)
+        assert host.stop is not None and dev.stop is not None
+        assert dev.stop.reason == host.stop.reason
+
+    def test_triad_split_strategy(self):
+        cfg = QBAConfig(
+            n_parties=5,
+            size_l=8,
+            n_dishonest=2,
+            trials=8,
+            seed=9,
+            strategy="split",
+        )
+        _triad(cfg, DECIDE, 4, 8)
+
+    def test_triad_noise_point(self):
+        cfg = QBAConfig(
+            n_parties=5,
+            size_l=16,
+            n_dishonest=1,
+            trials=8,
+            seed=2,
+            p_depolarize=0.05,
+            p_measure_flip=0.02,
+        )
+        _triad(cfg, DECIDE, 4, 8)
+
+    def test_budget_exhausted_parity(self):
+        # A target no small budget can resolve: both loops run the
+        # whole budget and surface the same typed exhaustion.
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=1, trials=8, seed=4)
+        host, dev = _triad(cfg, "ci_width<=0.05", 2, 8)
+        assert host.stop.reason == "budget_exhausted"
+        assert dev.stop.reason == "budget_exhausted"
+        assert len(dev.chunks) == 2
+
+    def test_decision_on_final_budget_chunk_is_not_divergence(self):
+        # split @ seed 9 fires exactly at the last budget chunk: the
+        # loop exits on i == n_chunks either way, so the divergence
+        # check must stay quiet (it warned spuriously once).
+        import warnings
+
+        cfg = QBAConfig(
+            n_parties=5,
+            size_l=8,
+            n_dishonest=2,
+            trials=8,
+            seed=9,
+            strategy="split",
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            res = run_sweep(
+                cfg, n_chunks=4, chunk_trials=8, target=DECIDE,
+                dispatch="device",
+            )
+        assert len(res.chunks) == 4
+        assert res.stop.reason in ("decided_above", "decided_below")
+
+    def test_device_loop_is_one_fenced_span(self):
+        # Satellite: loop-level telemetry replaces the per-chunk
+        # dispatch/readback spans — a device run records ONE fenced
+        # device_loop span and zero per-chunk phases.
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=1, trials=8, seed=4)
+        timers = PhaseTimers()
+        res = run_sweep(
+            cfg,
+            n_chunks=4,
+            chunk_trials=8,
+            target=DECIDE,
+            dispatch="device",
+            timers=timers,
+        )
+        assert timers.total("device_loop") > 0.0
+        assert timers.total("dispatch") == 0.0
+        assert timers.total("readback") == 0.0
+        assert res.stats_summary()["dispatch"] == "device"
+
+    def test_device_dispatch_validation(self):
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=1, trials=8)
+        with pytest.raises(ValueError, match="needs a target"):
+            run_sweep(cfg, n_chunks=2, dispatch="device")
+        with pytest.raises(ValueError, match="custom runner"):
+            run_sweep(
+                cfg,
+                n_chunks=2,
+                target=DECIDE,
+                dispatch="device",
+                runner=lambda cfg, keys: None,
+            )
+        with pytest.raises(ValueError, match="dispatch must be"):
+            run_sweep(cfg, n_chunks=2, target=DECIDE, dispatch="tpu")
+
+
+class TestDeviceCheckpoint:
+    CFG = QBAConfig(n_parties=5, size_l=16, n_dishonest=1, trials=8, seed=11)
+
+    def test_device_checkpoint_resumes_on_host(self, tmp_path):
+        ckpt = str(tmp_path / "sweep.json")
+        dev = run_sweep(
+            self.CFG,
+            n_chunks=6,
+            chunk_trials=8,
+            target=DECIDE,
+            dispatch="device",
+            checkpoint=ckpt,
+        )
+        payload = json.loads(open(ckpt).read())
+        assert payload["stats"]["dispatch"] == "device"
+        host = run_sweep(
+            self.CFG,
+            n_chunks=6,
+            chunk_trials=8,
+            target=DECIDE,
+            checkpoint=ckpt,
+        )
+        assert host.resumed_chunks == len(dev.chunks)
+        assert host.chunks == dev.chunks
+        assert host.stop == dev.stop
+
+    def test_host_partial_checkpoint_resumes_on_device(self, tmp_path):
+        ckpt = str(tmp_path / "sweep.json")
+        # A budget too small to resolve leaves a partial prefix behind.
+        partial = run_sweep(
+            self.CFG,
+            n_chunks=1,
+            chunk_trials=8,
+            target="ci_width<=0.05",
+            checkpoint=ckpt,
+        )
+        assert partial.stop.reason == "budget_exhausted"
+        dev = run_sweep(
+            self.CFG,
+            n_chunks=4,
+            chunk_trials=8,
+            target=DECIDE,
+            dispatch="device",
+            checkpoint=ckpt,
+        )
+        assert dev.resumed_chunks == 1
+        fresh = run_sweep(
+            self.CFG, n_chunks=4, chunk_trials=8, target=DECIDE,
+            dispatch="device",
+        )
+        assert dev.chunks == fresh.chunks
+        assert dev.stop == fresh.stop
+
+
+class TestDeviceSurface:
+    def test_surface_parity_vs_host_allocator(self, tmp_path):
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=1, trials=8, seed=3)
+        kw = dict(
+            strategies=("reference",),
+            noise_points=[(0.0, 0.0)],
+            size_ls=[8, 16],
+            chunk_trials=8,
+            target=DECIDE,
+            budget_chunks=8,
+        )
+        host = run_surface(cfg, **kw)
+        dev = run_surface(cfg, dispatch="device", **kw)
+        assert len(host) == len(dev) == 2
+        for hc, dc in zip(host, dev):
+            # Per-cell chunk contents and stop decisions are exact
+            # (schedule ORDER may differ — f32 width tiering on device);
+            # with a budget that resolves every cell, the per-cell work
+            # is identical.
+            assert dc.result.chunks == hc.result.chunks
+            assert dc.result.stop == hc.result.stop
+            assert dc.result.dispatch == "device"
+            assert dc.manifest["stats"]["dispatch"] == "device"
+            alloc = dc.manifest["stats"]["allocator"]
+            assert alloc["dispatch"] == "device"
+            assert alloc["spent_chunks"] == (
+                hc.manifest["stats"]["allocator"]["spent_chunks"]
+            )
+
+    def test_device_surface_validation(self):
+        cfg = QBAConfig(n_parties=5, size_l=16, n_dishonest=1, trials=8)
+        with pytest.raises(ValueError, match="needs a target"):
+            run_surface(
+                cfg,
+                strategies=("reference",),
+                noise_points=[(0.0, 0.0)],
+                size_ls=[16],
+                dispatch="device",
+            )
+
+
+class TestDeviceLoopLint:
+    def test_shipped_loop_proven_clean(self):
+        from qba_tpu.analysis.transfers import check_device_loop
+
+        rep = check_device_loop()
+        assert rep.ok, [f.message for f in rep.findings]
+        assert any("PROVEN eliminated" in n for n in rep.notes)
+        assert rep.stats["device_loop_obligations"] == 3
+
+    def test_leaky_fixture_flagged(self):
+        from qba_tpu.analysis.transfers import audit_device_loop
+        from tests.analysis_fixtures import bad_device_loop as bdl
+
+        n, ct = 4, 8
+        lo = jnp.full(n + 1, -1, jnp.int32)
+        hi = jnp.full(n + 1, n * ct + 1, jnp.int32)
+        closed = jax.make_jaxpr(
+            lambda lo_, hi_: bdl.leaky_loop(0, n, ct, lo_, hi_)
+        )(lo, hi)
+        rep = audit_device_loop(closed, "fixture/leaky_loop")
+        assert not rep.ok
+        assert any(
+            "host round trip per loop iteration" in f.message
+            for f in rep.findings
+        )
+
+    def test_clean_fixture_passes(self):
+        from qba_tpu.analysis.transfers import audit_device_loop
+        from tests.analysis_fixtures import bad_device_loop as bdl
+
+        n, ct = 4, 8
+        lo = jnp.full(n + 1, -1, jnp.int32)
+        hi = jnp.full(n + 1, n * ct + 1, jnp.int32)
+        closed = jax.make_jaxpr(
+            lambda lo_, hi_: bdl.clean_loop(0, n, ct, lo_, hi_)
+        )(lo, hi)
+        rep = audit_device_loop(closed, "fixture/clean_loop")
+        assert rep.ok, [f.message for f in rep.findings]
+
+
+class TestServeDevice:
+    @staticmethod
+    def _run(dispatch, target, trials=256, ct=16, seed=3):
+        from qba_tpu.serve.engine import QBAServer, serve_batch
+        from qba_tpu.serve.request import EvalRequest
+
+        srv = QBAServer(chunk_trials=ct, dispatch=dispatch)
+        req = EvalRequest(
+            request_id="r1",
+            n_parties=5,
+            size_l=16,
+            n_dishonest=1,
+            trials=trials,
+            seed=seed,
+            round_engine="xla",
+            strategy="collude",
+            target=target,
+        )
+        (res,) = serve_batch(srv, [req])
+        assert res.error is None, res.error
+        return res, srv
+
+    @pytest.mark.parametrize("tgt", [DECIDE, "ci_width<=0.3"])
+    def test_parity_with_host_server(self, tgt):
+        h, _ = self._run("host", tgt)
+        d, srv = self._run("device", tgt)
+        assert d.n_trials == h.n_trials
+        assert d.successes == h.successes
+        assert d.success == h.success  # per-trial bits, bit-identical
+        assert d.stop == h.stop
+        assert d.ci == h.ci
+        assert d.chunks == h.chunks
+        assert d.manifest["stats"]["dispatch"] == "device"
+        assert srv.stats()["dispatch"] == "device"
+
+    def test_untargeted_request_falls_back_to_host_path(self):
+        from qba_tpu.serve.engine import QBAServer, serve_batch
+        from qba_tpu.serve.request import EvalRequest
+
+        srv = QBAServer(chunk_trials=16, dispatch="device")
+        req = EvalRequest(
+            request_id="u1",
+            n_parties=5,
+            size_l=16,
+            n_dishonest=1,
+            trials=32,
+            seed=7,
+            round_engine="xla",
+        )
+        (res,) = serve_batch(srv, [req])
+        assert res.error is None and res.n_trials == 32
+        assert "dispatch" not in (res.manifest["stats"] or {})
+
+    def test_return_decisions_falls_back_to_host_path(self):
+        from qba_tpu.serve.engine import QBAServer, serve_batch
+        from qba_tpu.serve.request import EvalRequest
+
+        srv = QBAServer(chunk_trials=16, dispatch="device")
+        req = EvalRequest(
+            request_id="d1",
+            n_parties=5,
+            size_l=16,
+            n_dishonest=1,
+            trials=64,
+            seed=7,
+            round_engine="xla",
+            target=DECIDE,
+            return_decisions=True,
+        )
+        (res,) = serve_batch(srv, [req])
+        assert res.error is None and res.decisions is not None
+
+    def test_dispatch_validation(self):
+        from qba_tpu.serve.engine import QBAServer
+
+        with pytest.raises(ValueError, match="dispatch"):
+            QBAServer(dispatch="tpu")
+
+
+class TestCarryBytes:
+    def test_device_loop_carry_accounting(self):
+        from qba_tpu.analysis.memory import device_loop_carry_bytes
+
+        base = device_loop_carry_bytes(64, 512)
+        assert base["total_bytes"] == (
+            base["per_cell_bytes"] + base["shared_bytes"]
+        )
+        # Per-trial success bits (the serve prefix loop) add exactly
+        # one bool per trial plus the 8-byte key rows.
+        serve = device_loop_carry_bytes(64, 512, per_trial_bits=True)
+        assert (
+            serve["total_bytes"] - base["total_bytes"] == 64 * 512 * (1 + 8)
+        )
+        # More cells scale the per-cell block and add the schedule logs.
+        multi = device_loop_carry_bytes(64, 512, n_cells=4)
+        assert multi["total_bytes"] > 4 * base["per_cell_bytes"]
