@@ -129,20 +129,31 @@ def _measure_device(
 
 
 def _mega_vs_fused(quick: bool) -> list[dict]:
-    """Round-8 launch-overhead decomposition: the same Monte-Carlo
-    batch timed under ``round_engine="pallas_mega"`` (one launch per
-    trial) and ``"pallas_fused"`` (one launch per round), same keys,
-    same trial count.  Because the two engines are bit-identical (the
-    megakernel equivalence tests), the wall-time gap divided by the
-    launch-count gap is a direct per-launch fixed-overhead estimate —
-    ``fixed_overhead_share`` is the fraction of the fused engine's
-    time that the in-kernel round loop eliminates.
+    """Round-8/round-11 launch-overhead decomposition: the same
+    Monte-Carlo batch timed under three engine variants, same keys,
+    same trial count, on the stabilizer sampler so the measured trial
+    includes step-1 resource generation:
+
+    - ``pallas_fused`` — one launch per round, host-side generation;
+    - ``pallas_mega`` (``mega_gen="host"``) — one launch per trial,
+      host-side generation (the round-8 comparison point);
+    - ``pallas_mega_gen`` (``mega_gen="gf2"``) — one launch per trial
+      INCLUDING generation (the round-11 in-VMEM GF(2) prologue).
+
+    Because all three are bit-identical (the megakernel equivalence
+    tests), the wall-time gap divided by the launch-count gap is a
+    direct per-launch fixed-overhead estimate; ``fixed_overhead_share``
+    is the round-8 share (host-gen mega vs fused) and
+    ``gen_inclusive_overhead_share`` is the round-11 headline — the
+    fraction of the fused engine's generation-inclusive trial time the
+    fully-fused launch eliminates.
 
     Config points: the headline shape (11p/L64), a launch-bound shape
-    (33p/L8: 11 rounds of tiny kernels — overhead-dominated), and the
-    north-star (33p/L64) gated to TPU (``QBA_BENCH_NS=1`` overrides)
-    because the megakernel honestly demotes there by VMEM estimate and
-    off-TPU both engines run minutes-slow in interpret mode.
+    (17p/L16: 5 rounds of tiny kernels — overhead-dominated), 33p/L8
+    (the row records the honest demotion: the per-round working set
+    alone crowds the mega budget), and the north-star (33p/L64) gated
+    to TPU (``QBA_BENCH_NS=1`` overrides) because off-TPU both engines
+    run minutes-slow in interpret mode.
 
     Standing caveat (docs/PERF.md): off-TPU these numbers come from the
     Pallas interpreter on CPU — valid for RELATIVE overhead share with
@@ -169,6 +180,11 @@ def _mega_vs_fused(quick: bool) -> list[dict]:
         )
     trials = 4 if quick else (64 if on_tpu else 16)
     reps = 2 if quick else 4
+    variants = (
+        ("pallas_fused", "pallas_fused", "host"),
+        ("pallas_mega", "pallas_mega", "host"),
+        ("pallas_mega_gen", "pallas_mega", "gf2"),
+    )
     rows = []
     for label, kw in points:
         row: dict = {"config": label, "trials": trials}
@@ -176,20 +192,26 @@ def _mega_vs_fused(quick: bool) -> list[dict]:
             from qba_tpu.benchmark import engine_description, kernel_plan
 
             per = {}
-            for eng in ("pallas_mega", "pallas_fused"):
-                cfg = QBAConfig(**kw, trials=trials, seed=0)
-                cfg = dataclasses.replace(cfg, round_engine=eng)
+            for name, eng, gen in variants:
+                cfg = QBAConfig(
+                    **kw, trials=trials, seed=0, qsim_path="stabilizer"
+                )
+                cfg = dataclasses.replace(
+                    cfg, round_engine=eng, mega_gen=gen
+                )
                 times, n_run = _measure_jax(cfg, reps=reps)
                 plan = kernel_plan(cfg)
-                per[eng] = {
+                per[name] = {
                     "median_seconds": round(statistics.median(times), 4),
                     "rep_seconds": [round(t, 4) for t in times],
                     "engine": engine_description(cfg),
                     "launches_per_trial": plan["launches_per_trial"],
+                    "mega_gen": plan["mega_gen"],
                 }
-                row[eng] = per[eng]
+                row[name] = per[name]
             t_m = per["pallas_mega"]["median_seconds"]
             t_f = per["pallas_fused"]["median_seconds"]
+            t_g = per["pallas_mega_gen"]["median_seconds"]
             l_m = per["pallas_mega"]["launches_per_trial"]
             l_f = per["pallas_fused"]["launches_per_trial"]
             if None not in (l_m, l_f) and l_f > l_m and t_f > 0:
@@ -199,9 +221,16 @@ def _mega_vs_fused(quick: bool) -> list[dict]:
                 row["fixed_overhead_share"] = round(
                     max(1.0 - t_m / t_f, 0.0), 4
                 )
+            if t_f > 0 and per["pallas_mega_gen"]["mega_gen"] == "gf2":
+                row["gen_inclusive_overhead_share"] = round(
+                    max(1.0 - t_g / t_f, 0.0), 4
+                )
             row["methodology"] = (
-                "cpu-fenced interpret-mode (relative share only)"
-                if not on_tpu else "tpu, fence-at-end"
+                "cpu-fenced interpret-mode, generation-inclusive "
+                "stabilizer trials (relative share only)"
+                if not on_tpu
+                else "tpu, fence-at-end, generation-inclusive "
+                "stabilizer trials"
             )
         except Exception as e:  # comparison must never sink the gate
             row["error"] = repr(e)[:300]
@@ -230,9 +259,10 @@ def _multichip(quick: bool) -> dict:
     trials = 8 if quick else 32
     reps = 2 if quick else 4
     code = f"""
-import json, statistics, time
+import dataclasses, json, statistics, time, warnings
 import jax
 from qba_tpu.config import QBAConfig
+from qba_tpu.analysis.launches import spmd_launches_per_trial
 from qba_tpu.analysis.memory import sharded_trial_ceiling
 from qba_tpu.benchmark import engine_description
 from qba_tpu.parallel import make_mesh, run_trials_spmd
@@ -241,22 +271,28 @@ from qba_tpu.backends.jax_backend import trial_keys
 cfg = QBAConfig(n_parties=17, size_l=16, n_dishonest=4,
                 trials={trials}, seed=0)
 ns = QBAConfig(33, 64, 10)
-rows = []
-for dp, tp in ((8, 1), (4, 2), (2, 4), (1, 8)):
-    mesh = make_mesh({{"dp": dp, "tp": tp}})
-    keys = trial_keys(cfg)
-    run_trials_spmd(cfg, mesh, keys)  # warm the jit cache
+on_tpu = jax.default_backend() == "tpu"
+
+def timed(run_cfg, mesh):
+    keys = trial_keys(run_cfg)
+    run_trials_spmd(run_cfg, mesh, keys)  # warm the jit cache
     times = []
     for _ in range({reps}):
         t0 = time.perf_counter()
-        res = run_trials_spmd(cfg, mesh, keys)
+        res = run_trials_spmd(run_cfg, mesh, keys)
         jax.block_until_ready(res.trials.success)
         times.append(time.perf_counter() - t0)
+    return times
+
+rows = []
+for dp, tp in ((8, 1), (4, 2), (2, 4), (1, 8)):
+    mesh = make_mesh({{"dp": dp, "tp": tp}})
+    times = timed(cfg, mesh)
     med = statistics.median(times)
     model = sharded_trial_ceiling(ns, dp=dp, tp=tp, comms="ring")
     model_ag = sharded_trial_ceiling(ns, dp=dp, tp=tp,
                                      comms="all_gather")
-    rows.append({{
+    row = {{
         "mesh": {{"dp": dp, "tp": tp}},
         "engine": engine_description(cfg, tp=tp) if tp > 1
                   else engine_description(cfg),
@@ -266,7 +302,30 @@ for dp, tp in ((8, 1), (4, 2), (2, 4), (1, 8)):
         "northstar_per_device_ceiling": model["per_device_trials"],
         "northstar_mesh_ceiling": model["mesh_trials"],
         "northstar_all_gather_per_device": model_ag["per_device_trials"],
-    }})
+    }}
+    if tp > 1:
+        # Round-11 row: the party-sharded megakernel (in-kernel ring,
+        # one launch per trial on TPU; off-TPU it times the fused
+        # transport twin — same pool movement, per-round launches).
+        mcfg = dataclasses.replace(cfg, round_engine="pallas_mega")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mtimes = timed(mcfg, mesh)
+            mdesc = engine_description(mcfg, tp=tp)
+        mmed = statistics.median(mtimes)
+        row["sharded_mega"] = {{
+            "engine": mdesc,
+            "rounds_per_sec": round(
+                cfg.trials * cfg.n_rounds / mmed, 2),
+            "rep_seconds": [round(t, 4) for t in mtimes],
+            "launches_per_trial": spmd_launches_per_trial(
+                cfg, "pallas_mega", "ring", 4, tpu=on_tpu),
+            "launches_per_trial_tpu_model": spmd_launches_per_trial(
+                cfg, "pallas_mega", "ring", 4, tpu=True),
+            "in_kernel_ring_hops_tpu_model":
+                4 * cfg.n_rounds * (tp - 1),
+        }}
+    rows.append(row)
 print(json.dumps(rows))
 """
     env = dict(os.environ)

@@ -18,10 +18,11 @@ chosen to cover the planner's phase space:
   range, so the one-hot structure proofs carry real weight;
 * ``f32-gdt``     — (11, 1000, 3): the reference paper's 11-party
   scale; size_l pushes the verdict kernel into its f32 gather dtype.
-* ``stabilizer``  — (11, 16, 3) on ``qsim_path="stabilizer"``: the
-  batched GF(2) resource path; its parity dots (``qba_tpu/gf2``) must
-  prove KI-3-clean with zero allowlist markers, and the packed-tableau
-  KI-2 entry fires.
+* ``stabilizer``  — (11, 16, 3) on ``qsim_path="stabilizer"`` with
+  ``mega_gen="gf2"``: the batched GF(2) resource path; its parity dots
+  (``qba_tpu/gf2``) must prove KI-3-clean with zero allowlist markers,
+  the packed-tableau KI-2 entry fires, and the gen-fused megakernel
+  audits (generation in-kernel, zero host scans) run on every lint.
 
 One aggregated :class:`~qba_tpu.analysis.findings.Report` comes back:
 empty findings means the tree upholds KI-1/KI-2/KI-3 by construction.
@@ -44,6 +45,7 @@ LINT_MATRIX = (
     ("f32-gdt", dict(n_parties=11, size_l=1000, n_dishonest=3)),
     ("stabilizer", dict(
         n_parties=11, size_l=16, n_dishonest=3, qsim_path="stabilizer",
+        mega_gen="gf2",
     )),
     # split traces the forge-P flag algebra + full-mask MXU identities
     # that every other strategy statically gates OUT of its jaxpr — the

@@ -537,6 +537,45 @@ def _audit_mega(cfg, report, stats) -> None:
             "1 pallas_call, 0 kernel-launching scans in the full trial "
             "jaxpr (no host carries exist to donate)"
         )
+    _audit_mega_gen(cfg, closed, report, stats)
+
+
+def _audit_mega_gen(cfg, closed, report, stats) -> None:
+    """Gen-fused extension of the KI-5 megakernel audit: when
+    ``mega_gen`` resolves ``"gf2"``, step-1 resource generation claims
+    to run in VMEM inside the same launch.  Prove it from the same
+    trial jaxpr the one-launch check used — the host generation path
+    evaluates its GF(2) measurement sweeps as ``lax.scan``s outside
+    any kernel, so the gen-fused trace must carry ZERO host-side
+    scans (the launch count alone stays 1 either way and cannot see
+    the leak)."""
+    from qba_tpu.analysis.launches import count_host_scans
+    from qba_tpu.ops.round_kernel_tiled import resolve_mega_gen
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gen = resolve_mega_gen(cfg)
+    if gen != "gf2":
+        return
+    host_scans = count_host_scans(closed.jaxpr)
+    stats["mega_gen_host_scans"] = host_scans
+    if host_scans:
+        report.findings.append(Finding(
+            ki="KI-5", check="mega-gen-in-kernel",
+            path="pallas_mega/run_trial",
+            message=(
+                f"mega_gen resolved 'gf2' but {host_scans} host-side "
+                "scan(s) remain in the trial jaxpr — the generation "
+                "sweep leaked back outside the one launch"
+            ),
+        ))
+    else:
+        report.notes.append(
+            "effects/pallas_mega: generation PROVEN in-kernel — "
+            "mega_gen='gf2', 0 host-side scans alongside the single "
+            "launch (host generation would carry its measurement "
+            "sweeps as scans)"
+        )
 
 
 # ---------------------------------------------------------------------------

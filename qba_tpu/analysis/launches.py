@@ -16,12 +16,21 @@ engine                    launches per trial
 ``pallas``                ``n_rounds`` (one monolithic call/round)
 ``pallas_tiled``          ``2 * n_rounds`` (verdict + rebuild)
 ``pallas_fused``          ``n_rounds`` (one fused call/round)
-``pallas_mega``           1 (decode + all rounds + decision reduce)
+``pallas_mega``           1 (decode + all rounds + decision reduce;
+                          with ``mega_gen="gf2"`` the count INCLUDES
+                          step-1 generation — the GF(2) measurement
+                          sweep runs in VMEM inside the same launch,
+                          proven by the zero-host-scan pin below)
 ========================  =======================================
 
 A drift in these counts is a perf regression the runtime would never
 surface (everything stays bit-identical), so the pin is a lint
 finding, tagged KI-5 with the donation/launch-discipline family.
+For gen-fused megakernel configs (``mega_gen`` resolving ``"gf2"``)
+the launch pin is paired with a host-scan pin: the traced trial must
+carry ZERO ``lax.scan``s outside kernel bodies — the host generation
+path's measurement sweeps are scans, so a nonzero count means step 1
+leaked back to the host even though launches still say 1.
 
 The party-sharded (tp) path has its own rows
 (:func:`check_spmd_launches`): per device-program the engine keeps its
@@ -41,6 +50,16 @@ tp comms                  extra launches / collectives per trial
 ``all_gather``            0 launches, 0 ``ppermute`` (one XLA
                           collective per leaf per round)
 ========================  =======================================
+
+``pallas_mega`` under tp is special-cased: on TPU the party-sharded
+megakernel moves the ring INSIDE the launch (one
+``make_async_remote_copy`` per pool leaf per hop, all inside the
+round ``fori_loop``), so its TPU row is ONE launch per trial with
+``leaves x n_rounds x (tp - 1)`` in-kernel remote-DMA hops and zero
+transport launches.  Off-TPU remote DMA does not exist, so the spmd
+path runs the ``pallas_fused`` transport twin; the twin's counted
+``ppermute`` schedule is what pins the in-kernel hop count (same
+leaves, same hop algebra).
 """
 
 from __future__ import annotations
@@ -95,6 +114,31 @@ def count_primitive(jaxpr, prim_names) -> int:
 def count_pallas_launches(jaxpr) -> int:
     """``pallas_call`` launches per evaluation of ``jaxpr``."""
     return count_primitive(jaxpr, ("pallas_call",))
+
+
+def count_host_scans(jaxpr) -> int:
+    """``lax.scan`` eqns OUTSIDE kernel bodies — the host-side loops.
+
+    Unlike :func:`count_primitive` this does NOT descend through a
+    ``pallas_call``: a scan inside a kernel (the megakernel's round
+    loop, the gen-fused measurement sweep) is exactly what the
+    in-kernel contract wants, while a scan outside one is host work.
+    Counts eqns, not trips — the pin is existence, not cost."""
+    from qba_tpu.analysis.effects import _as_jaxprs
+
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            continue
+        if eqn.primitive.name == "scan":
+            total += 1
+        total += sum(
+            count_host_scans(s)
+            for p in eqn.params.values()
+            for s in _as_jaxprs(p)
+        )
+    return total
 
 
 def _trace_trial(cfg: QBAConfig, engine: str | None):
@@ -173,14 +217,53 @@ def check_launches(cfg: QBAConfig, engines) -> Report:
                 f"launches/{engine}: {count} launch(es) per trial "
                 "(= model)"
             )
+        if engine == "pallas_mega":
+            _pin_mega_gen_in_kernel(cfg, closed, report)
     report.stats["launch_engines_checked"] = checked
     return report
 
 
+def _pin_mega_gen_in_kernel(cfg: QBAConfig, closed, report: Report) -> None:
+    """For a gen-fused megakernel config, prove generation moved
+    in-kernel: the traced trial must carry ZERO host-side scans.  The
+    host generation path evaluates the GF(2) measurement sweeps as
+    ``lax.scan``s outside any kernel, so a nonzero count here means
+    step 1 leaked back to the host while the launch count still reads
+    1 (the launch pin alone cannot see that regression)."""
+    from qba_tpu.ops.round_kernel_tiled import resolve_mega_gen
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        gen = resolve_mega_gen(cfg)
+    if gen != "gf2":
+        return
+    host_scans = count_host_scans(closed.jaxpr)
+    if host_scans:
+        report.findings.append(Finding(
+            ki="KI-5", check="mega-gen-in-kernel",
+            path="pallas_mega/run_trial",
+            message=(
+                f"mega_gen resolved 'gf2' but the trial jaxpr carries "
+                f"{host_scans} host-side scan(s) — step-1 generation "
+                "(the GF(2) measurement sweep) leaked back outside the "
+                "kernel launch"
+            ),
+        ))
+    else:
+        report.notes.append(
+            "launches/pallas_mega: generation in-kernel PROVEN — "
+            "mega_gen='gf2' and 0 host-side scans in the full trial "
+            "jaxpr (the host path's measurement sweeps would be scans)"
+        )
+    report.stats["mega_gen_host_scans"] = host_scans
+
+
 #: Engines whose party-sharded variants get launch rows.  xla pins the
-#: pure-collective path; pallas_fused pins the spmd hot path (mega has
-#: no sharded variant — spmd demotes it to fused, so fused IS its row).
-SPMD_CHECK_ENGINES = ("xla", "pallas_fused")
+#: pure-collective path; pallas_fused pins the per-round spmd path;
+#: pallas_mega pins the party-sharded megakernel (on TPU the ring runs
+#: IN-kernel; off-TPU its trace is the fused transport twin, whose
+#: ppermute schedule pins the in-kernel hop count).
+SPMD_CHECK_ENGINES = ("xla", "pallas_fused", "pallas_mega")
 
 
 def spmd_launches_per_trial(
@@ -190,17 +273,27 @@ def spmd_launches_per_trial(
     pool_leaves: int = 0,
     tpu: bool = False,
 ) -> int:
-    """The closed launch model for the party-sharded path: the
-    engine's single-device launches per trial (``pallas_mega`` demotes
-    to ``pallas_fused`` under the tp mesh) plus, on TPU under
-    ``comms="ring"``, one remote-DMA kernel launch per gathered pool
-    leaf per round.  Off-TPU the ring is ``ppermute`` hops and
-    ``all_gather`` is one XLA collective per leaf per round — neither
-    adds a ``pallas_call``.  ``pool_leaves`` comes from the counted
-    hop schedule (:func:`check_spmd_launches` derives it as
-    ``ppermute_hops / (n_rounds * (tp - 1))``)."""
-    resolved = "pallas_fused" if engine == "pallas_mega" else engine
-    base = LAUNCH_MODEL[resolved](cfg)
+    """The closed launch model for the party-sharded path.
+
+    ``pallas_mega`` on TPU is ONE launch per trial regardless of
+    comms: the neighbor ring runs inside the kernel's round loop as
+    ``pool_leaves x n_rounds x (tp - 1)`` remote-DMA hops, which are
+    DMAs within the launch, not launches.  Off-TPU remote DMA does
+    not exist, so the spmd path runs the ``pallas_fused`` transport
+    twin and this model returns the twin's counts.
+
+    Every other engine keeps its single-device launches per trial
+    plus, on TPU under ``comms="ring"``, one remote-DMA kernel launch
+    per gathered pool leaf per round.  Off-TPU the ring is
+    ``ppermute`` hops and ``all_gather`` is one XLA collective per
+    leaf per round — neither adds a ``pallas_call``.  ``pool_leaves``
+    comes from the counted hop schedule (:func:`check_spmd_launches`
+    derives it as ``ppermute_hops / (n_rounds * (tp - 1))``)."""
+    if engine == "pallas_mega":
+        if tpu:
+            return LAUNCH_MODEL["pallas_mega"](cfg)
+        engine = "pallas_fused"  # off-TPU transport twin
+    base = LAUNCH_MODEL[engine](cfg)
     if comms == "ring" and tpu:
         return base + pool_leaves * cfg.n_rounds
     return base
@@ -280,7 +373,13 @@ def check_spmd_launches(cfg: QBAConfig, engines, tp: int = 2) -> Report:
             )
             continue
         checked += 1
-        base = LAUNCH_MODEL[engine](cfg)
+        # Off-TPU the sharded megakernel runs its fused transport
+        # twin (remote DMA exists only on hardware), so its traced
+        # counts are the twin's; the hop pin below still closes the
+        # in-kernel model because both move the same pool leaves on
+        # the same schedule.
+        twin = "pallas_fused" if engine == "pallas_mega" else engine
+        base = LAUNCH_MODEL[twin](cfg)
         for comms, (pallas, _) in counts.items():
             if pallas != base:
                 report.findings.append(Finding(
@@ -291,6 +390,11 @@ def check_spmd_launches(cfg: QBAConfig, engines, tp: int = 2) -> Report:
                         f"off-TPU, the engine's model says {base} — "
                         "the comms path must add zero launches off-TPU "
                         "(remote DMA exists only on hardware)"
+                        + (
+                            "; pallas_mega traces its pallas_fused "
+                            "transport twin here" if twin != engine
+                            else ""
+                        )
                     ),
                 ))
         hops = cfg.n_rounds * (tp - 1)
@@ -322,11 +426,23 @@ def check_spmd_launches(cfg: QBAConfig, engines, tp: int = 2) -> Report:
             tpu_model = spmd_launches_per_trial(
                 cfg, engine, "ring", leaves, tpu=True
             )
-            report.notes.append(
-                f"spmd-launches[tp={tp}]/{engine}: {base} launch(es) + "
-                f"{ring_hops} ppermute hops/trial (= {leaves} pool "
-                f"leaves x {cfg.n_rounds} rounds x {tp - 1} hops); "
-                f"TPU ring model closes at {tpu_model} launch(es)/trial"
-            )
+            if engine == "pallas_mega":
+                report.notes.append(
+                    f"spmd-launches[tp={tp}]/pallas_mega: twin counts "
+                    f"{base} launch(es) + {ring_hops} ppermute "
+                    f"hops/trial (= {leaves} pool leaves x "
+                    f"{cfg.n_rounds} rounds x {tp - 1} hops); on TPU "
+                    f"the sharded megakernel closes at {tpu_model} "
+                    f"launch/trial with the same {ring_hops} hops as "
+                    "IN-KERNEL remote DMAs"
+                )
+            else:
+                report.notes.append(
+                    f"spmd-launches[tp={tp}]/{engine}: {base} "
+                    f"launch(es) + {ring_hops} ppermute hops/trial "
+                    f"(= {leaves} pool leaves x {cfg.n_rounds} rounds "
+                    f"x {tp - 1} hops); TPU ring model closes at "
+                    f"{tpu_model} launch(es)/trial"
+                )
     report.stats["spmd_launch_engines_checked"] = checked
     return report

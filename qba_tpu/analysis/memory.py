@@ -261,25 +261,65 @@ def _audit_plans(cfg: QBAConfig, n_recv: int | None, report: Report,
         "demotes to the two-kernel tiled path on TPU",
     )
     if n_recv is None:
-        # The trial megakernel is global-only (no party-sharded
-        # variant; spmd demotes it to fused) — its whole-launch VMEM
-        # scratch budget is the KI-2 entry that decides whether one
-        # trial's decode + all rounds + reduce fit residency at once.
+        # The trial megakernel's whole-launch VMEM scratch budget is
+        # the KI-2 entry that decides whether one trial's decode + all
+        # rounds + reduce fit residency at once.  The gen-fused launch
+        # additionally prices the in-VMEM GF(2) tableau working set
+        # and gives up _MEGA_RESERVE for the prologue's unpriced
+        # transients — audited against the reserved budget exactly as
+        # the planner screens it.
         from qba_tpu.ops.round_kernel_tiled import (
-            _MEGA_BUDGET,
+            _mega_budget,
             _mega_estimate,
+            _mega_gen_bytes,
             mega_candidates,
             resolve_mega_block,
+            resolve_mega_gen,
         )
 
+        gen = resolve_mega_gen(cfg, pack) == "gf2"
         mega_plan = resolve_mega_block(cfg, trial_pack=pack)
         check(
-            "pallas_mega/trial",
-            mega_candidates(cfg, blk_v, pack), n_pool,
-            lambda b: _mega_estimate(cfg, b, blk_v, pack),
-            _MEGA_BUDGET, "_MEGA_BUDGET",
+            "pallas_mega/trial" + ("+gen" if gen else ""),
+            mega_candidates(cfg, blk_v, pack, gen=gen), n_pool,
+            lambda b: _mega_estimate(cfg, b, blk_v, pack, gen=gen),
+            _mega_budget(gen),
+            "_mega_budget(gen=True)" if gen else "_MEGA_BUDGET",
             mega_plan[0] if mega_plan is not None else None,
-            "demotes to the fused per-round engine on TPU",
+            "demotes to the fused per-round engine on TPU"
+            if not gen else "demotes to host-side generation on TPU",
+        )
+        if gen:
+            report.notes.append(
+                f"pallas_mega/trial+gen: in-VMEM generation prices "
+                f"{_mega_gen_bytes(cfg, pack) / 2**20:.1f} MiB of "
+                f"tableau working set at {shape}; the launch budget "
+                "holds back the _MEGA_RESERVE guard for sweep "
+                "transients"
+            )
+    else:
+        # The party-sharded megakernel: per-shard launch residency
+        # (one assembled global pool half + local halves + the
+        # double-buffered in-kernel ring slots) against the RESERVED
+        # budget — the in-flight remote-DMA transients draw on the
+        # same guard the gen prologue does.
+        from qba_tpu.ops.round_kernel_tiled import (
+            _mega_budget,
+            _sharded_mega_estimate,
+            sharded_mega_candidates,
+            sharded_mega_plan,
+        )
+
+        n_tp = cfg.n_lieutenants // n_recv
+        loc_rows = n_recv * cfg.slots
+        sh_plan = sharded_mega_plan(cfg, n_tp)
+        check(
+            f"{prefix}pallas_mega/trial",
+            sharded_mega_candidates(cfg, n_tp, blk_v), loc_rows,
+            lambda b: _sharded_mega_estimate(cfg, b, blk_v, n_tp),
+            _mega_budget(gen=True), "_mega_budget(gen=True)",
+            sh_plan[0] if sh_plan is not None else None,
+            "demotes to the fused per-round engine under the tp mesh",
         )
 
 

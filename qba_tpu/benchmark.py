@@ -48,6 +48,9 @@ def kernel_plan(cfg: QBAConfig, tp: int | None = None) -> dict:
     - ``mega_block``: the trial megakernel's ``(decode, verdict)``
       block plan (None off the ``pallas_mega`` path or when it demotes
       on VMEM budget).
+    - ``mega_gen``: where step-1 generation runs on the megakernel
+      path — ``"gf2"`` when the in-VMEM GF(2) sweep is fused into the
+      launch, ``"host"`` otherwise; None off the ``pallas_mega`` path.
     - ``launches_per_trial``: total pallas_call launches one trial
       costs under the resolved engine — the round-8 fixed-overhead
       attribution unit (1 on ``pallas_mega``, ``n_rounds`` fused,
@@ -60,9 +63,12 @@ def kernel_plan(cfg: QBAConfig, tp: int | None = None) -> dict:
     used to live only in recorded warnings into the artifact:
 
     - ``tp``: the tp mesh width the row ran at.
-    - ``tp_engine``: the engine the party-sharded dispatch resolves
-      (``pallas_mega`` has no sharded variant — it demotes to
-      ``pallas_fused``, and ``tp_demoted_from`` records the original).
+    - ``tp_engine``: the engine the party-sharded dispatch resolves —
+      including ``pallas_mega``, whose sharded variant runs the
+      neighbor ring inside the one launch (it still demotes to
+      ``pallas_fused`` when counters are requested or no sharded plan
+      fits the reserved VMEM budget, and ``tp_demoted_from`` records
+      the original).
     - ``tp_comms``: the resolved comms transport (``ring`` /
       ``all_gather``, :func:`qba_tpu.parallel.ring.resolve_tp_comms`).
     - ``tp_demoted_from``: the forced engine the sharded path demoted
@@ -82,6 +88,7 @@ def kernel_plan(cfg: QBAConfig, tp: int | None = None) -> dict:
         "rebuild_block": None,
         "fused_block": None,
         "mega_block": None,
+        "mega_gen": None,
         "trial_pack": 1,
         "launches_per_round": {"xla": 0, "pallas": 1}.get(engine, 2),
         "launches_per_trial": LAUNCH_MODEL.get(
@@ -91,6 +98,7 @@ def kernel_plan(cfg: QBAConfig, tp: int | None = None) -> dict:
     if engine == "pallas_mega":
         from qba_tpu.ops.round_kernel_tiled import (
             resolve_mega_block,
+            resolve_mega_gen,
             resolve_trial_pack,
             resolve_verdict_variant,
         )
@@ -99,6 +107,7 @@ def kernel_plan(cfg: QBAConfig, tp: int | None = None) -> dict:
         plan["launches_per_round"] = None
         mega = resolve_mega_block(cfg)
         plan["mega_block"] = mega
+        plan["mega_gen"] = resolve_mega_gen(cfg)
         if mega is None or cfg.collect_counters:
             # run_trial demotes (VMEM budget / counters need the host
             # scan); attribute the fused path that actually runs.
@@ -187,6 +196,8 @@ def engine_description(cfg: QBAConfig, tp: int | None = None) -> str:
             return desc + "/demoted-to-fused"
         if cfg.collect_counters:
             return desc + "/demoted-to-fused(counters)"
+        if plan["mega_gen"] == "gf2":
+            desc += "/gen-gf2"
         return desc + f"/pack{plan['trial_pack']}"
     if engine == "pallas_fused":
         desc = f"{engine}/{plan['variant']}"

@@ -146,6 +146,19 @@ class QBAConfig:
         shows the mechanism.  ``tests/test_racy.py`` pins the
         cross-mode and cross-backend decision match.  See
         docs/DIVERGENCES.md D1.
+      mega_gen: where the trial megakernel generates the step-1
+        particle pool: "auto" (default — fuse the PR 7 bit-packed
+        GF(2) stabilizer sampler into the megakernel's entry whenever
+        ``qsim_path="stabilizer"`` and the tableau fits the megakernel
+        VMEM budget, otherwise generate on the host exactly as every
+        other engine does), "gf2" (force the in-VMEM generation —
+        requires ``qsim_path="stabilizer"``; demotes to the host path
+        with a recorded warning when the tableau busts the VMEM budget
+        or the gen-fused plan refuses to compile), or "host" (force
+        host-side generation).  Bit-identical either way by
+        construction: both paths share the GF(2) measurement-sweep
+        algebra (:func:`qba_tpu.gf2.symplectic.gf2_measure_sweep`)
+        under the same key tree.  Ignored by every non-mega engine.
       collect_counters: emit on-device protocol counters
         (:class:`qba_tpu.rounds.engine.ProtocolCounters`) as an
         auxiliary per-trial output of the round engines:
@@ -180,6 +193,7 @@ class QBAConfig:
     trial_pack: int | None = None
     max_evidence_rows: int | None = None
     collect_counters: bool = False
+    mega_gen: str = "auto"
 
     def __post_init__(self) -> None:
         if self.n_parties < 2:
@@ -267,6 +281,17 @@ class QBAConfig:
         if not 0.0 <= self.p_measure_flip <= 1.0:
             raise ValueError(
                 f"p_measure_flip must be in [0, 1]; got {self.p_measure_flip}"
+            )
+        if self.mega_gen not in ("auto", "gf2", "host"):
+            raise ValueError(
+                f"unknown mega_gen {self.mega_gen!r}; expected 'auto', "
+                "'gf2', or 'host'"
+            )
+        if self.mega_gen == "gf2" and self.qsim_path != "stabilizer":
+            raise ValueError(
+                "mega_gen='gf2' fuses the GF(2) stabilizer sampler into "
+                "the trial megakernel and is only defined for "
+                f"qsim_path='stabilizer'; got qsim_path={self.qsim_path!r}"
             )
         if self.racy_mode not in ("loss", "defer"):
             raise ValueError(f"unknown racy_mode {self.racy_mode!r}")

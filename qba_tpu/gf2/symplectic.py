@@ -118,6 +118,101 @@ def compile_symplectic(n: int, ops, n_params: int) -> SymplecticProgram:
     return SymplecticProgram(n=n, x=x, z=z, r=r, l=l)
 
 
+def gf2_measure_sweep(
+    n: int,
+    xw: jnp.ndarray,
+    zw: jnp.ndarray,
+    r: jnp.ndarray,
+    rnds: jnp.ndarray,
+) -> jnp.ndarray:
+    """The batched measurement sweep on an evolved packed tableau:
+    ``(xw[B, 2n, W], zw[B, 2n, W], r[B, 2n], rnds[B, n]) -> bits[B, n]``.
+
+    ``rnds`` are pre-drawn int32 {0, 1} coins (consumed only where the
+    outcome is random); ``r`` carries the per-shot phases with any
+    param/noise contribution already folded in.  This is THE sweep —
+    shared verbatim by the host sampler core
+    (:func:`build_gf2_sample_core`) and the trial megakernel's in-VMEM
+    generation prologue (:mod:`qba_tpu.ops.trial_megakernel`), so the
+    two generation paths are bit-identical *by construction*, not by
+    test luck.
+
+    Every step is written in the Pallas-safe subset — 2-D
+    ``broadcasted_iota``, one-hot ``where``-selects instead of
+    ``take``/``take_along_axis``/``argmax``, masked writes instead of
+    ``.at[].set`` — in formulations value-identical to the gather
+    originals:
+
+    * pivot: ``min(where(stab_xa == 1, col, n))`` equals
+      ``argmax(stab_xa)`` whenever a stabilizer anticommutes; rows
+      without one get the out-of-range pivot ``2n``, whose every
+      dependent value is discarded by the ``has_stab`` merge selects;
+    * row gathers (``xp``/``zp``/``rp``, the coin, the measured-bit
+      write): one-hot row masks summed/selected along the row axis —
+      exact, since exactly one (or zero, discarded) row is selected.
+    """
+    b = rnds.shape[0]
+    nw = xw.shape[-1]
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (b, n), 1)
+    rows2n = jax.lax.broadcasted_iota(jnp.int32, (b, 2 * n), 1)
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (1, nw), 1)
+    u0 = jnp.asarray(0, jnp.uint32)
+
+    def measure_one(a, carry):
+        xw, zw, r, out = carry
+        # get_bit without the word gather: one-hot word select.
+        wsel = iota_w == (a >> 5)                     # [1, W]
+        shift = (a & 31).astype(jnp.uint32)
+        word = jnp.sum(jnp.where(wsel[None], xw, u0), axis=-1)
+        xa = ((word >> shift) & 1).astype(jnp.int32)  # [B, 2n]
+        stab_xa = xa[:, n:]
+        has_stab = jnp.any(stab_xa == 1, axis=1)      # [B]
+        # -- random branch (masked; discarded where deterministic) --
+        first = jnp.min(jnp.where(stab_xa == 1, iota_n, n), axis=1)
+        p = n + first                                 # first pivot [B]
+        sel = rows2n == p[:, None]                    # [B, 2n] one-hot
+        xp = jnp.sum(jnp.where(sel[..., None], xw, u0), axis=1)
+        zp = jnp.sum(jnp.where(sel[..., None], zw, u0), axis=1)
+        rp = jnp.sum(jnp.where(sel, r, 0), axis=1)    # [B]
+        # Cross parity z_h . x_p per row — packed popcount, no dot.
+        cross = parity_words(zw & xp[:, None, :], axis=-1)  # [B, 2n]
+        mask_o = xa * (1 - sel.astype(jnp.int32))     # [B, 2n]
+        r_rand = r ^ (mask_o & (rp[:, None] ^ cross))
+        x_rand = rank1_update_packed(xw, mask_o, xp)
+        z_rand = rank1_update_packed(zw, mask_o, zp)
+        # Row surgery: pivot retires to the destabilizer bank; the
+        # new stabilizer is (+/-) Z_a signed by the coin.
+        rnd = jnp.sum(jnp.where(iota_n == a, rnds, 0), axis=1)  # [B]
+        e_a = jnp.where(wsel, jnp.asarray(1, jnp.uint32) << shift, u0)
+        is_dst = rows2n == (p - n)[:, None]           # [B, 2n]
+        is_p = sel
+        x_rand = jnp.where(is_dst[..., None], xp[:, None, :], x_rand)
+        x_rand = jnp.where(is_p[..., None], u0, x_rand)
+        z_rand = jnp.where(is_dst[..., None], zp[:, None, :], z_rand)
+        z_rand = jnp.where(is_p[..., None], e_a[None], z_rand)
+        r_rand = jnp.where(is_dst, rp[:, None], r_rand)
+        r_rand = jnp.where(is_p, rnd[:, None], r_rand)
+        # -- deterministic branch (reads state, never writes) --
+        s = xa[:, :n]                                 # [B, n]
+        phase_par = jnp.sum(s * r[:, n:], axis=1) & 1
+        sm = mask_words(s)[..., None]                 # [B, n, 1]
+        tri = triangular_parity(sm & zw[:, n:, :], sm & xw[:, n:, :])
+        det_out = phase_par ^ tri
+        # -- merge: one select per step replaces per-shot cond --
+        xw = jnp.where(has_stab[:, None, None], x_rand, xw)
+        zw = jnp.where(has_stab[:, None, None], z_rand, zw)
+        r = jnp.where(has_stab[:, None], r_rand, r)
+        bit = jnp.where(has_stab, rnd, det_out)
+        out = jnp.where(iota_n == a, bit[:, None], out)
+        return xw, zw, r, out
+
+    out0 = jnp.zeros((b, n), dtype=jnp.int32)
+    _, _, _, out = jax.lax.fori_loop(
+        0, n, measure_one, (xw, zw, r, out0)
+    )
+    return out
+
+
 def build_gf2_sample_core(n: int, ops, n_params: int):
     """Build the pure batched sampler core:
     ``sample(rnds[B, n], params[B, P] | None) -> int32 bits[B, n]``.
@@ -133,7 +228,6 @@ def build_gf2_sample_core(n: int, ops, n_params: int):
     z0w = jnp.asarray(pack_bits(jnp.asarray(prog.z)))
     r0 = jnp.asarray(prog.r, jnp.int32)                 # [2n]
     lt = jnp.asarray(prog.l.T, jnp.int32)               # [P, 2n]
-    rows2n = jnp.arange(2 * n, dtype=jnp.int32)
 
     def sample(
         rnds: jnp.ndarray,
@@ -157,56 +251,9 @@ def build_gf2_sample_core(n: int, ops, n_params: int):
             r = r ^ phase_noise
         xw = jnp.broadcast_to(x0w[None], (b, 2 * n, x0w.shape[-1]))
         zw = jnp.broadcast_to(z0w[None], (b, 2 * n, z0w.shape[-1]))
-
-        def measure_one(a, carry):
-            xw, zw, r, out = carry
-            xa = get_bit(xw, a)                      # [B, 2n]
-            stab_xa = xa[:, n:]
-            has_stab = jnp.any(stab_xa == 1, axis=1)  # [B]
-            # -- random branch (masked; discarded where deterministic) --
-            p = n + jnp.argmax(stab_xa, axis=1)       # first pivot [B]
-            xp = jnp.take_along_axis(xw, p[:, None, None], axis=1)[:, 0]
-            zp = jnp.take_along_axis(zw, p[:, None, None], axis=1)[:, 0]
-            rp = jnp.take_along_axis(r, p[:, None], axis=1)[:, 0]
-            # Cross parity z_h . x_p per row — packed popcount, no dot.
-            cross = parity_words(zw & xp[:, None, :], axis=-1)  # [B, 2n]
-            mask_o = xa * (rows2n[None, :] != p[:, None])       # [B, 2n]
-            r_rand = r ^ (mask_o & (rp[:, None] ^ cross))
-            x_rand = rank1_update_packed(xw, mask_o, xp)
-            z_rand = rank1_update_packed(zw, mask_o, zp)
-            # Row surgery: pivot retires to the destabilizer bank; the
-            # new stabilizer is (+/-) Z_a signed by the coin.
-            rnd = jnp.take(rnds, a, axis=1)                     # [B]
-            e_a = unit_words(n, a)                              # [W]
-            is_dst = rows2n[None, :] == (p - n)[:, None]        # [B, 2n]
-            is_p = rows2n[None, :] == p[:, None]
-            x_rand = jnp.where(is_dst[..., None], xp[:, None, :], x_rand)
-            x_rand = jnp.where(
-                is_p[..., None], jnp.asarray(0, jnp.uint32), x_rand
-            )
-            z_rand = jnp.where(is_dst[..., None], zp[:, None, :], z_rand)
-            z_rand = jnp.where(is_p[..., None], e_a[None, None, :], z_rand)
-            r_rand = jnp.where(is_dst, rp[:, None], r_rand)
-            r_rand = jnp.where(is_p, rnd[:, None], r_rand)
-            # -- deterministic branch (reads state, never writes) --
-            s = xa[:, :n]                                       # [B, n]
-            phase_par = jnp.sum(s * r[:, n:], axis=1) & 1
-            sm = mask_words(s)[..., None]                       # [B, n, 1]
-            tri = triangular_parity(sm & zw[:, n:, :], sm & xw[:, n:, :])
-            det_out = phase_par ^ tri
-            # -- merge: one select per step replaces per-shot cond --
-            xw = jnp.where(has_stab[:, None, None], x_rand, xw)
-            zw = jnp.where(has_stab[:, None, None], z_rand, zw)
-            r = jnp.where(has_stab[:, None], r_rand, r)
-            bit = jnp.where(has_stab, rnd, det_out)
-            out = out.at[:, a].set(bit)
-            return xw, zw, r, out
-
-        out0 = jnp.zeros((b, n), dtype=jnp.int32)
-        _, _, _, out = jax.lax.fori_loop(
-            0, n, measure_one, (xw, zw, r, out0)
-        )
-        return out
+        # One shared sweep (also the megakernel's in-VMEM prologue —
+        # gen-fused bit-identity is by construction, not by test).
+        return gf2_measure_sweep(n, xw, zw, r, rnds)
 
     return sample
 

@@ -2950,14 +2950,46 @@ def resolve_trial_pack(cfg: QBAConfig) -> int:
 
 _MEGA_BUDGET = 64 * 2**20
 
+# Reserve held back from the megakernel budget when the launch also
+# carries the in-VMEM GF(2) generation prologue or the in-kernel ring
+# exchange: both phases materialize transients the loose estimates
+# below do not itemize (the sweep's per-step one-hot selects, the
+# in-flight DMA slot plus the deposit window), so a plan that fits
+# only by consuming the last budget bytes demotes instead (KI-2).
+_MEGA_RESERVE = 8 * 2**20
+
+
+def _mega_gen_bytes(cfg: QBAConfig, trial_pack: int = 1) -> int:
+    """VMEM the gen-fused prologue adds to the megakernel launch: the
+    static packed tableaux of both circuit families, the per-shot
+    broadcast tableau planes the measurement sweep carries (the
+    dominant term — 2 planes x B shots x 2T rows x W words), the
+    per-shot phase/coin/flip operands, and the decoded-operand
+    scratch."""
+    from qba_tpu.gf2.bitops import n_words
+
+    t2 = 2 * cfg.total_qubits
+    wds = n_words(cfg.total_qubits)
+    b = trial_pack * cfg.size_l
+    tables = 4 * t2 * wds * 4
+    planes = 2 * b * t2 * wds * 4
+    vectors = b * (3 * t2 + 2 * cfg.total_qubits + 1) * 4
+    decoded = 4 * trial_pack * (
+        4 * cfg.n_lieutenants * cfg.size_l + cfg.size_l * (
+            cfg.n_parties + 1
+        )
+    )
+    return tables + planes + vectors + decoded
+
 
 def _mega_estimate(cfg: QBAConfig, blk_d: int, blk_v: int,
-                   trial_pack: int = 1) -> int:
+                   trial_pack: int = 1, gen: bool = False) -> int:
     """Loose VMEM estimate for the one-launch trial kernel: the fused
     round kernel's per-step terms plus what the in-kernel loop keeps
     resident for the whole launch — BOTH pool halves (ping-pong A/B
     scratch), the round-stacked draw slabs, and the entry-decode
-    one-hot intermediates."""
+    one-hot intermediates.  ``gen=True`` adds the in-VMEM generation
+    terms (:func:`_mega_gen_bytes`)."""
     n_rv = cfg.n_lieutenants
     n_pool = n_rv * cfg.slots
     s, max_l = cfg.size_l, cfg.max_l
@@ -2971,11 +3003,18 @@ def _mega_estimate(cfg: QBAConfig, blk_d: int, blk_v: int,
     return (
         _fused_estimate(cfg, blk_d, blk_v, None, trial_pack)
         + trial_pack * (2 * pool + draws + decode)
+        + (_mega_gen_bytes(cfg, trial_pack) if gen else 0)
     )
 
 
+def _mega_budget(gen: bool = False) -> int:
+    """Effective megakernel budget — the gen-fused launch gives up
+    :data:`_MEGA_RESERVE` for the prologue's unpriced transients."""
+    return _MEGA_BUDGET - (_MEGA_RESERVE if gen else 0)
+
+
 def mega_candidates(cfg: QBAConfig, blk_v: int | None = None,
-                    trial_pack: int = 1) -> list[int]:
+                    trial_pack: int = 1, gen: bool = False) -> list[int]:
     """Candidate destination block sizes for the trial megakernel —
     the fused kernel's candidate rule under the megakernel estimate."""
     if blk_v is None:
@@ -2985,7 +3024,8 @@ def mega_candidates(cfg: QBAConfig, blk_v: int | None = None,
     cands = [d for d in divs if d % 8 == 0] or divs
     ok = [
         b for b in cands
-        if _mega_estimate(cfg, b, blk_v, trial_pack) <= _MEGA_BUDGET
+        if _mega_estimate(cfg, b, blk_v, trial_pack, gen)
+        <= _mega_budget(gen)
     ]
     return _order_candidates(ok, _preferred_block(cfg))[
         :_MAX_PROBE_CANDIDATES
@@ -2993,7 +3033,8 @@ def mega_candidates(cfg: QBAConfig, blk_v: int | None = None,
 
 
 def _probe_mega_compile(cfg: QBAConfig, blk_d: int, blk_v: int,
-                        variant: str, trial_pack: int = 1) -> None:
+                        variant: str, trial_pack: int = 1,
+                        gen: bool = False) -> None:
     """Data-free compile probe of one trial-megakernel build (raises on
     failure, never executes)."""
     # Deferred import: the megakernel module imports this module's
@@ -3020,42 +3061,59 @@ def _probe_mega_compile(cfg: QBAConfig, blk_d: int, blk_v: int,
         li_arg = kshp(n_rv, s)
     mega = build_trial_megakernel(
         cfg, blk_d, blk_v, variant=variant, trial_pack=trial_pack,
+        gen=gen,
     )
-    jax.jit(jax.vmap(mega)).lower(
-        kshp(n_rv, s), kshp(n_rv, s), li_arg, kshp(n_rv),
-        kshp(n_pool, 1),
+    draws = (
         shp(*((cfg.n_rounds,) + kd + (n_pool, n_rv))),
         shp(*((cfg.n_rounds,) + kd + (n_pool, n_rv))),
         shp(*((cfg.n_rounds,) + kd + (n_pool, n_rv))),
-    ).compile()
+    )
+    if gen:
+        t = cfg.total_qubits
+        gen_ops = (
+            kshp(s), kshp(s, t), kshp(s, 2 * t), kshp(s, 2 * t),
+            kshp(s, t),
+        )
+        jax.jit(jax.vmap(mega)).lower(
+            gen_ops, kshp(n_rv), kshp(n_pool, 1), *draws,
+        ).compile()
+    else:
+        jax.jit(jax.vmap(mega)).lower(
+            kshp(n_rv, s), kshp(n_rv, s), li_arg, kshp(n_rv),
+            kshp(n_pool, 1), *draws,
+        ).compile()
 
 
 def mega_kernel_plan(cfg: QBAConfig, variant: str | None = None,
-                     trial_pack: int = 1) -> int | None:
+                     trial_pack: int = 1, gen: bool = False) -> int | None:
     """Destination block size for the trial megakernel, or None if no
     candidate compiles (the fused per-round engine then takes over —
-    the megakernel's demotion target)."""
+    the megakernel's demotion target; a gen-fused plan instead demotes
+    to host-side generation, keeping the megakernel)."""
     if variant is None:
         variant = resolve_verdict_variant(cfg)
     blk_v = resolve_tiled_block(cfg)
 
     def compile_one(blk_d):
-        _probe_mega_compile(cfg, blk_d, blk_v, variant, trial_pack)
+        _probe_mega_compile(cfg, blk_d, blk_v, variant, trial_pack, gen)
 
     return _probe_plan(
         "trial-mega", cfg,
-        mega_candidates(cfg, blk_v, trial_pack), compile_one,
-        _MEGA_PROBE_CACHE, "falling back to the fused per-round engine",
+        mega_candidates(cfg, blk_v, trial_pack, gen), compile_one,
+        _MEGA_PROBE_CACHE,
+        "falling back to host-side list generation" if gen
+        else "falling back to the fused per-round engine",
         extra={"allrecv": "+allrecv", "group-serial": "+accser"}.get(
             variant, ""
         )
         + (f"+pack{trial_pack}" if trial_pack > 1 else "")
+        + ("+gen" if gen else "")
         + f"+v{blk_v}",
     )
 
 
 def _resolve_mega_block_impl(
-    cfg: QBAConfig, trial_pack: int = 1
+    cfg: QBAConfig, trial_pack: int = 1, gen: bool = False
 ) -> tuple[int, int] | None:
     """``(blk_d, blk_v)`` the megakernel engine runs with, or None to
     demote to the fused per-round engine.  An explicit ``tiled_block``
@@ -3069,23 +3127,144 @@ def _resolve_mega_block_impl(
     if cfg.tiled_block is not None and n_pool % cfg.tiled_block == 0:
         if (
             jax.default_backend() != "tpu"
-            or _mega_estimate(cfg, cfg.tiled_block, blk_v, trial_pack)
-            <= _MEGA_BUDGET
+            or _mega_estimate(cfg, cfg.tiled_block, blk_v, trial_pack, gen)
+            <= _mega_budget(gen)
         ):
             return (cfg.tiled_block, blk_v)
     if jax.default_backend() == "tpu":
-        blk_d = mega_kernel_plan(cfg, trial_pack=trial_pack)
+        blk_d = mega_kernel_plan(cfg, trial_pack=trial_pack, gen=gen)
         return None if blk_d is None else (blk_d, blk_v)
-    cands = mega_candidates(cfg, blk_v, trial_pack)
+    cands = mega_candidates(cfg, blk_v, trial_pack, gen)
     return (cands[0], blk_v) if cands else None
+
+
+def _resolve_mega_gen_impl(cfg: QBAConfig, trial_pack: int = 1) -> str:
+    """``"gf2"`` when step-1 generation runs inside the megakernel's
+    launch, ``"host"`` otherwise.  The fused path exists only for the
+    stabilizer sampler; ``mega_gen`` forces either side, and ``"auto"``
+    fuses exactly when a gen-fused plan (estimate + probe) is
+    admitted.  A forced ``"gf2"`` that cannot be honored still
+    resolves ``"host"`` here — the engine records the demotion loudly
+    at dispatch."""
+    if cfg.mega_gen == "host" or cfg.qsim_path != "stabilizer":
+        return "host"
+    plan = _memo(
+        _resolve_key("mega", cfg, None, (trial_pack, True)),
+        lambda: _resolve_mega_block_impl(cfg, trial_pack, gen=True),
+    )
+    return "host" if plan is None else "gf2"
+
+
+def resolve_mega_gen(cfg: QBAConfig, trial_pack: int = 1) -> str:
+    """Memoized :func:`_resolve_mega_gen_impl` (see
+    :func:`resolve_verdict_variant`)."""
+    return _memo(
+        _resolve_key(
+            "megagen", cfg, None,
+            (trial_pack, cfg.mega_gen, cfg.qsim_path),
+        ),
+        lambda: _resolve_mega_gen_impl(cfg, trial_pack),
+    )
 
 
 def resolve_mega_block(
     cfg: QBAConfig, trial_pack: int = 1
 ) -> tuple[int, int] | None:
     """Memoized :func:`_resolve_mega_block_impl` (see
+    :func:`resolve_verdict_variant`) — planned for the generation mode
+    :func:`resolve_mega_gen` settles on, so one resolver call answers
+    both "which blocks" and "which launch shape"."""
+    gen = resolve_mega_gen(cfg, trial_pack) == "gf2"
+    return _memo(
+        _resolve_key("mega", cfg, None, (trial_pack, gen)),
+        lambda: _resolve_mega_block_impl(cfg, trial_pack, gen=gen),
+    )
+
+
+def _sharded_mega_estimate(cfg: QBAConfig, blk_d: int, blk_v: int,
+                           n_tp: int) -> int:
+    """Loose VMEM estimate for the party-sharded megakernel on one tp
+    shard: the fused kernel's per-step terms at the local receiver
+    count, ONE global pool half (the assembled A side every shard
+    reads), the local B half plus the double-buffered ring transient
+    (two comm slots of the local segment), and the shard's slice of
+    the round-stacked draw slabs."""
+    n_rv = cfg.n_lieutenants
+    n_local = n_rv // n_tp
+    n_pool = n_rv * cfg.slots
+    s, max_l = cfg.size_l, cfg.max_l
+    vb = jnp.dtype(pool_vals_dtype(cfg)).itemsize
+    pool = (
+        vb * max_l * n_pool * s + 4 * n_pool * max_l
+        + vb * n_pool * s + 4 * n_pool * 4
+    )
+    local = pool // n_tp
+    draws = 3 * 4 * cfg.n_rounds * n_local * n_pool
+    decode = 4 * n_pool * n_local + 4 * n_pool * max(s, cfg.w)
+    return (
+        _fused_estimate(cfg, blk_d, blk_v, n_local, 1)
+        + pool + 3 * local + draws + decode
+    )
+
+
+def sharded_mega_candidates(cfg: QBAConfig, n_tp: int,
+                            blk_v: int | None = None) -> list[int]:
+    """Candidate destination block sizes for the party-sharded trial
+    megakernel — divisors of the LOCAL destination rows screened
+    against the reserved megakernel budget (the in-kernel ring's
+    in-flight DMA transients draw on the same :data:`_MEGA_RESERVE`
+    the gen prologue does)."""
+    n_rv = cfg.n_lieutenants
+    if n_tp < 2 or n_rv % n_tp != 0:
+        return []
+    n_local = n_rv // n_tp
+    loc_rows = n_local * cfg.slots
+    if blk_v is None:
+        blk_v = resolve_tiled_block(cfg, n_local)
+    divs = [d for d in range(loc_rows, 0, -1) if loc_rows % d == 0]
+    cands = [d for d in divs if d % 8 == 0] or divs
+    ok = [
+        b for b in cands
+        if _sharded_mega_estimate(cfg, b, blk_v, n_tp)
+        <= _mega_budget(gen=True)
+    ]
+    return _order_candidates(ok, _preferred_block(cfg))
+
+
+def _sharded_mega_plan_impl(
+    cfg: QBAConfig, n_tp: int
+) -> tuple[int, int] | None:
+    """``(blk_d, blk_v)`` for the party-sharded trial megakernel at
+    ``n_tp`` shards, or None to demote to the fused per-round engine
+    under the tp mesh.  Estimate-gated only — the in-kernel ring uses
+    remote DMA under shard_map, which has no single-device compile
+    probe; a dispatch failure on real hardware degrades loudly through
+    :func:`qba_tpu.parallel.spmd.run_trials_spmd`'s fallback (same
+    contract as the ring shuffle itself)."""
+    n_rv = cfg.n_lieutenants
+    if n_rv % n_tp != 0:
+        return None
+    n_local = n_rv // n_tp
+    # The sharded engine always resolves in the GROUP family at the
+    # local receiver count (allrecv is the global-batch formulation;
+    # _resolve_verdict_variant_impl with n_recv set never returns it),
+    # so the verdict block is the per-shard tiled plan.
+    blk_v = resolve_tiled_block(cfg, n_local)
+    n_pool = n_rv * cfg.slots
+    if n_pool % blk_v != 0:
+        return None
+    ordered = sharded_mega_candidates(cfg, n_tp, blk_v)
+    return (ordered[0], blk_v) if ordered else None
+
+
+def sharded_mega_plan(cfg: QBAConfig, n_tp: int) -> tuple[int, int] | None:
+    """Memoized :func:`_sharded_mega_plan_impl` (see
     :func:`resolve_verdict_variant`)."""
     return _memo(
-        _resolve_key("mega", cfg, None, (trial_pack,)),
-        lambda: _resolve_mega_block_impl(cfg, trial_pack),
+        _resolve_key(
+            "megash", cfg, cfg.n_lieutenants // n_tp
+            if n_tp and cfg.n_lieutenants % n_tp == 0 else None,
+            (n_tp,),
+        ),
+        lambda: _sharded_mega_plan_impl(cfg, n_tp),
     )

@@ -36,6 +36,32 @@ kernel touching only slice ``t`` per trial.
 wrap no longer exists on the host.  ``rounds/engine.py`` records a
 ``QBADemotionWarning`` demotion to ``pallas_fused`` when counters are
 requested (the ``scan_rounds(collect=True)`` seam).
+
+**In-VMEM generation** (``gen=True``, the ``mega_gen="gf2"`` knob):
+the step-1 particle pool is generated INSIDE the same launch — the
+packed GF(2) stabilizer tableaux of both protocol circuit families
+arrive as static VMEM inputs, the per-trial phase vectors / coins /
+correlation mask arrive from :func:`qba_tpu.qsim.protocol_circuits
+.stabilizer_gen_operands` (host PRNG, same key tree as
+``generate_lists_for``), and the kernel prologue runs ONE batched
+measurement sweep — the literal
+:func:`qba_tpu.gf2.symplectic.gf2_measure_sweep` both host paths
+execute, over per-shot tableaux pre-selected by the qcorr mask — then
+decodes order values and derives the ``p``/``li`` operands into VMEM
+scratch.  The rest of the kernel body is byte-for-byte the host-gen
+body reading those scratch refs, so gen-fused and host-gen trials are
+bit-identical by construction and the particle pool never touches HBM.
+
+**Party-sharded variant** (:func:`build_sharded_trial_megakernel`):
+the tp-mesh twin — each device carries its ``n_local`` receivers'
+verdict/build state, the GLOBAL pool lives in every device's VMEM
+scratch, and the per-round pool exchange is PR 14's double-buffered
+``make_async_remote_copy`` neighbor ring moved INSIDE the kernel's
+round loop (``n_rounds * (tp - 1)`` hops per trial, overlap-scheduled
+against the accept algebra).  TPU-only by construction, like
+:mod:`qba_tpu.ops.ring_shuffle`; off-TPU the spmd layer runs the
+fused-engine schedule as the megakernel's transport twin
+(:mod:`qba_tpu.parallel.spmd`).
 """
 
 from __future__ import annotations
@@ -54,6 +80,7 @@ from qba_tpu.adversary import (
 )
 from qba_tpu.config import QBAConfig
 from qba_tpu.core.types import SENTINEL
+from qba_tpu.gf2.symplectic import gf2_measure_sweep
 from qba_tpu.ops.round_kernel import (
     CompilerParams,
     _lane_group,
@@ -81,6 +108,7 @@ def build_trial_megakernel(
     variant: str = "group",
     trial_pack: int = 1,
     out_vma=None,
+    gen: bool = False,
 ):
     """Build the one-launch trial kernel.
 
@@ -98,6 +126,18 @@ def build_trial_megakernel(
 
     and ``vi'`` int32 ``[(k,) n_rv, w]``, ``decisions`` int32
     ``[(k,) n_rv]``, ``overflow`` bool (per trial when packed).
+
+    With ``gen=True`` (``mega_gen="gf2"``) the ``p_rows``/``li``/
+    ``li_arg`` operands disappear and the returned callable is instead
+    ``mega(gen_ops, v_sent, honest_cells, attack, rand_v, late)``
+    where ``gen_ops = (qcorr, coins, r_q, r_nq, mflip)`` is exactly
+    :func:`~qba_tpu.qsim.protocol_circuits.stabilizer_gen_operands`
+    of the trial's ``k_lists`` subkey (leading ``k`` axis when
+    packed): the kernel prologue sweeps the tableaux in VMEM and
+    derives ``p``/``li``/the verdict tables of the resolved variant
+    (lane-packed lists for the group family, the
+    :func:`make_receiver_tables` algebra for ``"allrecv"``) into
+    scratch.
     """
     n_rv, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
     size_l, w = cfg.size_l, cfg.w
@@ -119,6 +159,15 @@ def build_trial_megakernel(
         raise ValueError(
             f"allrecv variant unsupported at size_l={size_l}, w={w}"
         )
+    if gen and cfg.qsim_path != "stabilizer":
+        raise ValueError(
+            "gen-fused megakernel requires qsim_path='stabilizer'"
+        )
+    n_parties, nq, total = cfg.n_parties, cfg.n_qubits, cfg.total_qubits
+    if gen:
+        from qba_tpu.qsim.protocol_circuits import stabilizer_gen_tables
+
+        gen_tables = stabilizer_gen_tables(cfg)  # 4 x [2T, W] uint32
 
     # Receiver lane-packing plan — identical to the fused kernel.
     grp = _lane_group(size_l, n_rv)
@@ -131,7 +180,32 @@ def build_trial_megakernel(
         e_np[j, j * size_l : (j + 1) * size_l] = 1.0
 
     def kernel(*refs):
-        if variant == "allrecv":
+        if gen and variant == "allrecv":
+            (
+                xq_ref, zq_ref, xn_ref, zn_ref,
+                rq_ref, rn_ref, qc_ref, coins_ref, mf_ref,
+                v_ref, vrow_ref,
+                hon_ref, att_ref, rv_ref, late_ref,
+                ovi_ref, dec_ref, ovf_ref,
+                p_ref, pt_ref, li_ref, lit_ref,
+                t1_ref, t2_ref, tob_ref, tlh_ref, tlh2_ref,
+                vals_a, lens_a, pa_scr, meta_a,
+                vals_b, lens_b, pb_scr, meta_b,
+                acc_scr, w_scr, s_scr, lane_scr,
+            ) = refs
+        elif gen:
+            (
+                xq_ref, zq_ref, xn_ref, zn_ref,
+                rq_ref, rn_ref, qc_ref, coins_ref, mf_ref,
+                v_ref, vrow_ref,
+                hon_ref, att_ref, rv_ref, late_ref, e_ref,
+                ovi_ref, dec_ref, ovf_ref,
+                p_ref, pt_ref, li_ref, lit_ref, lip_ref, lioob_ref,
+                vals_a, lens_a, pa_scr, meta_a,
+                vals_b, lens_b, pb_scr, meta_b,
+                acc_scr, w_scr, s_scr, lane_scr,
+            ) = refs
+        elif variant == "allrecv":
             (
                 p_ref, pt_ref, li_ref, lit_ref, v_ref, vrow_ref,
                 hon_ref, att_ref, rv_ref, late_ref,
@@ -154,6 +228,100 @@ def build_trial_megakernel(
 
         def T(ref, t):  # full per-trial view of a trial-varying ref
             return ref[t] if packed else ref[:]
+
+        if gen:
+            # ---- Gen prologue: step 1 IN VMEM.  Select each shot's
+            # initial tableau by its qcorr bit (the sweep is per-shot
+            # deterministic, so selecting inputs commutes with the host
+            # path's post-sweep `where(qcorr, bits_q, bits_nq)`), run
+            # the ONE shared measurement sweep over the whole
+            # (trial-pack x size_l) shot batch, fold the readout flips,
+            # decode order values (measure_to_ints' big-endian weights
+            # as shifts), and derive every list-dependent operand the
+            # host-gen kernel takes as inputs — into VMEM scratch the
+            # rest of the body reads through the SAME names.
+            b_all = kk * size_l
+
+            def flat(ref, width):
+                val = ref[:]
+                return val.reshape(b_all, width) if packed else val
+
+            qc_all = flat(qc_ref, 1)            # [B, 1] int32
+            r_all = jnp.where(
+                qc_all != 0, flat(rq_ref, 2 * total), flat(rn_ref, 2 * total)
+            )
+            qc3 = (qc_all != 0)[:, :, None]     # [B, 1, 1]
+            xw0 = jnp.where(qc3, xq_ref[:][None], xn_ref[:][None])
+            zw0 = jnp.where(qc3, zq_ref[:][None], zn_ref[:][None])
+            bits = gf2_measure_sweep(
+                total, xw0, zw0, r_all, flat(coins_ref, total)
+            ) ^ flat(mf_ref, total)             # [B, T]
+            shifts = (nq - 1) - jax.lax.broadcasted_iota(
+                jnp.int32, (1, 1, nq), 2
+            )
+            lists_bt = jnp.sum(
+                bits.reshape(b_all, n_parties + 1, nq) << shifts, axis=-1
+            )                                   # [B, n_parties + 1]
+            for t in range(kk):
+                lists_t = lists_bt[t * size_l : (t + 1) * size_l]
+                isq = lists_t[:, 0:1] != lists_t[:, 1:2]  # [size_l, 1]
+                pt_v = jnp.where(
+                    isq & (lists_t[:, 1:2] == T(vrow_ref, t)), 1, 0
+                )                               # [size_l, n_rv]
+                lit_v = lists_t[:, 2:]
+                p_v = jnp.swapaxes(pt_v, 0, 1)
+                li_v = jnp.swapaxes(lit_v, 0, 1)
+                if packed:
+                    p_ref[t], pt_ref[t] = p_v, pt_v
+                    li_ref[t], lit_ref[t] = li_v, lit_v
+                else:
+                    p_ref[:], pt_ref[:] = p_v, pt_v
+                    li_ref[:], lit_ref[:] = li_v, lit_v
+                if variant == "allrecv":
+                    # make_receiver_tables' algebra on the decoded
+                    # lists — one-hots built from 3-D iotas instead of
+                    # the host's arange-compare + transpose.
+                    lit_f = lit_v.astype(jnp.float32)
+                    t1_v = lit_f + 1.0
+                    t2_v = lit_f * lit_f - 1.0
+                    tob_v = jnp.where(
+                        (lit_v > w) | (lit_v < 0), 1.0, 0.0
+                    )
+                    iota_sqn = jax.lax.broadcasted_iota(
+                        jnp.int32, (size_l, w, n_rv), 1
+                    )
+                    tlh_v = jnp.where(
+                        lit_v[:, None, :] == iota_sqn, 1.0, 0.0
+                    ).reshape(size_l, w * n_rv).astype(gdt)
+                    iota_qsn = jax.lax.broadcasted_iota(
+                        jnp.int32, (w, size_l, n_rv), 0
+                    )
+                    tlh2_v = jnp.where(
+                        lit_v[None, :, :] == iota_qsn, 1.0, 0.0
+                    ).reshape(w * size_l, n_rv).astype(gdt)
+                    if packed:
+                        t1_ref[t], t2_ref[t], tob_ref[t] = (
+                            t1_v, t2_v, tob_v
+                        )
+                        tlh_ref[t], tlh2_ref[t] = tlh_v, tlh2_v
+                    else:
+                        t1_ref[:], t2_ref[:], tob_ref[:] = (
+                            t1_v, t2_v, tob_v
+                        )
+                        tlh_ref[:], tlh2_ref[:] = tlh_v, tlh2_v
+                else:
+                    lip_v = jnp.concatenate(
+                        [
+                            li_v[r0 : r0 + grp].reshape(1, seg_l)
+                            for r0 in r0_list
+                        ],
+                        axis=0,
+                    )
+                    lioob_v = jnp.where((lip_v > w) | (lip_v < 0), 1, 0)
+                    if packed:
+                        lip_ref[t], lioob_ref[t] = lip_v, lioob_v
+                    else:
+                        lip_ref[:], lioob_ref[:] = lip_v, lioob_v
 
         iota_w = jax.lax.broadcasted_iota(jnp.int32, (n_rv, w), 1)
 
@@ -664,7 +832,10 @@ def build_trial_megakernel(
     def kdim(*dims):  # prepend the trial-pack axis when packed
         return (kk,) + dims if packed else dims
 
-    n_inputs = 15 if variant == "allrecv" else 13
+    if gen:
+        n_inputs = 15 if variant == "allrecv" else 16
+    else:
+        n_inputs = 15 if variant == "allrecv" else 13
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n_inputs)
     ]
@@ -675,6 +846,25 @@ def build_trial_megakernel(
     def oshp(*dims, dt=jnp.int32):
         return vma_struct(out_vma, dims, dt)
 
+    gen_scratch = [
+        pltpu.VMEM(kdim(n_rv, size_l), jnp.int32),   # p
+        pltpu.VMEM(kdim(size_l, n_rv), jnp.int32),   # pt
+        pltpu.VMEM(kdim(n_rv, size_l), jnp.int32),   # li
+        pltpu.VMEM(kdim(size_l, n_rv), jnp.int32),   # lit
+    ] if gen else []
+    if gen and variant == "allrecv":
+        gen_scratch += [
+            pltpu.VMEM(kdim(size_l, n_rv), jnp.float32),   # t_li1
+            pltpu.VMEM(kdim(size_l, n_rv), jnp.float32),   # t_li2
+            pltpu.VMEM(kdim(size_l, n_rv), jnp.float32),   # t_oob
+            pltpu.VMEM(kdim(size_l, w * n_rv), gdt),       # t_lh
+            pltpu.VMEM(kdim(w * size_l, n_rv), gdt),       # t_lh2
+        ]
+    elif gen:
+        gen_scratch += [
+            pltpu.VMEM(kdim(len(r0_list), seg_l), jnp.int32),  # lip
+            pltpu.VMEM(kdim(len(r0_list), seg_l), jnp.int32),  # lioob
+        ]
     pool_scratch = [
         pltpu.VMEM((max_l,) + kdim(n_pool, size_l), vdt),  # vals
         pltpu.VMEM(kdim(n_pool, max_l), jnp.int32),  # lens
@@ -696,8 +886,8 @@ def build_trial_megakernel(
         # per-recipient order column into the decision column (same
         # shape/dtype; v is only read at the entry decode, decisions
         # are only written after the loop).
-        input_output_aliases={4: 1},
-        scratch_shapes=pool_scratch + pool_scratch + [
+        input_output_aliases={9: 1} if gen else {4: 1},
+        scratch_shapes=gen_scratch + pool_scratch + pool_scratch + [
             pltpu.VMEM(kdim(n_pool, n_rv), jnp.int32),  # acc
             pltpu.VMEM(kdim(n_pool, n_rv), jnp.int32),  # write mask
             pltpu.VMEM(kdim(n_pool, n_rv), jnp.int32),  # clamped slots
@@ -730,19 +920,663 @@ def build_trial_megakernel(
     def _t(x):  # receiver-major draw layout (per trial when packed)
         return jnp.swapaxes(x, -1, -2)
 
+    def _unwrap(out):
+        ovi, dec, ovf = out
+        if packed:
+            return ovi, dec[..., 0], ovf[:, 0] > 0
+        return ovi, dec[:, 0], ovf[0, 0] > 0
+
+    if gen:
+        tables_c = tuple(jnp.asarray(tbl) for tbl in gen_tables)
+
+        gen_tail = () if variant == "allrecv" else (jnp.asarray(e_np),)
+
+        def mega_gen(gen_ops, v_sent, honest_pk, attack, rand_v, late):
+            qcorr, coins, r_q, r_nq, mflip = gen_ops
+            v_i = v_sent.astype(jnp.int32)
+            return _unwrap(call(
+                *tables_c,
+                r_q.astype(jnp.int32), r_nq.astype(jnp.int32),
+                qcorr.astype(jnp.int32)[..., None],
+                coins.astype(jnp.int32), mflip.astype(jnp.int32),
+                v_i[..., :, None], v_i[..., None, :], honest_pk,
+                _t(attack), _t(rand_v), _t(late), *gen_tail,
+            ))
+
+        return mega_gen
+
     def mega(p_rows, li, li_arg, v_sent, honest_pk, attack, rand_v,
              late):
         p_i = p_rows.astype(jnp.int32)
         li_i = li.astype(jnp.int32)
         v_i = v_sent.astype(jnp.int32)
-        out = call(
+        return _unwrap(call(
             p_i, _t(p_i), li_i, _t(li_i),
             v_i[..., :, None], v_i[..., None, :], honest_pk,
             _t(attack), _t(rand_v), _t(late), *_tail(li_arg),
+        ))
+
+    return mega
+
+
+def _ring_compiler_params(collective_id: int):
+    """Mosaic params for the in-loop ring: side-effecting (remote DMA
+    must not be reordered or elided) + a collective id distinct from
+    the per-round ring shuffle's.  Older jax builds predate the
+    ``has_side_effects`` field — there the DMA effects themselves keep
+    the call live, so dropping the flag is trace-compatible (those
+    builds cannot execute remote DMA anyway; this kernel is TPU-only
+    and the off-TPU suites only trace it)."""
+    try:
+        return CompilerParams(
+            has_side_effects=True,
+            collective_id=collective_id,
+            vmem_limit_bytes=100 * 2**20,
         )
-        ovi, dec, ovf = out
-        if packed:
-            return ovi, dec[..., 0], ovf[:, 0] > 0
+    except TypeError:
+        return CompilerParams(
+            collective_id=collective_id,
+            vmem_limit_bytes=100 * 2**20,
+        )
+
+
+def build_sharded_trial_megakernel(
+    cfg: QBAConfig,
+    blk_d: int,
+    blk_v: int,
+    *,
+    n_tp: int,
+    variant: str = "group",
+    out_vma=None,
+    axis_name: str = "tp",
+    mesh_axes: tuple[str, ...] = ("dp", "tp"),
+    collective_id: int = 2,
+):
+    """One launch = one trial on a ``tp``-sharded mesh: the megakernel
+    with the per-round pool exchange — PR 14's double-buffered
+    ``make_async_remote_copy`` neighbor ring — INSIDE the round
+    ``fori_loop``.
+
+    Each device carries its ``n_local = n_lieutenants / n_tp``
+    receivers' state: the verdict carry ``vi`` [n_local, w], the LOCAL
+    successor-pool half B (``n_local * slots`` rows, locally
+    compacted), and ONE assembled GLOBAL pool half A (``n_pool`` rows)
+    every shard reads during the verdict phase.  A round is
+
+    1. ``exchange()`` — neighbor barrier, own B segment into A at this
+       shard's offset, then ``n_tp - 1`` remote-DMA hops (one per pool
+       leaf: vals/lens/p/meta through 2-slot comm scratch, the
+       :mod:`qba_tpu.ops.ring_shuffle` schedule verbatim) depositing
+       every other shard's segment at its owner's offset — so ring
+       hops per trial = ``n_rounds * (n_tp - 1)``, the count the KI-5
+       launch model pins;
+    2. the single-device round body at ``n_rv = n_local`` with
+       ``r_off = start`` (the traced global receiver offset
+       ``axis_index("tp") * n_local`` — sender/self-delivery ids stay
+       global) over the global A, writing the local B.
+
+    Pool cell ids in ``meta`` are GLOBAL (``(start + r_j) * slots +
+    slot``), so draw selection and the sender-id algebra are
+    bit-identical to the single-device megakernel; physical rows are
+    segment-compacted rather than globally compacted, which the
+    verdict phase is insensitive to (empty rows carry ``SENT = 0`` —
+    the same layout the fused sharded engine's host-side gather
+    produces, pinned bit-identical in tests/test_parallel.py).
+
+    The cross-exchange barrier re-runs EVERY exchange (not just at
+    kernel entry like the one-launch-per-hop ring shuffle): a neighbor
+    must not start a new exchange's remote writes into our comm slots
+    while this device still reads the prior exchange's deposits.  The
+    pairwise 2-signal barrier bounds ring skew to one exchange, which
+    is exactly the guarantee the 2-slot buffers need.
+
+    TPU-only by construction (remote DMA has no interpret path):
+    :mod:`qba_tpu.parallel.spmd` builds it only on a real TPU backend
+    and runs the fused-engine schedule as the off-TPU transport twin.
+
+    Returns ``mega(my_p, my_li, my_v, honest_cells, attack, rand_v,
+    late) -> (vi' [n_local, w], decisions [n_local], overflow)`` with
+    ``my_*`` the shard's receiver slices and draws ``[n_rounds,
+    n_pool, n_local]`` cell-major (this shard's receiver columns of
+    the full stacked slabs).
+    """
+    n_rv, slots, max_l = cfg.n_lieutenants, cfg.slots, cfg.max_l
+    size_l, w = cfg.size_l, cfg.w
+    n_pool = n_rv * slots
+    n_rounds, n_dis = cfg.n_rounds, cfg.n_dishonest
+    if n_tp < 2:
+        raise ValueError(f"n_tp={n_tp} must be >= 2")
+    if n_rv % n_tp:
+        raise ValueError(
+            f"n_tp={n_tp} must divide n_lieutenants={n_rv}"
+        )
+    if axis_name not in mesh_axes:
+        raise ValueError(
+            f"axis_name {axis_name!r} not in mesh_axes {mesh_axes!r}"
+        )
+    n_local = n_rv // n_tp
+    loc_rows = n_local * slots
+    if loc_rows % blk_d:
+        raise ValueError(
+            f"blk_d={blk_d} must divide local rows {loc_rows}"
+        )
+    if n_pool % blk_v:
+        raise ValueError(f"blk_v={blk_v} must divide n_pool={n_pool}")
+    if variant not in ("group", "group-serial"):
+        raise ValueError(
+            "party-sharded megakernel stays in the group family; got "
+            f"variant={variant!r}"
+        )
+    gdt = _gdt(cfg)
+    vdt = pool_vals_dtype(cfg)
+
+    # Receiver lane-packing plan at the LOCAL receiver count.
+    grp = _lane_group(size_l, n_local)
+    seg_l = grp * size_l
+    r0_list = list(range(0, n_local - grp + 1, grp))
+    if n_local % grp:
+        r0_list.append(n_local - grp)
+    e_np = np.zeros((grp, seg_l), np.float32)
+    for j in range(grp):
+        e_np[j, j * size_l : (j + 1) * size_l] = 1.0
+
+    def kernel(
+        p_ref, pt_ref, li_ref, lit_ref, v_ref, vrow_ref,
+        hon_ref, att_ref, rv_ref, late_ref,
+        e_ref, lip_ref, lioob_ref,
+        ovi_ref, dec_ref, ovf_ref,
+        vals_a, lens_a, pa_scr, meta_a,
+        vals_b, lens_b, pb_scr, meta_b,
+        acc_scr,
+        vals_c, lens_c, p_c, meta_c, send_sem, recv_sem,
+    ):
+        my_tp = jax.lax.axis_index(axis_name)
+        start = my_tp * n_local  # global receiver offset (traced)
+
+        def coords(tp_idx):
+            # Mesh-coordinate device id: every non-tp axis keeps this
+            # device's own index (the ring never leaves its tp row).
+            return tuple(
+                tp_idx if a == axis_name else jax.lax.axis_index(a)
+                for a in mesh_axes
+            )
+
+        right = jax.lax.rem(my_tp + 1, n_tp)
+        left = jax.lax.rem(my_tp + n_tp - 1, n_tp)
+
+        iota_w = jax.lax.broadcasted_iota(jnp.int32, (n_local, w), 1)
+
+        # ---- Entry: step 3a on the LOCAL receivers + local-segment
+        # compaction into the B half (the global A is assembled by the
+        # first exchange).  Same algebra as the single-device entry
+        # decode with n_rv -> n_local; cell ids written GLOBAL.
+        ovf_ref[:] = jnp.zeros((1, 1), jnp.int32)
+        p_i = p_ref[:]  # [n_local, size_l] 0/1
+        li_m = li_ref[:]
+        v_col = v_ref[:]  # [n_local, 1]
+        in_c = (p_i != 0) & (li_m != SENTINEL)
+        bad_c = in_c & ((li_m == v_col) | (li_m > w) | (li_m < 0))
+        ok_c = (
+            jnp.sum(jnp.where(bad_c, 1, 0), axis=1, keepdims=True) == 0
+        )
+        ovi_ref[:] = jnp.where((iota_w == v_col) & ok_c, 1, 0)
+
+        p_t = pt_ref[:]  # [size_l, n_local]
+        li_t = lit_ref[:]
+        v_row = vrow_ref[:]  # [1, n_local]
+        in_r = (p_t != 0) & (li_t != SENTINEL)
+        bad_r = in_r & ((li_t == v_row) | (li_t > w) | (li_t < 0))
+        ok_r = jnp.where(
+            jnp.sum(jnp.where(bad_r, 1, 0), axis=0, keepdims=True) == 0,
+            1,
+            0,
+        )  # [1, n_local]
+        x = ok_r
+        k = 1
+        while k < n_local:
+            x = x + jnp.pad(x, ((0, 0), (k, 0)))[:, :n_local]
+            k *= 2
+        offs_row = x - ok_r  # exclusive prefix = local pool position
+        total0 = jnp.sum(ok_r)
+
+        d_col = jax.lax.broadcasted_iota(jnp.int32, (loc_rows, 1), 0)
+        live0 = d_col < total0
+        offs_b = jnp.broadcast_to(offs_row, (loc_rows, n_local))
+        ok_b = jnp.broadcast_to(ok_r, (loc_rows, n_local))
+        onehot0 = (offs_b <= d_col) & (d_col < offs_b + ok_b)
+        oh_i0 = jnp.where(onehot0, 1, 0)
+        oh_f0 = jnp.where(onehot0, 1.0, 0.0).astype(gdt)
+
+        def oh_mm0(tbl, dt=gdt):  # [n_local, X] -> [loc_rows, X]
+            return jax.lax.dot_general(
+                oh_f0.astype(dt), tbl.astype(dt),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=_prec(dt),
+            )
+
+        own0 = jnp.where(p_i != 0, li_m, SENTINEL)
+        own_len0 = jnp.sum(p_i, axis=1, keepdims=True)
+        row0 = jnp.where(
+            live0, oh_mm0(own0).astype(jnp.int32), SENTINEL
+        ).astype(vdt)
+        empty0 = jnp.full((loc_rows, size_l), SENTINEL, vdt)
+        for r in range(max_l):
+            vals_b[r] = row0 if r == 0 else empty0
+        l0 = jnp.where(live0, oh_mm0(own_len0).astype(jnp.int32), 0)
+        iota_l0 = jax.lax.broadcasted_iota(
+            jnp.int32, (loc_rows, max_l), 1
+        )
+        lens_b[:] = jnp.where(live0 & (iota_l0 == 0), l0, 0)
+        pb_scr[:] = jnp.where(
+            live0, oh_mm0(p_i).astype(jnp.int32), 0
+        ).astype(vdt)
+        iota_rv0 = jax.lax.broadcasted_iota(
+            jnp.int32, (loc_rows, n_local), 1
+        )
+        r_j0 = jnp.sum(oh_i0 * iota_rv0, axis=1, keepdims=True)
+        one_col0 = jnp.where(live0, 1, 0)
+        v_dec0 = jnp.where(live0, oh_mm0(v_col).astype(jnp.int32), 0)
+        meta_b[:] = jnp.concatenate(
+            [
+                one_col0, v_dec0, one_col0,
+                jnp.where(live0, (start + r_j0) * slots, 0),
+            ],
+            axis=1,
+        )
+
+        # ---- In-loop exchange: assemble global A from every shard's
+        # B segment.  The ring_shuffle hop schedule, once per pool
+        # leaf, all four leaves' hops issued before any wait.
+        def exchange():
+            barrier = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=coords(left),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=coords(right),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            pltpu.semaphore_wait(barrier, 2)
+
+            row_own = my_tp * loc_rows
+            for r in range(max_l):
+                vals_a[r, pl.ds(row_own, loc_rows)] = vals_b[r]
+                vals_c[0, r] = vals_b[r]
+            lens_a[pl.ds(row_own, loc_rows)] = lens_b[:]
+            lens_c[0] = lens_b[:]
+            pa_scr[pl.ds(row_own, loc_rows)] = pb_scr[:]
+            p_c[0] = pb_scr[:]
+            meta_a[pl.ds(row_own, loc_rows)] = meta_b[:]
+            meta_c[0] = meta_b[:]
+
+            leaves = (vals_c, lens_c, p_c, meta_c)
+            for step in range(n_tp - 1):
+                send_slot = step % 2
+                recv_slot = (step + 1) % 2
+                rdmas = []
+                for leaf, ref in enumerate(leaves):
+                    rdma = pltpu.make_async_remote_copy(
+                        src_ref=ref.at[send_slot],
+                        dst_ref=ref.at[recv_slot],
+                        send_sem=send_sem.at[leaf, send_slot],
+                        recv_sem=recv_sem.at[leaf, recv_slot],
+                        device_id=coords(right),
+                        device_id_type=pltpu.DeviceIdType.MESH,
+                    )
+                    rdma.start()
+                    rdmas.append(rdma)
+                for rdma in rdmas:
+                    rdma.wait()
+                # The segment now in recv_slot originated step+1 hops
+                # to the left.
+                src_dev = jax.lax.rem(my_tp + n_tp - step - 1, n_tp)
+                dst0 = src_dev * loc_rows
+                for r in range(max_l):
+                    vals_a[r, pl.ds(dst0, loc_rows)] = (
+                        vals_c[recv_slot, r]
+                    )
+                lens_a[pl.ds(dst0, loc_rows)] = lens_c[recv_slot]
+                pa_scr[pl.ds(dst0, loc_rows)] = p_c[recv_slot]
+                meta_a[pl.ds(dst0, loc_rows)] = meta_c[recv_slot]
+
+        # ---- Round loop: exchange, verdict over the global A at the
+        # local receiver lanes, local B rebuild.
+        def round_body(r_idx, carry):
+            exchange()
+            att_t = att_ref[r_idx - 1]  # [n_local, n_pool]
+            rv_t = rv_ref[r_idx - 1]
+            late_t = late_ref[r_idx - 1]
+            tables_t = (e_ref[:], lip_ref[:], lioob_ref[:])
+
+            # --- Verdict (phase A), vi carried through ovi.
+            for b0 in range(0, n_pool, blk_v):
+                sl = slice(b0, b0 + blk_v)
+                meta_blk = meta_a[sl]
+                live_b = jnp.sum(
+                    meta_blk[:, META_SENT : META_SENT + 1]
+                ) > 0
+
+                @pl.when(live_b)
+                def _do(sl=sl, meta_blk=meta_blk, att_t=att_t,
+                        rv_t=rv_t, late_t=late_t, tables_t=tables_t):
+                    acc, new_vi = _verdict_block_accepts(
+                        variant=variant, blk=blk_v, n_rv=n_local,
+                        n_cells=n_pool, slots=slots, max_l=max_l,
+                        size_l=size_l, w=w, gdt=gdt, grp=grp,
+                        seg_l=seg_l, r0_list=r0_list,
+                        r_off=start, r_idx=r_idx,
+                        vals=[
+                            vals_a[r, sl].astype(jnp.int32)
+                            for r in range(max_l)
+                        ],
+                        lens=lens_a[sl],
+                        p_i32=(pa_scr[sl] != 0).astype(jnp.int32),
+                        meta=meta_blk,
+                        vi=ovi_ref[:],
+                        honest_col=hon_ref[:],
+                        att_t=att_t, rv_t=rv_t, late_t=late_t,
+                        tables=tables_t,
+                        use_fp=cfg.strategy == "split",
+                    )
+                    acc_scr[sl] = acc
+                    ovi_ref[:] = new_vi
+
+                @pl.when(jnp.logical_not(live_b))
+                def _skip_blk(sl=sl):
+                    acc_scr[sl] = jnp.zeros(
+                        (blk_v, n_local), jnp.int32
+                    )
+
+            # --- Slot allocation: packet-major prefix over the GLOBAL
+            # pool, lane prefix over the LOCAL receivers.
+            acc_t = acc_scr[:]  # [n_pool, n_local]
+            write0 = (acc_t != 0) & (r_idx <= n_dis)
+            w_i = jnp.where(write0, 1, 0)
+            x = w_i
+            k = 1
+            while k < n_pool:
+                x = x + jnp.pad(x, ((k, 0), (0, 0)))[:n_pool, :]
+                k *= 2
+            slot0 = x - w_i  # exclusive prefix = outgoing slot
+            write_m = write0 & (slot0 < slots)
+            ovf_val = jnp.where(
+                jnp.any(write0 & ~write_m), 1, 0
+            ).reshape(1, 1)
+            ovf_ref[:] = jnp.maximum(ovf_ref[:], ovf_val)
+            w_m = jnp.where(write_m, 1, 0)  # [n_pool, n_local]
+            s_m = jnp.minimum(slot0, slots)
+            k_lane = jnp.minimum(
+                jnp.sum(w_i, axis=0, keepdims=True), slots
+            )  # [1, n_local]
+            x = k_lane
+            k = 1
+            while k < n_local:
+                x = x + jnp.pad(x, ((0, 0), (k, 0)))[:, :n_local]
+                k *= 2
+            offs = x - k_lane  # [1, n_local] exclusive
+            total = jnp.sum(k_lane)
+
+            # --- Successor pool (phase B) into the local B half.
+            for bd0 in range(0, loc_rows, blk_d):
+                dsl = slice(bd0, bd0 + blk_d)
+
+                def zero_outputs(dsl=dsl):
+                    empty = jnp.full((blk_d, size_l), SENTINEL, vdt)
+                    for r in range(max_l):
+                        vals_b[r, dsl] = empty
+                    lens_b[dsl] = jnp.zeros((blk_d, max_l), jnp.int32)
+                    pb_scr[dsl] = jnp.zeros((blk_d, size_l), vdt)
+                    meta_b[dsl] = jnp.zeros((blk_d, 4), jnp.int32)
+
+                @pl.when(bd0 >= total)
+                def _skip(zero_outputs=zero_outputs):
+                    zero_outputs()
+
+                @pl.when(bd0 < total)
+                def _build(dsl=dsl, bd0=bd0, offs=offs, k_lane=k_lane,
+                           total=total, w_m=w_m, s_m=s_m, att_t=att_t,
+                           rv_t=rv_t):
+                    d_col = bd0 + jax.lax.broadcasted_iota(
+                        jnp.int32, (blk_d, 1), 0
+                    )  # LOCAL dst position
+                    live = d_col < total  # [blk_d, 1]
+                    offs_b = jnp.broadcast_to(offs, (blk_d, n_local))
+                    k_b = jnp.broadcast_to(k_lane, (blk_d, n_local))
+                    onehot = (offs_b <= d_col) & (
+                        d_col < offs_b + k_b
+                    )
+                    oh_i = jnp.where(onehot, 1, 0)
+                    iota_rv = jax.lax.broadcasted_iota(
+                        jnp.int32, (blk_d, n_local), 1
+                    )
+                    r_j = jnp.sum(
+                        oh_i * iota_rv, axis=1, keepdims=True
+                    )  # LOCAL receiver index
+                    slot_lane = d_col - jnp.sum(
+                        oh_i * offs_b, axis=1, keepdims=True
+                    )
+                    oh_f = jnp.where(onehot, 1.0, 0.0).astype(gdt)
+
+                    def oh_mm(tbl, dt=gdt):  # [n_local, X]
+                        return jax.lax.dot_general(
+                            oh_f.astype(dt), tbl.astype(dt),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=_prec(dt),
+                        )
+
+                    def oh_mm_t(tbl, dt=gdt):  # [n_pool, n_local]
+                        return jax.lax.dot_general(
+                            oh_f.astype(dt), tbl.astype(dt),
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=_prec(dt),
+                        )
+
+                    w_sel = oh_mm_t(w_m) > 0.5
+                    s_sel = oh_mm_t(s_m).astype(jnp.int32)
+                    g_t = w_sel & (s_sel == slot_lane)
+                    g_f = jnp.where(g_t, 1.0, 0.0)
+
+                    def gmm(field, dt=gdt):  # [n_pool, X] global A
+                        return jax.lax.dot_general(
+                            g_f.astype(dt), field.astype(dt),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=_prec(dt),
+                        )
+
+                    rows_g = [
+                        gmm(vals_a[r]).astype(jnp.int32)
+                        for r in range(max_l)
+                    ]
+                    lens_g = gmm(lens_a[:]).astype(jnp.int32)
+                    p_g = gmm(pa_scr[:]).astype(jnp.int32)
+                    # f32 + HIGHEST: cell ids reach n_pool-1 > 256.
+                    meta_g = gmm(meta_a[:], jnp.float32).astype(
+                        jnp.int32
+                    )
+                    cnt_g = meta_g[:, META_COUNT : META_COUNT + 1]
+                    v_g = meta_g[:, META_V : META_V + 1]
+                    cell_g = meta_g[:, META_CELL : META_CELL + 1]
+
+                    iota_cells = jax.lax.broadcasted_iota(
+                        jnp.int32, (blk_d, n_pool), 1
+                    )
+                    oh_cell = jnp.where(
+                        iota_cells == cell_g, 1.0, 0.0
+                    ).astype(gdt)
+
+                    def cell_mm(tbl_t, dt=gdt):  # [n_local, n_pool]
+                        return jax.lax.dot_general(
+                            oh_cell.astype(dt), tbl_t.astype(dt),
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=_prec(dt),
+                        )
+
+                    def cell_col_mm(tbl, dt=gdt):  # [n_pool, 1]
+                        return jax.lax.dot_general(
+                            oh_cell.astype(dt), tbl.astype(dt),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=_prec(dt),
+                        )
+
+                    att_rows = cell_mm(att_t)  # [blk_d, n_local]
+                    rv_rows = cell_mm(rv_t)
+                    att_c = jnp.sum(
+                        att_rows * oh_f.astype(jnp.float32),
+                        axis=1, keepdims=True,
+                    ).astype(jnp.int32)
+                    rv_c = jnp.sum(
+                        rv_rows * oh_f.astype(jnp.float32),
+                        axis=1, keepdims=True,
+                    ).astype(jnp.int32)
+                    hon_c = cell_col_mm(hon_ref[:]).astype(jnp.int32)
+
+                    biz = hon_c == 0
+                    clearp_c = biz & ((att_c & CLEAR_P_BIT) != 0)
+                    clearl_c = biz & ((att_c & CLEAR_L_BIT) != 0)
+                    v2_c = jnp.where(
+                        biz & ((att_c & FORGE_BIT) != 0), rv_c, v_g
+                    )
+                    li_row = oh_mm(li_ref[:]).astype(jnp.int32)
+
+                    # Keep/append row algebra — mirrors rebuild_pool.
+                    p2 = (p_g != 0) & ~clearp_c
+                    if cfg.strategy == "split":
+                        p2 = (
+                            biz & ((att_c & FORGE_P_BIT) != 0)
+                        ) | p2
+                    own = jnp.where(p2, li_row, SENTINEL)
+                    own_len = jnp.sum(
+                        jnp.where(p2, 1, 0), axis=1, keepdims=True
+                    )
+                    cnt_eff = jnp.where(clearl_c, 0, cnt_g)
+                    dup = jnp.zeros((blk_d, 1), jnp.bool_)
+                    for r in range(max_l):
+                        mism = jnp.sum(
+                            jnp.where(rows_g[r] != own, 1, 0),
+                            axis=1, keepdims=True,
+                        )
+                        dup |= (cnt_g > r) & (mism == 0)
+                    dup &= ~clearl_c
+                    new_cnt = jnp.where(
+                        dup, cnt_eff,
+                        jnp.minimum(cnt_eff + 1, max_l),
+                    )
+
+                    has = live
+                    iota_l = jax.lax.broadcasted_iota(
+                        jnp.int32, (blk_d, max_l), 1
+                    )
+                    keep_row = iota_l < cnt_eff
+                    new_row = ~dup & (iota_l == cnt_eff)
+                    lens_b[dsl] = jnp.where(
+                        has,
+                        jnp.where(
+                            new_row, own_len,
+                            jnp.where(keep_row, lens_g, 0),
+                        ),
+                        0,
+                    )
+                    for r in range(max_l):
+                        keep = ~clearl_c & (r < cnt_eff)
+                        is_new = ~dup & (r == cnt_eff)
+                        row = jnp.where(
+                            is_new, own,
+                            jnp.where(keep, rows_g[r], SENTINEL),
+                        )
+                        vals_b[r, dsl] = jnp.where(
+                            has, row, SENTINEL
+                        ).astype(vdt)
+                    pb_scr[dsl] = jnp.where(
+                        has & p2, 1.0, 0.0
+                    ).astype(vdt)
+                    meta_b[dsl] = jnp.where(
+                        has,
+                        jnp.concatenate(
+                            [
+                                new_cnt,
+                                v2_c,
+                                jnp.ones((blk_d, 1), jnp.int32),
+                                # GLOBAL cell id: r_j is local.
+                                (start + r_j) * slots + slot_lane,
+                            ],
+                            axis=1,
+                        ),
+                        0,
+                    )
+            return carry
+
+        jax.lax.fori_loop(1, n_rounds + 1, round_body, jnp.int32(0))
+
+        # ---- Exit: the per-lieutenant decision reduce.
+        dec_ref[:] = jnp.min(
+            jnp.where(ovi_ref[:] != 0, iota_w, w),
+            axis=1, keepdims=True,
+        )
+
+    def oshp(*dims, dt=jnp.int32):
+        return vma_struct(out_vma, dims, dt)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            oshp(n_local, w),  # vi'
+            oshp(n_local, 1),  # decisions
+            oshp(1, 1),  # overflow
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(13)
+        ],
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(3)
+        ),
+        input_output_aliases={4: 1},
+        scratch_shapes=[
+            # Global A half: every shard's assembled pool.
+            pltpu.VMEM((max_l, n_pool, size_l), vdt),
+            pltpu.VMEM((n_pool, max_l), jnp.int32),
+            pltpu.VMEM((n_pool, size_l), vdt),
+            pltpu.VMEM((n_pool, 4), jnp.int32),
+            # Local B half: this shard's successor segment.
+            pltpu.VMEM((max_l, loc_rows, size_l), vdt),
+            pltpu.VMEM((loc_rows, max_l), jnp.int32),
+            pltpu.VMEM((loc_rows, size_l), vdt),
+            pltpu.VMEM((loc_rows, 4), jnp.int32),
+            pltpu.VMEM((n_pool, n_local), jnp.int32),  # acc
+            # 2-slot ring comm buffers, one per pool leaf.
+            pltpu.VMEM((2, max_l, loc_rows, size_l), vdt),
+            pltpu.VMEM((2, loc_rows, max_l), jnp.int32),
+            pltpu.VMEM((2, loc_rows, size_l), vdt),
+            pltpu.VMEM((2, loc_rows, 4), jnp.int32),
+            pltpu.SemaphoreType.DMA((4, 2)),
+            pltpu.SemaphoreType.DMA((4, 2)),
+        ],
+        compiler_params=_ring_compiler_params(collective_id),
+    )
+
+    def _t(x):  # receiver-major draw layout
+        return jnp.swapaxes(x, -1, -2)
+
+    def mega(my_p, my_li, my_v, honest_pk, attack, rand_v, late):
+        p_i = my_p.astype(jnp.int32)
+        li_i = my_li.astype(jnp.int32)
+        v_i = my_v.astype(jnp.int32)
+        li_pack = jnp.stack(
+            [li_i[r0 : r0 + grp].reshape(-1) for r0 in r0_list]
+        )
+        li_oob = ((li_pack > w) | (li_pack < 0)).astype(jnp.int32)
+        ovi, dec, ovf = call(
+            p_i, _t(p_i), li_i, _t(li_i),
+            v_i[:, None], v_i[None, :], honest_pk,
+            _t(attack), _t(rand_v), _t(late),
+            jnp.asarray(e_np), li_pack, li_oob,
+        )
         return ovi, dec[:, 0], ovf[0, 0] > 0
 
     return mega

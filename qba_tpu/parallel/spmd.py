@@ -179,7 +179,59 @@ def _trial_party_sharded(
     # single-launch round kernel — each in a party-sharded variant
     # where the device's kernels drain only its receiver block against
     # the gathered global mailbox/pool.
-    if engine == "pallas":
+    if engine == "pallas_mega" and jax.default_backend() != "tpu":
+        # The sharded megakernel's in-loop ring is remote DMA, which
+        # has no interpret path on an emulated mesh; the fused
+        # per-round schedule is its bit-identical transport twin (same
+        # verdict/rebuild algebra, same draws, same segment-compacted
+        # pool layout), so the CPU equivalence suites exercise the same
+        # math the TPU megakernel runs.  A transport substitution, not
+        # a capability demotion — no warning (the ``ppermute`` twin of
+        # :mod:`qba_tpu.ops.ring_shuffle` is the precedent).
+        engine = "pallas_fused"
+
+    if engine == "pallas_mega":
+        # One launch per trial on the tp mesh: the entry decode, the
+        # ``n_rounds * (tp - 1)`` in-kernel ring hops, and every voting
+        # round run inside a single pallas_call per device — the KI-5
+        # end state, replacing the recorded spmd demotion that ran the
+        # per-round fused kernel here through round 10.
+        from qba_tpu.ops.round_kernel_tiled import (
+            honest_cells as honest_cells_fn,
+            resolve_verdict_variant,
+            sharded_mega_plan,
+        )
+        from qba_tpu.ops.trial_megakernel import (
+            build_sharded_trial_megakernel,
+        )
+        from qba_tpu.rounds.engine import _stacked_draws
+
+        # _resolve_spmd_engine only selects this engine with a plan in
+        # hand (estimate-gated; no compile probe exists for remote DMA
+        # under shard_map — a dispatch failure degrades loudly through
+        # run_trials_spmd's fallback).
+        blk_d, blk_v = sharded_mega_plan(cfg, n_tp)
+        variant = resolve_verdict_variant(cfg, n_recv=n_local)
+        mega = build_sharded_trial_megakernel(
+            cfg, blk_d, blk_v, n_tp=n_tp, variant=variant,
+            out_vma=tiled_out_vma, axis_name="tp", mesh_axes=mesh_axes,
+        )
+        honest_cells = honest_cells_fn(honest, cfg)
+        # The same pre-stacked fold_in draw slabs the single-device
+        # megakernel consumes, sliced to this shard's receiver columns
+        # — placement cannot change the randomness.
+        att_s, rv_s, late_s = (
+            jax.lax.dynamic_slice_in_dim(d, start, n_local, 2)
+            .astype(jnp.int32)
+            for d in _stacked_draws(cfg, k_rounds, ctx)
+        )
+        vi_i32, _, mega_ovf = mega(
+            my_p, my_li, my_v, honest_cells, att_s, rv_s, late_s
+        )
+        vi_l = vi_i32 != 0
+        overflows = mega_ovf
+        cst = None
+    elif engine == "pallas":
         from qba_tpu.ops.round_kernel import (
             build_round_step,
             honest_packets,
@@ -554,7 +606,7 @@ def _resolve_check_vma(engine: str) -> bool:
     literal indices lack the operand's vma, which the checker rejects.
     The tiled engine additionally honors the ``QBA_TILED_CHECK_VMA``
     escape hatch (:func:`_tiled_check_vma`)."""
-    if engine in ("pallas_tiled", "pallas_fused"):
+    if engine in ("pallas_tiled", "pallas_fused", "pallas_mega"):
         return _tiled_check_vma()
     return not (engine == "pallas" and jax.default_backend() != "tpu")
 
@@ -626,30 +678,76 @@ def run_trials_spmd(
 
 def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:
     """Engine for the party-sharded round loop: forced engines pass
-    through (both Pallas kernel families have party-sharded variants);
-    ``auto`` on TPU follows the same flat preference order as the
-    single-device :func:`~qba_tpu.rounds.engine.resolve_round_engine`
-    (packet-tiled first everywhere since round 4, monolithic second,
-    XLA last), probing the LOCAL-receiver kernel variants.
+    through (every Pallas engine family has a party-sharded variant —
+    including, since round 11, the trial megakernel with its in-kernel
+    neighbor ring); ``auto`` on TPU follows the same flat preference
+    order as the single-device
+    :func:`~qba_tpu.rounds.engine.resolve_round_engine` (packet-tiled
+    first everywhere since round 4, the fused per-round kernel above
+    it, the sharded trial megakernel above both where its plan is
+    admitted, XLA last), probing the LOCAL-receiver kernel variants.
+
+    A forced ``pallas_mega`` demotes loudly — the same two recorded
+    reasons as the single-device :func:`~qba_tpu.rounds.engine
+    ._demote_mega` — when counters need the host round scan or the
+    sharded plan (:func:`~qba_tpu.ops.round_kernel_tiled
+    .sharded_mega_plan`) is refused; and ``mega_gen='gf2'`` records a
+    generation demotion to the host sampler (the sharded megakernel
+    has no gen-fused prologue — the global gen operands would have to
+    replicate into every shard's VMEM next to the assembled pool).
     """
+    from qba_tpu.ops.round_kernel_tiled import sharded_mega_plan
+
+    n_tp = cfg.n_lieutenants // n_local
     if cfg.round_engine in ("pallas", "pallas_tiled", "pallas_fused"):
         return cfg.round_engine
     if cfg.round_engine == "pallas_mega":
-        # The megakernel's in-kernel round loop would need a per-round
-        # tp all-gather of the party-sharded vi/mailbox state INSIDE
-        # one launch — no party-sharded variant exists; the fused
-        # per-round kernel is its demotion target here too.
-        warn_and_record(
-            "trial megakernel has no party-sharded variant; demoting "
-            "to the fused per-round engine under the tp mesh",
-            QBADemotionWarning,
-            site="parallel.spmd._resolve_spmd_engine",
-            stacklevel=3,
-            engine_from="pallas_mega",
-            engine_to="pallas_fused",
-            reason="no_party_sharded_megakernel",
-        )
-        return "pallas_fused"
+        if cfg.collect_counters:
+            warn_and_record(
+                "trial megakernel has no host round scan for the "
+                "counters wrapper to instrument; collect_counters "
+                "demotes to the fused per-round engine under the tp "
+                "mesh (bit-identical counters)",
+                QBADemotionWarning,
+                site="parallel.spmd._resolve_spmd_engine",
+                stacklevel=3,
+                engine_from="pallas_mega",
+                engine_to="pallas_fused",
+                reason="counters_need_host_scan",
+            )
+            return "pallas_fused"
+        if sharded_mega_plan(cfg, n_tp) is None:
+            warn_and_record(
+                "party-sharded trial megakernel unavailable at "
+                f"(n_parties={cfg.n_parties}, size_l={cfg.size_l}, "
+                f"slots={cfg.slots}, tp={n_tp}); demoting to the "
+                "fused per-round engine under the tp mesh",
+                QBADemotionWarning,
+                site="parallel.spmd._resolve_spmd_engine",
+                stacklevel=3,
+                engine_from="pallas_mega",
+                engine_to="pallas_fused",
+                reason="no_sharded_mega_plan",
+                n_parties=cfg.n_parties,
+                size_l=cfg.size_l,
+                slots=cfg.slots,
+                n_tp=n_tp,
+            )
+            return "pallas_fused"
+        if cfg.mega_gen == "gf2":
+            warn_and_record(
+                "mega_gen='gf2' has no party-sharded gen-fused "
+                "prologue; step-1 generation stays on the host under "
+                "the tp mesh (the sharded megakernel itself still "
+                "runs)",
+                QBADemotionWarning,
+                site="parallel.spmd._resolve_spmd_engine",
+                stacklevel=3,
+                engine_from="pallas_mega+gen",
+                engine_to="pallas_mega",
+                reason="no_sharded_gen_fused",
+            )
+        return "pallas_mega"
     if cfg.round_engine != "auto" or jax.default_backend() != "tpu":
         return "xla"
     from qba_tpu.ops.round_kernel import kernel_compiles
@@ -660,6 +758,10 @@ def _resolve_spmd_engine(cfg: QBAConfig, n_local: int) -> str:
 
     if tiled_kernel_plan(cfg, n_recv=n_local) is not None:
         if fused_kernel_plan(cfg, n_recv=n_local) is not None:
+            if not cfg.collect_counters and (
+                sharded_mega_plan(cfg, n_tp) is not None
+            ):
+                return "pallas_mega"
             return "pallas_fused"
         return "pallas_tiled"
     if kernel_compiles(cfg, n_recv=n_local):

@@ -133,6 +133,110 @@ def generate_lists_dense(cfg: QBAConfig, key: jax.Array, impl: str = "xla"):
     return lists, qcorr
 
 
+def stabilizer_gen_tables(cfg: QBAConfig):
+    """Static packed tableaux of both protocol circuit families —
+    the compile-time half of the megakernel's in-VMEM generation.
+
+    Returns ``(x0w_q, z0w_q, x0w_nq, z0w_nq)``, each a numpy
+    ``[2*total, W]`` uint32 array: the evolved symplectic rows of the
+    Q-correlated / not-Q-correlated circuits, packed exactly as
+    :func:`qba_tpu.gf2.symplectic.build_gf2_sample_core` packs them.
+    Pure host numpy per config shape; the megakernel takes them as
+    VMEM inputs and broadcasts per shot.
+    """
+    import numpy as np
+
+    from qba_tpu.gf2.bitops import pack_bits
+    from qba_tpu.gf2.symplectic import compile_symplectic
+
+    n, nq = cfg.n_parties, cfg.n_qubits
+    total = (n + 1) * nq
+    circ_q = gen_q_corr_circuit(n, nq)
+    circ_nq = gen_nq_corr_circuit(n, nq)
+    prog_q = compile_symplectic(total, tuple(circ_q.ops), circ_q.n_params)
+    prog_nq = compile_symplectic(total, tuple(circ_nq.ops), 0)
+    # The tables are config-constant: force eager packing so tracing a
+    # gen-fused trial (launch/effects audits run under make_jaxpr) does
+    # not turn these kernel-build-time constants into tracers.
+    with jax.ensure_compile_time_eval():
+        return tuple(
+            np.asarray(pack_bits(jnp.asarray(m)))
+            for m in (prog_q.x, prog_q.z, prog_nq.x, prog_nq.z)
+        )
+
+
+def stabilizer_gen_operands(cfg: QBAConfig, key: jax.Array):
+    """Per-trial generation operands for the megakernel's in-VMEM
+    GF(2) sweep — everything of :func:`generate_lists_stabilizer`
+    EXCEPT the measurement sweep and the decode, under the *identical*
+    key tree, so the in-kernel sweep (sharing
+    :func:`~qba_tpu.gf2.symplectic.gf2_measure_sweep`) reproduces the
+    host path bit for bit.
+
+    ``key`` is the SAME ``k_lists`` subkey ``setup_trial`` feeds
+    ``generate_lists_for``.  Returns ``(qcorr, coins, r_q, r_nq,
+    mflip)``:
+
+    * ``qcorr``  bool ``[size_l]`` — the position-correlation mask;
+    * ``coins``  int32 ``[size_l, total]`` — the measurement coins
+      (``_draw_coins`` off the per-position meas keys, shared by both
+      branches exactly as the host path shares them);
+    * ``r_q``    int32 ``[size_l, 2*total]`` — Q-correlated phases:
+      ``r0 ^ params @ L^T`` (the permutation encoding) with any
+      depolarizing phase parity already folded in;
+    * ``r_nq``   int32 ``[size_l, 2*total]`` — not-Q-correlated
+      phases, noise likewise folded;
+    * ``mflip``  int32 ``[size_l, total]`` — readout flips (all
+      zeros when noiseless; both branches share the draw, so the
+      post-sweep XOR commutes with the qcorr select).
+
+    Noise uses :func:`qba_tpu.qsim.noise.noise_draws` off the same
+    meas keys as the host path; the sweep itself stays PRNG-free.
+    """
+    from qba_tpu.gf2.linalg import gf2_matmul
+    from qba_tpu.gf2.symplectic import _draw_coins, compile_symplectic
+
+    n, nq = cfg.n_parties, cfg.n_qubits
+    total = (n + 1) * nq
+    circ_q = gen_q_corr_circuit(n, nq)
+    circ_nq = gen_nq_corr_circuit(n, nq)
+    prog_q = compile_symplectic(total, tuple(circ_q.ops), circ_q.n_params)
+    prog_nq = compile_symplectic(total, tuple(circ_nq.ops), 0)
+    r0_q = jnp.asarray(prog_q.r, jnp.int32)    # [2T]
+    r0_nq = jnp.asarray(prog_nq.r, jnp.int32)
+    lt_q = jnp.asarray(prog_q.l.T, jnp.int32)  # [P, 2T]
+
+    k_qcorr, k_perm, k_meas = jax.random.split(key, 3)
+    qcorr = jax.random.bernoulli(k_qcorr, 0.5, (cfg.size_l,))
+
+    perm_keys = jax.random.split(k_perm, cfg.size_l)
+    meas_keys = jax.random.split(k_meas, cfg.size_l)
+    perms = jax.vmap(
+        lambda k: jax.random.permutation(k, jnp.arange(1, n + 1, dtype=jnp.int32))
+    )(perm_keys)
+    params = jax.vmap(_perm_bits, in_axes=(0, None))(perms, nq)
+    coins = _draw_coins(meas_keys, total)      # [size_l, T]
+
+    b = cfg.size_l
+    r_q = r0_q[None, :] ^ gf2_matmul(params & 1, lt_q)  # [size_l, 2T]
+    r_nq = jnp.broadcast_to(r0_nq[None, :], (b, 2 * total))
+    noisy = cfg.p_depolarize > 0.0 or cfg.p_measure_flip > 0.0
+    if not noisy:
+        return qcorr, coins, r_q, r_nq, jnp.zeros((b, total), jnp.int32)
+    from qba_tpu.qsim.noise import noise_draws
+
+    bx, bz, mflip = jax.vmap(
+        lambda k: noise_draws(k, total, cfg.p_depolarize, cfg.p_measure_flip)
+    )(meas_keys)
+    noise_q = gf2_matmul(bx, jnp.asarray(prog_q.z.T, jnp.int32)) ^ gf2_matmul(
+        bz, jnp.asarray(prog_q.x.T, jnp.int32)
+    )
+    noise_nq = gf2_matmul(bx, jnp.asarray(prog_nq.z.T, jnp.int32)) ^ gf2_matmul(
+        bz, jnp.asarray(prog_nq.x.T, jnp.int32)
+    )
+    return qcorr, coins, r_q ^ noise_q, r_nq ^ noise_nq, mflip
+
+
 def generate_lists_stabilizer(cfg: QBAConfig, key: jax.Array):
     """``generacionListas`` on the batched GF(2) symplectic engine — the
     primary resource path at reference scale (ROADMAP item 5).

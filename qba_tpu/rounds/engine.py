@@ -1046,6 +1046,55 @@ def _demote_mega(cfg: QBAConfig) -> str | None:
     return None
 
 
+def _resolve_mega_gen_recorded(cfg: QBAConfig, trial_pack: int = 1) -> str:
+    """:func:`~qba_tpu.ops.round_kernel_tiled.resolve_mega_gen` with
+    the demotion discipline applied: a FORCED ``mega_gen='gf2'`` the
+    planner cannot honor records a :class:`QBADemotionWarning` (the
+    megakernel itself still runs — generation falls back to the host
+    sampler, bit-identical by the shared-sweep construction).  ``auto``
+    resolving to host is a plan, not a demotion, and stays silent."""
+    from qba_tpu.ops.round_kernel_tiled import resolve_mega_gen
+
+    mode = resolve_mega_gen(cfg, trial_pack)
+    if mode == "host" and cfg.mega_gen == "gf2":
+        # Config validation already pins qsim_path == "stabilizer" for
+        # a forced gf2, so the only refusal left is a missing plan.
+        reason = "gen_fused_plan_refused"
+        warn_and_record(
+            "mega_gen='gf2' forced but the gen-fused megakernel plan "
+            f"is unavailable at (n_parties={cfg.n_parties}, "
+            f"size_l={cfg.size_l}, total_qubits={cfg.total_qubits}); "
+            "demoting step-1 generation to the host sampler (the trial"
+            " megakernel itself still runs)",
+            QBADemotionWarning,
+            site="rounds.engine.run_trial",
+            stacklevel=3,
+            engine_from="pallas_mega+gen",
+            engine_to="pallas_mega",
+            reason=reason,
+            n_parties=cfg.n_parties,
+            size_l=cfg.size_l,
+            total_qubits=cfg.total_qubits,
+        )
+    return mode
+
+
+def _mega_gen_setup(cfg: QBAConfig, key: jax.Array):
+    """Pre-kernel phases of a gen-fused trial: the same key tree as
+    :func:`setup_trial` (``k_dis, k_lists, k_comm, k_rounds``), but
+    ``k_lists`` feeds :func:`stabilizer_gen_operands` — the sampler's
+    host-side draws — instead of materializing the lists themselves.
+    The tableau sweep and list decode then run inside the megakernel's
+    VMEM prologue, bit-identically (shared ``gf2_measure_sweep``)."""
+    from qba_tpu.qsim.protocol_circuits import stabilizer_gen_operands
+
+    k_dis, k_lists, k_comm, k_rounds = jax.random.split(key, 4)
+    honest = assign_dishonest(cfg, k_dis)
+    gen_ops = stabilizer_gen_operands(cfg, k_lists)
+    v_sent, v_comm = commander_orders(cfg, k_comm, honest[1])
+    return honest, gen_ops, v_sent, v_comm, k_rounds
+
+
 def _stacked_draws(cfg: QBAConfig, k_rounds, ctx):
     """All rounds' attack draws, stacked round-major
     (``[n_rounds, n_pool, n_rv]`` int32 each) for the in-kernel loop.
@@ -1086,26 +1135,44 @@ def run_trial_mega(
     )
     from qba_tpu.ops.trial_megakernel import build_trial_megakernel
 
-    honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = setup_trial(
-        cfg, key, hints
-    )
     variant = resolve_verdict_variant(cfg)
-    blk_d, blk_v = resolve_mega_block(cfg)
-    mega = build_trial_megakernel(
-        cfg, blk_d, blk_v,
-        interpret=jax.default_backend() != "tpu", variant=variant,
-    )
-    ctx = adversary_ctx(cfg, k_rounds, v_sent)
-    att, rv, late = _stacked_draws(cfg, k_rounds, ctx)
-    li_arg = (
-        make_verdict_tables(cfg, lieu_lists)
-        if variant == "allrecv"
-        else lieu_lists
-    )
-    vi_i32, dec, overflow = mega(
-        p_rows, lieu_lists, li_arg, v_sent,
-        honest_cells_fn(honest, cfg), att, rv, late,
-    )
+    gen = _resolve_mega_gen_recorded(cfg) == "gf2"
+    if gen:
+        honest, gen_ops, v_sent, v_comm, k_rounds = _mega_gen_setup(
+            cfg, key
+        )
+        blk_d, blk_v = resolve_mega_block(cfg)
+        mega = build_trial_megakernel(
+            cfg, blk_d, blk_v,
+            interpret=jax.default_backend() != "tpu", variant=variant,
+            gen=True,
+        )
+        ctx = adversary_ctx(cfg, k_rounds, v_sent)
+        att, rv, late = _stacked_draws(cfg, k_rounds, ctx)
+        vi_i32, dec, overflow = mega(
+            gen_ops, v_sent, honest_cells_fn(honest, cfg),
+            att, rv, late,
+        )
+    else:
+        honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = (
+            setup_trial(cfg, key, hints)
+        )
+        blk_d, blk_v = resolve_mega_block(cfg)
+        mega = build_trial_megakernel(
+            cfg, blk_d, blk_v,
+            interpret=jax.default_backend() != "tpu", variant=variant,
+        )
+        ctx = adversary_ctx(cfg, k_rounds, v_sent)
+        att, rv, late = _stacked_draws(cfg, k_rounds, ctx)
+        li_arg = (
+            make_verdict_tables(cfg, lieu_lists)
+            if variant == "allrecv"
+            else lieu_lists
+        )
+        vi_i32, dec, overflow = mega(
+            p_rows, lieu_lists, li_arg, v_sent,
+            honest_cells_fn(honest, cfg), att, rv, late,
+        )
     # The kernel's exit reduce IS decide_order's lieutenant branch
     # (masked min over accepted values, w when empty), so the finish
     # pass needs no vmapped reduce of its own.
@@ -1141,48 +1208,82 @@ def run_trials_mega_packed(cfg: QBAConfig, keys, pack: int):
     plan = resolve_mega_block(cfg, trial_pack=pack)
     if cfg.collect_counters or plan is None or pack < 2:
         return jax.vmap(lambda k: run_trial(cfg, k))(keys)
+    gen = _resolve_mega_gen_recorded(cfg, trial_pack=pack) == "gf2"
     mega = build_trial_megakernel(
         cfg, *plan, interpret=jax.default_backend() != "tpu",
-        variant=variant, trial_pack=pack,
+        variant=variant, trial_pack=pack, gen=gen,
     )
     n_groups = keys.shape[0] // pack
 
-    def setup_one(key):
-        honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = (
-            setup_trial(cfg, key, None)
-        )
-        li_arg = (
-            make_verdict_tables(cfg, lieu_lists)
-            if variant == "allrecv"
-            else lieu_lists
-        )
-        ctx = adversary_ctx(cfg, k_rounds, v_sent)
-        att, rv, late = _stacked_draws(cfg, k_rounds, ctx)
-        return (
-            honest, lieu_lists, li_arg, p_rows, v_sent, v_comm,
-            honest_cells_fn(honest, cfg), att, rv, late,
-        )
+    if gen:
 
-    (honest_t, li_t, li_arg_t, p_t, v_sent_t, v_comm_t, hc_t,
-     att_t, rv_t, late_t) = jax.vmap(setup_one)(keys)
+        def setup_one(key):
+            honest, gen_ops, v_sent, v_comm, k_rounds = (
+                _mega_gen_setup(cfg, key)
+            )
+            ctx = adversary_ctx(cfg, k_rounds, v_sent)
+            att, rv, late = _stacked_draws(cfg, k_rounds, ctx)
+            return (
+                honest, gen_ops, v_sent, v_comm,
+                honest_cells_fn(honest, cfg), att, rv, late,
+            )
+    else:
+
+        def setup_one(key):
+            honest, lieu_lists, p_rows, v_sent, v_comm, k_rounds = (
+                setup_trial(cfg, key, None)
+            )
+            li_arg = (
+                make_verdict_tables(cfg, lieu_lists)
+                if variant == "allrecv"
+                else lieu_lists
+            )
+            ctx = adversary_ctx(cfg, k_rounds, v_sent)
+            att, rv, late = _stacked_draws(cfg, k_rounds, ctx)
+            return (
+                honest, lieu_lists, li_arg, p_rows, v_sent, v_comm,
+                honest_cells_fn(honest, cfg), att, rv, late,
+            )
 
     def group(x):  # [trials, ...] -> [n_groups, pack, ...]
         return jax.tree_util.tree_map(
             lambda a: a.reshape((n_groups, pack) + a.shape[1:]), x
         )
 
-    def run_group(p_k, li_k, li_arg_k, v_k, hc_k, att_k, rv_k, late_k):
+    def stack_rounds(att_k, rv_k, late_k):
         # The kernel's packed draw layout is round-major:
         # [n_rounds, k, n_pool, n_rv].
-        att_k, rv_k, late_k = (
+        return (
             jnp.moveaxis(a, 0, 1) for a in (att_k, rv_k, late_k)
         )
-        return mega(p_k, li_k, li_arg_k, v_k, hc_k, att_k, rv_k, late_k)
 
-    vi_g, dec_g, ovf_g = jax.vmap(run_group)(
-        group(p_t), group(li_t), group(li_arg_t), group(v_sent_t),
-        group(hc_t), group(att_t), group(rv_t), group(late_t),
-    )
+    if gen:
+        (honest_t, gen_ops_t, v_sent_t, v_comm_t, hc_t,
+         att_t, rv_t, late_t) = jax.vmap(setup_one)(keys)
+
+        def run_group(gen_ops_k, v_k, hc_k, att_k, rv_k, late_k):
+            att_k, rv_k, late_k = stack_rounds(att_k, rv_k, late_k)
+            return mega(gen_ops_k, v_k, hc_k, att_k, rv_k, late_k)
+
+        vi_g, dec_g, ovf_g = jax.vmap(run_group)(
+            group(gen_ops_t), group(v_sent_t), group(hc_t),
+            group(att_t), group(rv_t), group(late_t),
+        )
+    else:
+        (honest_t, li_t, li_arg_t, p_t, v_sent_t, v_comm_t, hc_t,
+         att_t, rv_t, late_t) = jax.vmap(setup_one)(keys)
+
+        def run_group(p_k, li_k, li_arg_k, v_k, hc_k, att_k, rv_k,
+                      late_k):
+            att_k, rv_k, late_k = stack_rounds(att_k, rv_k, late_k)
+            return mega(
+                p_k, li_k, li_arg_k, v_k, hc_k, att_k, rv_k, late_k
+            )
+
+        vi_g, dec_g, ovf_g = jax.vmap(run_group)(
+            group(p_t), group(li_t), group(li_arg_t), group(v_sent_t),
+            group(hc_t), group(att_t), group(rv_t), group(late_t),
+        )
     n = keys.shape[0]
     vi_flat = vi_g.reshape((n,) + vi_g.shape[2:])
     dec_flat = dec_g.reshape((n,) + dec_g.shape[2:])

@@ -1,0 +1,35 @@
+"""Seeded KI-5 violation: step-1 generation leaked back out of the
+one launch.
+
+The round-11 contract is machine-checked, not asserted: when
+``mega_gen`` resolves ``"gf2"`` the trial jaxpr must carry ZERO
+host-side ``scan``s outside the single ``pallas_call`` (host
+generation necessarily traces its two GF(2) measurement sweeps as
+scans).  This fixture pairs a gf2-resolving config with the HOST-gen
+trace of the same shape — exactly what a regressed dispatch would
+produce if the gen-fused prologue silently fell back to the host
+sampler while the resolver still claimed ``"gf2"``.  The
+``mega-gen-in-kernel`` pin must flag it.
+"""
+
+import dataclasses
+
+from qba_tpu.config import QBAConfig
+
+
+def leaky_config() -> QBAConfig:
+    """The headline stabilizer shape, forced gen-fused — a shape
+    where the gf2 plan IS admitted, so the pin is armed."""
+    return QBAConfig(
+        n_parties=11, size_l=64, n_dishonest=3,
+        qsim_path="stabilizer", mega_gen="gf2",
+    )
+
+
+def leaky_trace():
+    """The megakernel trial jaxpr with generation ON THE HOST — the
+    measurement sweeps ride as host-side scans next to the launch."""
+    from qba_tpu.analysis.launches import _trace_trial
+
+    cfg = dataclasses.replace(leaky_config(), mega_gen="host")
+    return _trace_trial(cfg, "pallas_mega")

@@ -1,0 +1,25 @@
+"""Seeded KI-5 violation: the neighbor-ring hop schedule drifted.
+
+``check_spmd_launches`` pins the party-sharded transport by counting
+``ppermute`` hops in the traced device program: the ring trace must
+carry exactly ``leaves x n_rounds x (tp - 1)`` hops — that counted
+schedule is what closes the TPU in-kernel remote-DMA model for the
+sharded megakernel (the hops it cannot trace off-TPU).  This fixture
+wraps the spmd dispatch so a request for ``comms="ring"`` silently
+runs the broadcast ``all_gather`` transport instead — zero hops where
+the schedule demands a full ring — the exact regression (a transport
+swap that nobody re-priced) the pin exists to catch.
+"""
+
+
+def silent_allgather_spmd_batch(real_spmd_batch):
+    """Wrap ``_spmd_batch`` to ignore the requested transport and
+    always gather by broadcast: the ring trace then carries 0
+    ``ppermute`` hops and the schedule pin must fire."""
+
+    def wrapped(cfg, mesh, keys, engine, check_vma, comms):
+        return real_spmd_batch(
+            cfg, mesh, keys, engine, check_vma, "all_gather"
+        )
+
+    return wrapped

@@ -324,6 +324,43 @@ def test_fixture_oversharded_budget():
 
 
 # ---------------------------------------------------------------------------
+# KI-5 round-11 fixtures: generation leaking back out of the one
+# launch, and a drifted neighbor-ring hop schedule.
+
+
+def test_fixture_mega_gen_leak_flagged():
+    from qba_tpu.analysis.launches import _pin_mega_gen_in_kernel
+    from tests.analysis_fixtures import bad_mega_gen_leak as bgl
+
+    report = Report()
+    _pin_mega_gen_in_kernel(bgl.leaky_config(), bgl.leaky_trace(), report)
+    assert ("KI-5", "mega-gen-in-kernel") in {
+        (f.ki, f.check) for f in report.findings
+    }
+    assert report.stats["mega_gen_host_scans"] > 0
+
+
+def test_fixture_ring_schedule_drift_flagged(monkeypatch):
+    import qba_tpu.parallel.spmd as spmd_mod
+    from qba_tpu.analysis.launches import check_spmd_launches
+    from tests.analysis_fixtures import bad_ring_schedule as brs
+
+    monkeypatch.setattr(
+        spmd_mod, "_spmd_batch",
+        brs.silent_allgather_spmd_batch(spmd_mod._spmd_batch),
+    )
+    cfg = QBAConfig(
+        n_parties=9, size_l=16, n_dishonest=2,
+        round_engine="pallas_mega",
+    )
+    report = check_spmd_launches(cfg, {"pallas_mega"}, tp=2)
+    assert ("KI-5", "spmd-launches") in {
+        (f.ki, f.check) for f in report.findings
+    }
+    assert any("ring schedule" in f.message for f in report.findings)
+
+
+# ---------------------------------------------------------------------------
 # Per-config entry + CLI.
 
 

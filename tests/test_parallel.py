@@ -421,6 +421,147 @@ class TestRingComms:
         assert_trials_equal(spmd, run_trials(cfg))
 
 
+class TestShardedMega:
+    """Round 11 tentpole (b): the party-sharded trial megakernel.  On
+    TPU its neighbor ring runs INSIDE the one launch as double-buffered
+    remote DMAs; off-TPU (this mesh) the spmd dispatch runs the fused
+    transport twin over the identical hop schedule, so equality here
+    pins the semantics and :mod:`qba_tpu.analysis.launches` pins the
+    in-kernel schedule.  Placement, never semantics: forced
+    ``pallas_mega`` under tp must match the single-device megakernel
+    and the all_gather escape hatch bit for bit."""
+
+    def _mega_triple(self, cfg, tp, n_devices):
+        """spmd(mega, ring) == spmd(mega, all_gather) == single-device
+        mega — with NO demotion recorded on the spmd path."""
+        import dataclasses
+        import warnings as _warnings
+
+        from qba_tpu.diagnostics import QBADemotionWarning
+
+        if n_devices < tp:
+            pytest.skip(f"needs >= {tp} devices")
+        mcfg = dataclasses.replace(cfg, round_engine="pallas_mega")
+        mesh = make_mesh({"dp": n_devices // tp, "tp": tp})
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            ring = run_trials_spmd(mcfg, mesh)
+            ag = run_trials_spmd(
+                dataclasses.replace(mcfg, tp_comms="all_gather"), mesh
+            )
+        assert not any(
+            issubclass(w.category, QBADemotionWarning) for w in caught
+        ), [str(w.message) for w in caught]
+        assert_trials_equal(ring, ag)
+        assert_trials_equal(ring, run_trials(mcfg))
+
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_sharded_mega_matches_single_device_17p(self, n_devices, tp):
+        cfg = QBAConfig(
+            n_parties=17, size_l=8, n_dishonest=4, trials=4, seed=41
+        )
+        self._mega_triple(cfg, tp, n_devices)
+
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_sharded_mega_matches_single_device_9p(self, n_devices, tp):
+        cfg = QBAConfig(
+            n_parties=9, size_l=16, n_dishonest=2, trials=4, seed=42
+        )
+        self._mega_triple(cfg, tp, n_devices)
+
+    def test_sharded_mega_split_strategy(self, n_devices):
+        cfg = QBAConfig(
+            n_parties=17, size_l=8, n_dishonest=4, trials=4, seed=43,
+            strategy="split",
+        )
+        self._mega_triple(cfg, 4, n_devices)
+
+    def test_sharded_mega_with_noise(self, n_devices):
+        cfg = QBAConfig(
+            n_parties=17, size_l=8, n_dishonest=4, trials=4, seed=44,
+            p_depolarize=0.05, p_measure_flip=0.02,
+        )
+        self._mega_triple(cfg, 2, n_devices)
+
+    def test_sharded_mega_counters_demote_recorded(self, n_devices):
+        # The megakernel has no host round scan for the counters
+        # wrapper under tp either — a forced mega with counters must
+        # RECORD its demotion to the fused engine and stay
+        # bit-identical (the same contract as single-device).
+        import dataclasses
+        import warnings as _warnings
+
+        from qba_tpu.diagnostics import QBADemotionWarning
+
+        cfg = QBAConfig(
+            n_parties=9, size_l=16, n_dishonest=2, trials=4, seed=45,
+            collect_counters=True, round_engine="pallas_mega",
+        )
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        with pytest.warns(QBADemotionWarning, match="counters"):
+            spmd = run_trials_spmd(cfg, mesh)
+        fused = dataclasses.replace(cfg, round_engine="pallas_fused")
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            ref = run_trials(fused)
+        assert_trials_equal(spmd, ref)
+
+    def test_sharded_mega_gen_stays_on_host_bit_identical(self, n_devices):
+        # mega_gen='gf2' under tp records a generation demotion (no
+        # party-sharded gen-fused prologue) but the sharded megakernel
+        # still runs — and, generation being bit-identical by
+        # construction, it must match the single-device GEN-FUSED
+        # megakernel exactly.
+        import dataclasses
+        import warnings as _warnings
+
+        from qba_tpu.diagnostics import QBADemotionWarning
+
+        cfg = QBAConfig(
+            n_parties=9, size_l=16, n_dishonest=2, trials=4, seed=46,
+            qsim_path="stabilizer", mega_gen="gf2",
+            round_engine="pallas_mega",
+        )
+        mesh = make_mesh({"dp": n_devices // 2, "tp": 2})
+        with pytest.warns(
+            QBADemotionWarning, match="gen-fused prologue"
+        ):
+            spmd = run_trials_spmd(cfg, mesh)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            ref = run_trials(cfg)  # single-device: gen fuses for real
+        assert_trials_equal(spmd, ref)
+
+    @pytest.mark.slow
+    def test_65p_sharded_mega_one_launch(self, n_devices):
+        # THE round-11 acceptance shape: the 65-party (w=128) pool
+        # that breaks the single-chip KI-2 budget completes under the
+        # party-sharded MEGAKERNEL at dp x tp = 1 x 8 — and the launch
+        # model machine-proves ONE launch per trial on TPU, ring hops
+        # and all.
+        if n_devices < 8:
+            pytest.skip("needs >= 8 devices")
+        import dataclasses
+
+        from qba_tpu.analysis.launches import spmd_launches_per_trial
+        from qba_tpu.ops.round_kernel_tiled import sharded_mega_plan
+
+        cfg = QBAConfig(
+            n_parties=65, size_l=32, n_dishonest=2, trials=2, seed=9,
+        )
+        assert sharded_mega_plan(cfg, 8) is not None
+        assert spmd_launches_per_trial(
+            cfg, "pallas_mega", "ring", 4, tpu=True
+        ) == 1
+        mcfg = dataclasses.replace(cfg, round_engine="pallas_mega")
+        mesh = make_mesh({"dp": 1, "tp": 8})
+        spmd = run_trials_spmd(mcfg, mesh)
+        ref = run_trials(
+            dataclasses.replace(cfg, round_engine="xla")
+        )
+        assert_trials_equal(spmd, ref)
+
+
 class TestMeshHelpers:
     def test_make_mesh_validates_device_count(self):
         with pytest.raises(ValueError, match="devices"):

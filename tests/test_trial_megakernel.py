@@ -193,12 +193,34 @@ class TestDemotions:
         [w] = rec.list
         assert "VMEM" in str(w.message) or "unavailable" in str(w.message)
 
-    def test_spmd_has_no_party_sharded_mega(self):
-        # The megakernel holds the WHOLE trial in one kernel's VMEM;
-        # there is no party-sharded variant, so the tp-mesh resolver
-        # must record a demotion to the fused engine.
+    def test_spmd_resolves_party_sharded_mega(self):
+        # Round 11 inverts the round-9 pin: the megakernel HAS a
+        # party-sharded variant (the in-kernel neighbor ring), so a
+        # forced mega under the tp mesh resolves to itself with no
+        # demotion wherever the sharded plan is admitted.
         from qba_tpu.parallel.spmd import _resolve_spmd_engine
 
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=1,
+            round_engine="pallas_mega",
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert (
+                _resolve_spmd_engine(cfg, cfg.n_lieutenants // 2)
+                == "pallas_mega"
+            )
+
+    def test_spmd_mega_without_plan_demotes_recorded(self, monkeypatch):
+        # When the sharded plan is refused (VMEM screen or probe), the
+        # tp-mesh resolver must still record its demotion to the fused
+        # engine — never a silent fallback.
+        from qba_tpu.ops import round_kernel_tiled as rkt
+        from qba_tpu.parallel.spmd import _resolve_spmd_engine
+
+        monkeypatch.setattr(
+            rkt, "sharded_mega_plan", lambda cfg, n_tp: None
+        )
         cfg = QBAConfig(
             n_parties=5, size_l=16, n_dishonest=1,
             round_engine="pallas_mega",
@@ -207,7 +229,7 @@ class TestDemotions:
             QBADemotionWarning, match="party-sharded"
         ):
             assert (
-                _resolve_spmd_engine(cfg, cfg.n_lieutenants)
+                _resolve_spmd_engine(cfg, cfg.n_lieutenants // 2)
                 == "pallas_fused"
             )
 
@@ -264,16 +286,244 @@ class TestLaunchModel:
         assert desc.startswith("spmd[tp=4]/")
         assert desc.endswith("/ring")
 
+        # Round 11: the sharded megakernel survives the tp mesh — the
+        # plan attributes it (and its ring) with no demotion.
         cfg_mega = dataclasses.replace(cfg, round_engine="pallas_mega")
         plan_mega = kernel_plan(cfg_mega, tp=4)
-        assert plan_mega["tp_engine"] == "pallas_fused"
-        assert plan_mega["tp_demoted_from"] == "pallas_mega"
-        assert "(from mega)" in engine_description(cfg_mega, tp=4)
+        assert plan_mega["tp_engine"] == "pallas_mega"
+        assert plan_mega["tp_demoted_from"] is None
+        desc_mega = engine_description(cfg_mega, tp=4)
+        assert "/pallas_mega/" in desc_mega
+        assert desc_mega.endswith("/ring")
+
+        # ... but counters still demote under tp, and the demotion is
+        # attributed in the plan, never silent.
+        cfg_ctr = dataclasses.replace(cfg_mega, collect_counters=True)
+        plan_ctr = kernel_plan(cfg_ctr, tp=4)
+        assert plan_ctr["tp_engine"] == "pallas_fused"
+        assert plan_ctr["tp_demoted_from"] == "pallas_mega"
+        assert "(from mega)" in engine_description(cfg_ctr, tp=4)
 
         cfg_ag = dataclasses.replace(cfg, tp_comms="all_gather")
         assert kernel_plan(cfg_ag, tp=2)["tp_comms"] == "all_gather"
         # tp=None keeps the single-device attribution unchanged.
         assert "tp" not in kernel_plan(cfg)
+
+
+def gen_triad(cfg, seed=0, n=2):
+    """Bit-identity across the generation seam: host-gen XLA, host-gen
+    fused, host-gen megakernel, and the gen-fused (in-VMEM GF(2))
+    megakernel must all agree for the same trial keys.  ``cfg`` must
+    ride the stabilizer sampler (the gen-fused prologue exists only
+    there)."""
+    assert cfg.qsim_path == "stabilizer"
+    host = dataclasses.replace(cfg, mega_gen="host")
+    gf2 = dataclasses.replace(cfg, mega_gen="gf2")
+    mega_gf2 = batch(gf2, "pallas_mega", seed, n)
+    assert_equal(batch(host, "xla", seed, n), mega_gf2)
+    assert_equal(batch(host, "pallas_fused", seed, n), mega_gf2)
+    assert_equal(batch(host, "pallas_mega", seed, n), mega_gf2)
+
+
+class TestMegaGen:
+    """Round 11 tentpole (a): step-1 generation folded into the one
+    launch.  The GF(2) sweep inside VMEM replays the HOST sampler's
+    exact bit algebra over the same packed tables and key-derived
+    draws, so equivalence is by construction — these triads prove the
+    construction held through the kernel move."""
+
+    def test_headline_gen_fused(self):
+        cfg = QBAConfig(
+            n_parties=11, size_l=64, n_dishonest=3,
+            qsim_path="stabilizer",
+        )
+        from qba_tpu.ops.round_kernel_tiled import resolve_mega_gen
+
+        assert resolve_mega_gen(
+            dataclasses.replace(cfg, mega_gen="gf2")
+        ) == "gf2"
+        gen_triad(cfg)
+
+    def test_wide_group_gen_fused(self):
+        # 33p/L8 — the second pinned shape (single chip, wide group).
+        gen_triad(
+            QBAConfig(
+                n_parties=33, size_l=8, n_dishonest=2,
+                qsim_path="stabilizer",
+            ),
+            seed=17,
+        )
+
+    def test_split_strategy_gen_fused(self):
+        gen_triad(
+            QBAConfig(
+                n_parties=11, size_l=16, n_dishonest=3,
+                strategy="split", qsim_path="stabilizer",
+            ),
+            seed=19,
+        )
+
+    def test_noisy_gen_fused(self):
+        # Depolarizing + measurement-flip noise folds into the
+        # generation draws; the in-VMEM sweep must consume the same
+        # key-derived planes as the host sampler.
+        gen_triad(
+            QBAConfig(
+                n_parties=5, size_l=16, n_dishonest=1,
+                qsim_path="stabilizer",
+                p_depolarize=0.05, p_measure_flip=0.02,
+            ),
+            seed=23,
+        )
+
+    def test_packed_matches_unpacked_gen_fused(self):
+        from qba_tpu.rounds.engine import run_trials_mega_packed
+
+        cfg = QBAConfig(
+            n_parties=11, size_l=64, n_dishonest=3,
+            qsim_path="stabilizer", mega_gen="gf2",
+            round_engine="pallas_mega",
+        )
+        keys = jax.random.split(jax.random.key(29), 4)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            packed = run_trials_mega_packed(cfg, keys, pack=2)
+            unpacked = jax.vmap(lambda k: run_trial(cfg, k))(keys)
+        assert_equal(unpacked, packed)
+
+    def test_gf2_requires_stabilizer(self):
+        with pytest.raises(ValueError, match="stabilizer"):
+            QBAConfig(
+                n_parties=5, size_l=16, n_dishonest=1,
+                qsim_path="factorized", mega_gen="gf2",
+            )
+
+    def test_forced_gf2_refused_records_demotion(self):
+        # A forced gen-fused prologue whose plan is refused must
+        # RECORD the generation demotion (host sampler, megakernel
+        # still runs) — and stay bit-identical.  The gen working set
+        # is small, so no natural shape refuses only the gen plan;
+        # pre-seed the plan memo with a refusal instead.
+        from qba_tpu.ops.round_kernel_tiled import (
+            _memo,
+            _resolve_key,
+            clear_resolve_caches,
+        )
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=1,
+            qsim_path="stabilizer", mega_gen="gf2",
+            round_engine="pallas_mega",
+        )
+        clear_resolve_caches()
+        try:
+            _memo(
+                _resolve_key("mega", cfg, None, (1, True)),
+                lambda: None,
+            )
+            keys = jax.random.split(jax.random.key(31), 2)
+            with pytest.warns(
+                QBADemotionWarning,
+                match="gen-fused megakernel plan",
+            ):
+                mega = jax.vmap(lambda k: run_trial(cfg, k))(keys)
+        finally:
+            clear_resolve_caches()
+        host = batch(
+            dataclasses.replace(cfg, mega_gen="host"),
+            "pallas_mega", 31, 2,
+        )
+        assert_equal(host, mega)
+
+    def test_spmd_gf2_stays_on_host_recorded(self):
+        # The sharded megakernel has no gen-fused prologue: a forced
+        # gf2 under the tp mesh records a generation demotion but the
+        # sharded megakernel itself still runs.
+        from qba_tpu.parallel.spmd import _resolve_spmd_engine
+
+        cfg = QBAConfig(
+            n_parties=5, size_l=16, n_dishonest=1,
+            qsim_path="stabilizer", mega_gen="gf2",
+            round_engine="pallas_mega",
+        )
+        with pytest.warns(
+            QBADemotionWarning, match="gen-fused prologue"
+        ):
+            assert (
+                _resolve_spmd_engine(cfg, cfg.n_lieutenants // 2)
+                == "pallas_mega"
+            )
+
+
+class TestGenLaunchPin:
+    """Satellite 1: machine proof that generation moved in-kernel.
+    Host generation necessarily carries its measurement sweeps as
+    host-side ``scan``s; the gen-fused trace must carry ZERO scans
+    outside the one ``pallas_call``."""
+
+    def test_gen_fused_proves_zero_host_scans(self):
+        from qba_tpu.analysis.launches import check_launches
+
+        cfg = QBAConfig(
+            n_parties=11, size_l=64, n_dishonest=3,
+            qsim_path="stabilizer", mega_gen="gf2",
+        )
+        report = check_launches(cfg, {"pallas_mega"})
+        assert report.ok
+        assert report.stats.get("mega_gen_host_scans") == 0
+        assert any("PROVEN" in n for n in report.notes)
+
+    def test_host_gen_carries_host_scans(self):
+        from qba_tpu.analysis.launches import (
+            _trace_trial,
+            count_host_scans,
+        )
+
+        cfg = QBAConfig(
+            n_parties=11, size_l=64, n_dishonest=3,
+            qsim_path="stabilizer", mega_gen="host",
+        )
+        closed = _trace_trial(cfg, "pallas_mega")
+        assert count_host_scans(closed.jaxpr) > 0
+
+    def test_effects_audit_proves_gen_in_kernel(self):
+        from qba_tpu.analysis.effects import _audit_mega
+        from qba_tpu.analysis.findings import Report
+
+        cfg = QBAConfig(
+            n_parties=11, size_l=64, n_dishonest=3,
+            qsim_path="stabilizer", mega_gen="gf2",
+        )
+        report = Report()
+        stats = {"mega_demotions_recorded": 0}
+        _audit_mega(cfg, report, stats)
+        assert not report.findings
+        assert stats["mega_gen_host_scans"] == 0
+        assert any("PROVEN" in n for n in report.notes)
+
+    def test_spmd_mega_launch_row(self):
+        from qba_tpu.analysis.launches import (
+            check_spmd_launches,
+            spmd_launches_per_trial,
+        )
+
+        cfg = QBAConfig(n_parties=9, size_l=16, n_dishonest=2)
+        # TPU model: ONE launch per trial regardless of comms — the
+        # ring hops are in-kernel remote DMAs, not launches.
+        assert spmd_launches_per_trial(
+            cfg, "pallas_mega", "ring", 4, tpu=True
+        ) == 1
+        # Off-TPU model: the fused transport twin's counts.
+        assert spmd_launches_per_trial(
+            cfg, "pallas_mega", "ring", 4, tpu=False
+        ) == cfg.n_rounds
+        report = check_spmd_launches(
+            dataclasses.replace(cfg, round_engine="pallas_mega"),
+            {"pallas_mega"}, tp=2,
+        )
+        assert report.ok
+        assert report.stats["spmd_launch_engines_checked"] == 1
+        assert any("IN-KERNEL" in n for n in report.notes)
 
 
 class TestServeWarmStart:
@@ -302,6 +552,68 @@ class TestServeWarmStart:
             clear_resolve_caches()  # simulate a fresh process
             assert import_resolver_state(state) > 0
             assert resolve_mega_block(cfg) == plan
+            assert PROBE_STATS["compile_probes"] == 0
+            assert PROBE_STATS["resolve_misses"] == 0
+            assert PROBE_STATS["resolve_hits"] > 0
+        finally:
+            clear_resolve_caches()
+
+    def test_gen_fused_plan_round_trips_zero_probe(self):
+        # Round 11: the gen-fused probe results (the "+gen" mega plan
+        # and the megagen resolution) ride the same resolver-state
+        # artifact — a warm-started serve process answers the
+        # generation question with ZERO new probes.
+        from qba_tpu.ops.round_kernel_tiled import (
+            PROBE_STATS,
+            clear_resolve_caches,
+            export_resolver_state,
+            import_resolver_state,
+            resolve_mega_block,
+            resolve_mega_gen,
+        )
+
+        cfg = QBAConfig(
+            n_parties=11, size_l=64, n_dishonest=3,
+            qsim_path="stabilizer", mega_gen="gf2",
+        )
+        clear_resolve_caches()
+        try:
+            assert resolve_mega_gen(cfg) == "gf2"
+            plan = resolve_mega_block(cfg)
+            assert plan is not None
+            state = export_resolver_state()
+            kinds = {k[0] for k, _ in state["resolve"]}
+            assert "megagen" in kinds
+            assert "mega" in kinds
+            clear_resolve_caches()  # simulate a fresh process
+            assert import_resolver_state(state) > 0
+            assert resolve_mega_gen(cfg) == "gf2"
+            assert resolve_mega_block(cfg) == plan
+            assert PROBE_STATS["compile_probes"] == 0
+            assert PROBE_STATS["resolve_misses"] == 0
+            assert PROBE_STATS["resolve_hits"] > 0
+        finally:
+            clear_resolve_caches()
+
+    def test_sharded_mega_plan_round_trips_zero_probe(self):
+        from qba_tpu.ops.round_kernel_tiled import (
+            PROBE_STATS,
+            clear_resolve_caches,
+            export_resolver_state,
+            import_resolver_state,
+            sharded_mega_plan,
+        )
+
+        cfg = QBAConfig(n_parties=9, size_l=16, n_dishonest=2)
+        clear_resolve_caches()
+        try:
+            plan = sharded_mega_plan(cfg, 2)
+            assert plan is not None
+            state = export_resolver_state()
+            assert any(k[0] == "megash" for k, _ in state["resolve"])
+            clear_resolve_caches()  # simulate a fresh process
+            assert import_resolver_state(state) > 0
+            assert sharded_mega_plan(cfg, 2) == plan
             assert PROBE_STATS["compile_probes"] == 0
             assert PROBE_STATS["resolve_misses"] == 0
             assert PROBE_STATS["resolve_hits"] > 0
