@@ -8,8 +8,10 @@ KI-3 exact-dot (:mod:`qba_tpu.analysis.dots`), KI-1 vma-threading
 per-device budgets (:mod:`qba_tpu.analysis.memory`), and, with
 ``effects=True`` (CLI ``--effects``), KI-5 donation/aliasing
 (:mod:`qba_tpu.analysis.effects`) and KI-6 host-sync discipline
-(:mod:`qba_tpu.analysis.transfers`) — over a small config matrix
-chosen to cover the planner's phase space:
+(:mod:`qba_tpu.analysis.transfers`); ``protocol=True`` (CLI
+``--protocol``) adds the config-independent KI-10 file-queue
+protocol model check (:mod:`qba_tpu.analysis.protocol`) — over a
+small config matrix chosen to cover the planner's phase space:
 
 * ``cheap``       — (17, 16, 4): every engine live, fused plan resolves,
   even lieutenant count so the 2-way sharded variants trace;
@@ -139,13 +141,20 @@ def run_lint(
     configs: Sequence[tuple[str, QBAConfig]] | None = None,
     engines: Iterable[str] | None = None,
     effects: bool = False,
+    protocol: bool = False,
 ) -> Report:
     """Run every lint pass over ``configs`` (default: the built-in
     matrix) restricted to ``engines`` (default: all build paths).
     ``effects=True`` adds the KI-5 donation/aliasing audit and the
     KI-6 host-sync discipline gate (per-config jaxpr passes plus the
     sitewide AST sweep, serve dispatch proof, and jit-donation audit).
+    ``protocol=True`` adds the KI-10 file-queue protocol pass — the
+    bounded model check, conformance sweep, and admission-purity proof
+    (:mod:`qba_tpu.analysis.protocol`); it is config-independent and
+    runs once per lint.
     Returns one aggregated report; ``report.ok`` is the CI gate."""
+    from qba_tpu.analysis import tracecache
+
     if engines is not None:
         bad = set(engines) - set(ENGINE_CHOICES)
         if bad:
@@ -153,6 +162,7 @@ def run_lint(
                 f"unknown lint engine(s) {sorted(bad)}; "
                 f"choose from {ENGINE_CHOICES}"
             )
+    tracecache.reset()
     report = Report()
     sitewide = True
     for label, cfg in configs if configs is not None else lint_configs():
@@ -173,4 +183,16 @@ def run_lint(
         # single transfer-free dispatch (per-chunk readbacks eliminated,
         # not fenced) — proven from its traced jaxpr, sitewide.
         report.extend(check_device_loop())
+    if protocol:
+        from qba_tpu.analysis.protocol import check_protocol
+
+        report.extend(check_protocol())
+    cache = tracecache.stats()
+    report.stats.update(cache)
+    if cache["trace_cache_hits"]:
+        report.notes.append(
+            f"trace cache: {cache['trace_cache_hits']} hit(s) across "
+            f"{cache['trace_cache_entries']} traced (config, engine) "
+            "pair(s) — each hit is one full run_trial retrace saved"
+        )
     return report

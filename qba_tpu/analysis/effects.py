@@ -389,14 +389,13 @@ def audit_scans(closed_jaxpr, path: str = "fixture") -> Report:
 def trace_trial_scan(cfg: QBAConfig, engine: str):
     """``jax.make_jaxpr`` of one full ``run_trial`` with the round
     engine forced, so the audit sees the scan exactly as dispatch
-    builds it (plan resolution, demotions and all)."""
-    import jax
+    builds it (plan resolution, demotions and all).  Memoized per
+    (config, engine) for the lint run — the launch pins trace the
+    same paths (:mod:`qba_tpu.analysis.tracecache`)."""
+    from qba_tpu.analysis.tracecache import trial_jaxpr
 
-    from qba_tpu.rounds.engine import run_trial
-
-    ecfg = dataclasses.replace(cfg, round_engine=engine)
-    key = jax.random.key(0)
-    return jax.make_jaxpr(lambda k: run_trial(ecfg, k))(key)
+    closed, _caught = trial_jaxpr(cfg, engine)
+    return closed
 
 
 def _audit_engine_scans(cfg, engines, report, stats) -> None:
@@ -407,9 +406,7 @@ def _audit_engine_scans(cfg, engines, report, stats) -> None:
             continue
         before = dict(stats)
         try:
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")
-                closed = trace_trial_scan(cfg, engine)
+            closed = trace_trial_scan(cfg, engine)
         except Exception as exc:  # demoted/unbuildable path -> note
             report.notes.append(
                 f"effects/{engine}: scan audit skipped "
@@ -468,14 +465,11 @@ def _audit_mega(cfg, report, stats) -> None:
     demotion (no plan / counters requested) is noted — the demoted
     path is one of the :data:`SCAN_ENGINES` and gets the ordinary
     carry audit on its own trace."""
-    import jax  # noqa: F401  (trace_trial_scan uses it)
-
+    from qba_tpu.analysis.tracecache import trial_jaxpr
     from qba_tpu.diagnostics import QBADemotionWarning
 
     try:
-        with warnings.catch_warnings(record=True) as caught:
-            warnings.simplefilter("always")
-            closed = trace_trial_scan(cfg, "pallas_mega")
+        closed, caught = trial_jaxpr(cfg, "pallas_mega")
     except Exception as exc:
         report.findings.append(Finding(
             ki="KI-5", check="mega-one-launch", path="pallas_mega/run_trial",

@@ -23,6 +23,12 @@ mechanizes (``docs/KNOWN_ISSUES.md``):
 * ``KI-8`` — an uncertified rate in a run manifest: a bare numeric
   ``*_rate`` value with no accompanying confidence interval
   (:mod:`qba_tpu.analysis.manifests`, docs/STATS.md).
+* ``KI-10`` — a file-queue protocol violation: a safety invariant
+  (exactly-once settle, single executor, poison blast-radius bound,
+  release-within-one-poll, no lost request) falsified by the bounded
+  model check, an unregistered queue mutation in ``serve/``, or an
+  admission-ledger purity break
+  (:mod:`qba_tpu.analysis.protocol`).
 
 A *note* is an informational line the report carries alongside the
 findings (plan predictions, probe-counter reality checks) — notes
@@ -34,7 +40,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterable
 
-KI_TAGS = ("KI-1", "KI-2", "KI-3", "KI-5", "KI-6", "KI-8")
+KI_TAGS = ("KI-1", "KI-2", "KI-3", "KI-5", "KI-6", "KI-8", "KI-10")
 
 
 @dataclasses.dataclass(frozen=True)

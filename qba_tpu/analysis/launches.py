@@ -64,7 +64,6 @@ leaves, same hop algebra).
 
 from __future__ import annotations
 
-import dataclasses
 import warnings
 
 from qba_tpu.analysis.findings import Finding, Report
@@ -142,27 +141,17 @@ def count_host_scans(jaxpr) -> int:
 
 
 def _trace_trial(cfg: QBAConfig, engine: str | None):
-    import jax
+    from qba_tpu.analysis.tracecache import trial_jaxpr
 
-    from qba_tpu.rounds.engine import run_trial
-
-    ecfg = (
-        dataclasses.replace(cfg, round_engine=engine)
-        if engine is not None
-        else cfg
-    )
-    key = jax.random.key(0)
-    return jax.make_jaxpr(lambda k: run_trial(ecfg, k))(key)
+    closed, _caught = trial_jaxpr(cfg, engine)
+    return closed
 
 
 def launches_per_trial(cfg: QBAConfig, engine: str | None = None) -> int:
     """Kernel launches one trial dispatches, from the full
     ``run_trial`` jaxpr with the round engine forced to ``engine``
     (None = the config's own resolution, demotions and all)."""
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        closed = _trace_trial(cfg, engine)
-    return count_pallas_launches(closed.jaxpr)
+    return count_pallas_launches(_trace_trial(cfg, engine).jaxpr)
 
 
 def check_launches(cfg: QBAConfig, engines) -> Report:
@@ -171,6 +160,7 @@ def check_launches(cfg: QBAConfig, engines) -> Report:
     :class:`~qba_tpu.diagnostics.QBADemotionWarning` during the trace
     is noted, not pinned — the demoted engine is pinned under its own
     entry."""
+    from qba_tpu.analysis.tracecache import trial_jaxpr
     from qba_tpu.diagnostics import QBADemotionWarning
 
     report = Report()
@@ -179,9 +169,7 @@ def check_launches(cfg: QBAConfig, engines) -> Report:
         if engine not in engines:
             continue
         try:
-            with warnings.catch_warnings(record=True) as caught:
-                warnings.simplefilter("always")
-                closed = _trace_trial(cfg, engine)
+            closed, caught = trial_jaxpr(cfg, engine)
         except Exception as exc:
             report.notes.append(
                 f"launches/{engine}: trace failed, pin skipped "

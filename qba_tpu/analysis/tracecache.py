@@ -1,0 +1,91 @@
+"""Memoized ``run_trial`` jaxpr traces for one lint run.
+
+With ``--effects`` the lint matrix traces the same
+``jax.make_jaxpr(run_trial)`` path repeatedly: the KI-5 launch pins
+(:mod:`qba_tpu.analysis.launches`) trace every engine, then the scan
+carry audits and the megakernel one-launch proof
+(:mod:`qba_tpu.analysis.effects`) trace the SAME (config, engine)
+pairs again.  Tracing is the dominant lint cost, so
+:func:`trial_jaxpr` memoizes on the ``(QBAConfig, engine)`` key —
+``QBAConfig`` is a frozen dataclass, so the key is exact, and any
+config difference (a demotion-relevant flag, a strategy) is a
+different entry, never a stale hit.
+
+Warnings are part of the trace's meaning here: the launch pins and
+the mega audit decide "pin vs skip" by whether a
+``QBADemotionWarning`` was recorded during tracing.  The cache
+therefore captures the warning list at trace time and hands the same
+list back on every hit (callers inspect, never re-emit).  Exceptions
+are cached too — a failing trace fails identically on the retry, and
+callers note-and-skip on the first failure already.
+
+The cache is process-global but scoped by convention to one driver
+run: :func:`~qba_tpu.analysis.driver.run_lint` calls :func:`reset`
+on entry so back-to-back lints (tests, REPL) never see each other's
+traces, and reports ``stats()`` in its ``-v`` output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Any
+
+from qba_tpu.config import QBAConfig
+
+#: (cfg, engine) -> ("ok", closed_jaxpr, warnings) | ("err", exc)
+_CACHE: dict[tuple[QBAConfig, str | None], tuple] = {}
+_HITS = 0
+
+
+def trial_jaxpr(
+    cfg: QBAConfig, engine: str | None
+) -> tuple[Any, list[warnings.WarningMessage]]:
+    """The traced ``run_trial`` jaxpr for ``cfg`` with the round
+    engine forced to ``engine`` (``None`` = the config's own
+    resolution), plus the warnings the trace recorded.
+
+    Returns ``(closed_jaxpr, warning_messages)``; raises the original
+    exception (cached) when the trace fails.
+    """
+    global _HITS
+    key = (cfg, engine)
+    hit = _CACHE.get(key)
+    if hit is not None:
+        _HITS += 1
+        if hit[0] == "err":
+            raise hit[1]
+        return hit[1], hit[2]
+
+    import jax
+
+    from qba_tpu.rounds.engine import run_trial
+
+    ecfg = (
+        dataclasses.replace(cfg, round_engine=engine)
+        if engine is not None
+        else cfg
+    )
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            closed = jax.make_jaxpr(
+                lambda k: run_trial(ecfg, k)
+            )(jax.random.key(0))
+    except Exception as exc:
+        _CACHE[key] = ("err", exc)
+        raise
+    _CACHE[key] = ("ok", closed, list(caught))
+    return closed, list(caught)
+
+
+def reset() -> None:
+    """Drop every cached trace and zero the hit counter (one driver
+    run = one cache generation)."""
+    global _HITS
+    _CACHE.clear()
+    _HITS = 0
+
+
+def stats() -> dict[str, int]:
+    return {"trace_cache_entries": len(_CACHE), "trace_cache_hits": _HITS}

@@ -36,6 +36,7 @@ from typing import Sequence
 from qba_tpu.config import QBAConfig
 from qba_tpu.native import NativeUnavailableError
 from qba_tpu.obs.plots import PlottingUnavailableError
+from qba_tpu.serve import timing as _timing
 from qba_tpu.stats.estimators import success_rate as _est_success_rate
 
 
@@ -323,6 +324,15 @@ def _parser() -> argparse.ArgumentParser:
         "with lo/hi bounds, never a bare float (docs/STATS.md)",
     )
     lint.add_argument(
+        "--protocol", action="store_true",
+        help="also run the KI-10 file-queue protocol pass: bounded "
+        "model check of the fleet's claim/reclaim/poison/stop protocol "
+        "(exhaustive BFS with minimal counterexample schedules), the "
+        "serve/ conformance sweep binding every queue mutation to a "
+        "model transition, and the admission-ledger purity proof "
+        "(docs/ANALYSIS.md)",
+    )
+    lint.add_argument(
         "--findings-json", metavar="PATH", default=None,
         help="write the full report (findings, notes, stats) as JSON "
         "to PATH — the CI lint job uploads this as an artifact",
@@ -378,7 +388,7 @@ def _parser() -> argparse.ArgumentParser:
         help="exit after consuming this many requests (CI smoke)",
     )
     serve.add_argument(
-        "--poll-s", type=float, default=0.05,
+        "--poll-s", type=float, default=_timing.WORKER_POLL_S,
         help="file-queue inbox poll interval in seconds",
     )
     serve.add_argument(
@@ -388,7 +398,7 @@ def _parser() -> argparse.ArgumentParser:
         "retry; docs/SERVING.md); default: no reclaim",
     )
     serve.add_argument(
-        "--max-reclaims", type=int, default=3,
+        "--max-reclaims", type=int, default=_timing.MAX_RECLAIMS,
         help="reclaim attempts per request file before dead-lettering "
         "it to <queue-dir>/dead with an error result",
     )
@@ -460,12 +470,13 @@ def _parser() -> argparse.ArgumentParser:
         help="per-request wall-clock deadline inside each worker",
     )
     fleet.add_argument(
-        "--reclaim-timeout-s", type=float, default=5.0,
+        "--reclaim-timeout-s", type=float,
+        default=_timing.RECLAIM_TIMEOUT_S,
         help="crash recovery: claims older than this with no result "
         "are pushed back to the inbox for a surviving replica",
     )
     fleet.add_argument(
-        "--max-reclaims", type=int, default=3,
+        "--max-reclaims", type=int, default=_timing.MAX_RECLAIMS,
         help="reclaim attempts per request before dead-lettering",
     )
     fleet.add_argument(
@@ -505,9 +516,9 @@ def _parser() -> argparse.ArgumentParser:
         "(ring = the round-9 remote-DMA default)",
     )
     fleet.add_argument(
-        "--poll-s", type=float, default=0.05,
+        "--poll-s", type=float, default=_timing.WORKER_POLL_S,
         help="worker inbox poll interval (the front-end outbox poll "
-        "runs at a fixed 20ms)",
+        "runs at timing.FRONTEND_POLL_S)",
     )
     fleet.add_argument(
         "--platform", default=None,
@@ -524,30 +535,33 @@ def _parser() -> argparse.ArgumentParser:
         "with backoff (docs/SERVING.md 'Self-healing')",
     )
     fleet.add_argument(
-        "--watchdog-s", type=float, default=10.0,
+        "--watchdog-s", type=float, default=_timing.WATCHDOG_S,
         help="base heartbeat staleness budget; the compile phase gets "
-        "30x (cold XLA compiles are slow, not hung)",
+        "timing.WATCHDOG_PHASE_SCALE x (cold XLA compiles are slow, "
+        "not hung)",
     )
     fleet.add_argument(
-        "--breaker-k", type=int, default=3,
+        "--breaker-k", type=int, default=_timing.BREAKER_K,
         help="crash-loop breaker: deaths of one replica slot inside "
         "--breaker-window-s that bench it for good",
     )
     fleet.add_argument(
-        "--breaker-window-s", type=float, default=60.0,
+        "--breaker-window-s", type=float,
+        default=_timing.BREAKER_WINDOW_S,
         help="crash-loop breaker window (seconds)",
     )
     fleet.add_argument(
-        "--poison-threshold", type=int, default=2,
+        "--poison-threshold", type=int, default=_timing.POISON_THRESHOLD,
         help="worker deaths blamed on one request before it is "
         "quarantined (dead-lettered with a crash report)",
     )
     fleet.add_argument(
-        "--max-respawns", type=int, default=5,
+        "--max-respawns", type=int, default=_timing.MAX_RESPAWNS,
         help="respawns per replica slot before it is benched",
     )
     fleet.add_argument(
-        "--respawn-backoff-s", type=float, default=0.5,
+        "--respawn-backoff-s", type=float,
+        default=_timing.RESPAWN_BACKOFF_S,
         help="base exponential backoff between respawns of one slot",
     )
 
@@ -1112,6 +1126,7 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
                 configs.append((label, cfg))
     report = run_lint(
         configs=configs, engines=engines, effects=args.effects,
+        protocol=args.protocol,
     )
     if args.manifests:
         from qba_tpu.analysis.manifests import check_manifest_files
@@ -1126,6 +1141,7 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
             "schema": "qba-tpu/lint-findings/v1",
             "ok": report.ok,
             "effects": bool(args.effects),
+            "protocol": bool(args.protocol),
             "findings": [dataclasses.asdict(f) for f in report.findings],
             "notes": report.notes,
             "stats": {

@@ -33,6 +33,7 @@ from typing import Any
 from qba_tpu.serve.fleet.admission import ADMIT, DEFER, AdmissionController
 from qba_tpu.serve.queuefs import drop_request, queue_paths, result_path
 from qba_tpu.serve.request import EvalRequest, EvalResult
+from qba_tpu.serve.timing import FRONTEND_POLL_S
 
 
 class FleetFrontend:
@@ -45,7 +46,7 @@ class FleetFrontend:
         *,
         host: str = "127.0.0.1",
         port: int = 0,
-        poll_s: float = 0.02,
+        poll_s: float = FRONTEND_POLL_S,
         request_prefix: str = "fl",
         max_requests: int | None = None,
         health_provider=None,
@@ -268,6 +269,7 @@ class FleetFrontend:
                     # not deleted — fleet_summary() recomputes the
                     # client-experienced latency/queue-wait
                     # distributions from consumed/ + outbox/.
+                    # qba-protocol: consume
                     os.replace(
                         path,
                         os.path.join(

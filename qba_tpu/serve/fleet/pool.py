@@ -38,6 +38,13 @@ import time
 from typing import Any
 
 from qba_tpu.serve.queuefs import queue_paths, request_slug, write_json_atomic
+from qba_tpu.serve.timing import (
+    MAX_RECLAIMS,
+    MAX_RESPAWNS,
+    RECLAIM_TIMEOUT_S,
+    RESPAWN_BACKOFF_S,
+    WORKER_POLL_S,
+)
 
 
 def tpu_present() -> bool:
@@ -132,13 +139,13 @@ class ReplicaPool:
         cache_dir: str | None = None,
         telemetry_dir: str | None = None,
         deadline_s: float | None = None,
-        reclaim_timeout_s: float | None = 5.0,
-        max_reclaims: int = 3,
-        poll_s: float = 0.05,
+        reclaim_timeout_s: float | None = RECLAIM_TIMEOUT_S,
+        max_reclaims: int = MAX_RECLAIMS,
+        poll_s: float = WORKER_POLL_S,
         platform: str | None = None,
         python: str | None = None,
-        max_respawns: int = 5,
-        respawn_backoff_s: float = 0.5,
+        max_respawns: int = MAX_RESPAWNS,
+        respawn_backoff_s: float = RESPAWN_BACKOFF_S,
     ) -> None:
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")

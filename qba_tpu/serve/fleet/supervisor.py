@@ -55,13 +55,22 @@ from qba_tpu.serve.queuefs import (
     write_json_atomic,
 )
 from qba_tpu.serve.request import EvalResult
+from qba_tpu.serve.timing import (
+    BOOT_GRACE_SCALE,
+    BREAKER_K,
+    BREAKER_WINDOW_S,
+    POISON_THRESHOLD,
+    SUPERVISOR_POLL_S,
+    WATCHDOG_PHASE_SCALE,
+    WATCHDOG_S,
+)
 
 CRASH_LEDGER_SCHEMA = "qba-tpu/crash-ledger/v1"
 
-#: Multiplier on the base watchdog timeout per heartbeat phase.  Cold
-#: XLA compiles legitimately run orders of magnitude longer than a
-#: dispatch or readback; everything else gets the base budget.
-WATCHDOG_PHASE_SCALE = {"compile": 30.0}
+# WATCHDOG_PHASE_SCALE is re-exported from qba_tpu.serve.timing (the
+# single source for every protocol timing constant) — existing callers
+# keep importing it from here.
+__all__ = ["FleetSupervisor", "WATCHDOG_PHASE_SCALE", "CRASH_LEDGER_SCHEMA"]
 
 #: Phases during which a death is attributable to the in-flight
 #: request(s) the heartbeat names.  An ``idle`` death blames nobody.
@@ -84,10 +93,10 @@ class FleetSupervisor:
         pool,
         *,
         admission=None,
-        watchdog_s: float = 10.0,
-        breaker_k: int = 3,
-        breaker_window_s: float = 60.0,
-        poison_threshold: int = 2,
+        watchdog_s: float = WATCHDOG_S,
+        breaker_k: int = BREAKER_K,
+        breaker_window_s: float = BREAKER_WINDOW_S,
+        poison_threshold: int = POISON_THRESHOLD,
         boot_grace_s: float | None = None,
         clock=time.monotonic,
     ) -> None:
@@ -110,7 +119,9 @@ class FleetSupervisor:
         # Workers importing jax take seconds to boot before their first
         # beat — a fresh pid with no heartbeat yet is booting, not hung.
         self.boot_grace_s = (
-            boot_grace_s if boot_grace_s is not None else 3.0 * watchdog_s
+            boot_grace_s
+            if boot_grace_s is not None
+            else BOOT_GRACE_SCALE * watchdog_s
         )
         self._clock = clock
         self._first_seen: dict[tuple[str, int], float] = {}
@@ -207,7 +218,11 @@ class FleetSupervisor:
             "respawned": respawned,
         }
 
-    def run(self, stop_event: threading.Event, poll_s: float = 0.5) -> None:
+    def run(
+        self,
+        stop_event: threading.Event,
+        poll_s: float = SUPERVISOR_POLL_S,
+    ) -> None:
         """Poll until ``stop_event`` is set (the CLI's supervisor
         thread body)."""
         while not stop_event.is_set():
@@ -290,6 +305,7 @@ class FleetSupervisor:
         if loc is None or loc[0] != "claimed":
             return False
         try:
+            # qba-protocol: release
             os.replace(
                 loc[1], os.path.join(self.paths["inbox"], f"{slug}.json")
             )
@@ -310,6 +326,7 @@ class FleetSupervisor:
                 pass
             try:
                 os.makedirs(self.paths["dead"], exist_ok=True)
+                # qba-protocol: quarantine
                 os.replace(
                     loc[1], os.path.join(self.paths["dead"], f"{slug}.json")
                 )

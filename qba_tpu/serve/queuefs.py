@@ -20,6 +20,8 @@ import os
 import time
 from typing import Any
 
+from qba_tpu.serve.timing import IDLE_REBEAT_S
+
 
 def queue_paths(queue_dir: str) -> dict[str, str]:
     return {
@@ -43,29 +45,40 @@ def write_json_atomic(path: str, payload: dict[str, Any]) -> None:
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1, default=str)
+    # qba-protocol: publish
     os.replace(tmp, path)
+
+
+#: Longest id that may map to itself; longer ones are truncated and
+#: hash-suffixed so two ids differing only past this point still get
+#: distinct (and filesystem-legal, NAME_MAX-safe) queue filenames.
+_SLUG_MAX = 100
 
 
 def request_slug(request_id: str) -> str:
     """Filesystem-safe **injective** slug for a request id (shared by
     result files and per-request telemetry directories).
 
-    An id that is already filesystem-safe maps to itself; anything
-    else maps to its sanitized form plus a short hash of the raw id.
-    Injectivity matters because distinct client-supplied ids must
-    never share a queue filename — ``'a/b'`` and ``'a_b'`` colliding
-    would overwrite one request's inbox file with the other's and
-    resolve both pending futures from a single result.
+    A short id that is already filesystem-safe maps to itself;
+    anything else maps to its sanitized (and truncated) form plus a
+    short hash of the raw id.  Injectivity matters because distinct
+    client-supplied ids must never share a queue filename — ``'a/b'``
+    and ``'a_b'`` colliding would overwrite one request's inbox file
+    with the other's and resolve both pending futures from a single
+    result.  The hash suffix is joined with ``~``, a character the
+    sanitizer never passes through, so a literal id crafted to look
+    like ``<sanitized>~<digest>`` cannot collide with a hashed slug:
+    self-mapped slugs never contain ``~``, hashed ones always do.
     """
     safe = "".join(
         c if c.isalnum() or c in "-_." else "_" for c in request_id
     )
-    if safe == request_id and safe:
+    if safe == request_id and safe and len(safe) <= _SLUG_MAX:
         return safe
     digest = hashlib.sha1(
         request_id.encode("utf-8", "surrogatepass")
     ).hexdigest()[:10]
-    return f"{safe or 'request'}-{digest}"
+    return f"{safe[:_SLUG_MAX] or 'request'}~{digest}"
 
 
 def result_path(outbox: str, request_id: str) -> str:
@@ -127,7 +140,7 @@ class HeartbeatWriter:
         queue_dir: str,
         replica_id: str,
         *,
-        idle_rebeat_s: float = 1.0,
+        idle_rebeat_s: float = IDLE_REBEAT_S,
     ) -> None:
         self.path = heartbeat_path(queue_dir, replica_id)
         self.replica_id = replica_id

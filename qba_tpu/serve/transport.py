@@ -39,6 +39,7 @@ from qba_tpu.serve.queuefs import (
     write_json_atomic,
 )
 from qba_tpu.serve.request import EvalResult, decode_request_line
+from qba_tpu.serve.timing import MAX_RECLAIMS, WORKER_POLL_S
 
 #: Test-only crash hook (the chaos harness's poison-request injector):
 #: when this env var is set, a worker that claims a request whose id
@@ -148,6 +149,7 @@ def _reclaim_stale(
         k = attempts.get(name, 0)
         if k >= max_reclaims:
             try:
+                # qba-protocol: dead-letter
                 os.replace(path, os.path.join(paths["dead"], name))
             except OSError:
                 continue
@@ -162,6 +164,7 @@ def _reclaim_stale(
         if age < timeout_s * (2 ** k):
             continue
         try:
+            # qba-protocol: reclaim
             os.replace(path, os.path.join(paths["inbox"], name))
         except OSError:
             continue
@@ -174,10 +177,10 @@ def serve_file_queue(
     server: QBAServer,
     queue_dir: str,
     *,
-    poll_s: float = 0.05,
+    poll_s: float = WORKER_POLL_S,
     max_requests: int | None = None,
     reclaim_timeout_s: float | None = None,
-    max_reclaims: int = 3,
+    max_reclaims: int = MAX_RECLAIMS,
 ) -> dict[str, Any]:
     """Drive ``server`` from ``queue_dir`` until the ``stop`` sentinel
     appears (or ``max_requests`` requests have been consumed); returns
@@ -213,6 +216,7 @@ def serve_file_queue(
 
     def settle(name: str) -> None:
         try:
+            # qba-protocol: settle
             os.replace(
                 os.path.join(paths["claimed"], name),
                 os.path.join(paths["done"], name),
@@ -249,6 +253,7 @@ def serve_file_queue(
                     emit(server.flush())
                 claimed = os.path.join(paths["claimed"], name)
                 try:
+                    # qba-protocol: claim
                     os.replace(os.path.join(paths["inbox"], name), claimed)
                 except OSError:
                     continue  # another consumer claimed it
@@ -272,6 +277,7 @@ def serve_file_queue(
                 except OSError:
                     queue_wait = None
                 try:
+                    # qba-protocol: restamp
                     os.utime(claimed, (claim_t, claim_t))
                 except OSError:
                     pass  # raced away; the eventual result still wins

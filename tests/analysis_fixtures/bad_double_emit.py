@@ -1,0 +1,50 @@
+"""Seeded KI-10 violation: a reclaimer that emits on every push-back.
+
+``_reclaim_stale`` here writes a failure result to the outbox on EVERY
+reclaim, not only on the terminal dead-letter branch.  The first time
+a stale claim is pushed back to the inbox the client's future resolves
+with that failure — and when the retry then succeeds, a second result
+lands for the same request id: exactly-once settle is violated on
+every successful crash recovery.
+
+The KI-10 model checker extracts ``emit_only_at_dead_letter=False``
+from this function's AST and must kill it with a minimal schedule in
+which a reclaim's spurious emit races the retry's real one.
+
+The shipped form is ``serve/transport.py``'s ``_reclaim_stale``: the
+``emit([EvalResult.failure(...)])`` call lives only inside the
+``k >= max_reclaims`` dead-letter branch (the ``# qba-protocol:
+dead-letter`` site); an ordinary reclaim moves the file silently.
+"""
+
+import os
+import time
+
+
+def _reclaim_stale(paths, attempts, live, timeout_s, max_reclaims, emit, failure):
+    """Bad reclaimer: every reclaim also resolves the client future."""
+    reclaimed = 0
+    now = time.time()
+    names = sorted(
+        n for n in os.listdir(paths["claimed"]) if n.endswith(".json")
+    )
+    for name in names:
+        if name in live:
+            continue
+        path = os.path.join(paths["claimed"], name)
+        age = now - os.path.getmtime(path)
+        k = attempts.get(name, 0)
+        if k >= max_reclaims:
+            os.replace(path, os.path.join(paths["dead"], name))
+            emit([failure(name, f"dead-lettered after {k} reclaims")])
+            continue
+        if age < timeout_s * (2 ** k):
+            continue
+        os.replace(path, os.path.join(paths["inbox"], name))
+        # BUG: an ordinary push-back must be silent — the retry is
+        # still in flight.  Emitting here resolves the client future
+        # with a failure that the retry's real result then duplicates.
+        emit([failure(name, f"reclaimed (attempt {k + 1})")])
+        attempts[name] = k + 1
+        reclaimed += 1
+    return reclaimed

@@ -1,0 +1,53 @@
+"""Seeded KI-10 violation: the pre-PR-12 reclaim double-execution race.
+
+``serve_file_queue`` here claims with the atomic rename but NEVER
+re-stamps the claim file's mtime — so claim staleness is measured from
+the producer's *enqueue* time, exactly the shipped behavior before the
+claim-instant ``os.utime`` fix.  A request that waited in the inbox
+longer than the reclaim timeout looks stale the moment it is claimed:
+a peer replica's reclaimer steals it from its live claimant, a second
+worker claims and executes it concurrently, and the client can see two
+results for one request id.
+
+The KI-10 model checker extracts ``restamp_on_claim=False`` from this
+function's AST, re-runs the same bounded scenarios, and must print the
+minimal interleaving schedule (enqueue, age-in-inbox, claim, steal,
+re-claim) that falsifies the single-executor and exactly-once-settle
+invariants.
+
+The shipped form is ``serve/transport.py``'s ``serve_file_queue``: the
+same loop with ``os.utime(claimed, (claim_t, claim_t))`` right after
+the claim rename (the ``# qba-protocol: restamp`` site).
+"""
+
+import os
+import time
+
+
+def serve_file_queue(server, paths, emit, decode_request_line, poll_s):
+    """Pre-fix claim loop: rename-only claim, no mtime re-stamp."""
+    claim_of = {}
+    while True:
+        names = sorted(
+            n for n in os.listdir(paths["inbox"]) if n.endswith(".json")
+        )
+        for name in names:
+            claimed = os.path.join(paths["claimed"], name)
+            try:
+                os.replace(os.path.join(paths["inbox"], name), claimed)
+            except OSError:
+                continue  # another consumer claimed it
+            # BUG: no os.utime here — the claim file keeps the
+            # producer's enqueue-time mtime, so inbox wait counts
+            # toward claim staleness and a backlogged request is
+            # reclaimable the instant it is claimed.
+            with open(claimed) as f:
+                req = decode_request_line(f.read())
+            server.submit(req)
+            claim_of[req.request_id] = name
+            emit(server.pump())
+        if os.path.exists(paths["stop"]):
+            emit(server.flush())
+            return claim_of
+        if not names:
+            time.sleep(poll_s)

@@ -495,6 +495,85 @@ def test_request_slug_is_injective_and_filesystem_safe():
     assert request_slug("") != request_slug("request")
 
 
+def test_request_slug_injectivity_fuzz():
+    # Adversarial id pool: case pairs, sanitizer collisions, crafted
+    # hash-suffix lookalikes, truncation-length tails, unicode and
+    # lone-surrogate ids, plus a seeded random soup.  Distinct ids
+    # must never share a queue filename, and every slug must be a
+    # legal single filename component.
+    import random
+
+    from qba_tpu.serve.queuefs import _SLUG_MAX, request_slug
+
+    long_base = "x" * (_SLUG_MAX + 50)
+    ids = [
+        # Case: safe ids map to themselves, so case must survive.
+        "req-A", "req-a", "REQ-a", "Req-A",
+        # Sanitizer collisions: all mangle toward 'a_b'.
+        "a/b", "a:b", "a b", "a\tb", "a_b", "a\\b", "a\x00b",
+        # Crafted lookalike of a hashed slug: a literal safe id equal
+        # to sanitize('a/b') + separator + its digest must not alias
+        # the real hashed slug of 'a/b'.
+        request_slug("a/b").replace("~", "-"),
+        "a_b-" + request_slug("a/b").rsplit("~", 1)[-1],
+        # Truncation: ids differing only past the self-map length.
+        long_base + "1", long_base + "2", long_base,
+        long_base[: _SLUG_MAX], long_base[: _SLUG_MAX - 1],
+        # Unicode: lookalikes, combining marks, surrogates, emoji.
+        "héllo", "héllo", "hēllo", "Ω-req", "ω-req",
+        "\ud800req", "req\udfff", "🐍", "🐍🐍", "",
+        "request", "request~deadbeef00",
+    ]
+    rng = random.Random(1729)
+    alphabet = "aA/_.:~ é́Ω🐍\x00-"
+    ids += [
+        "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 160)))
+        for _ in range(300)
+    ]
+    slugs = {}
+    for rid in ids:
+        slug = request_slug(rid)
+        # Filesystem-legal single component, bounded for NAME_MAX.
+        assert slug and "/" not in slug and "\x00" not in slug
+        assert slug not in (".", "..")
+        assert len(slug.encode("utf-8", "surrogatepass")) <= 255
+        assert request_slug(rid) == slug  # deterministic
+        if slug in slugs and slugs[slug] != rid:
+            raise AssertionError(
+                f"slug collision: {rid!r} and {slugs[slug]!r} both "
+                f"map to {slug!r}"
+            )
+        slugs[slug] = rid
+
+
+def test_stop_sentinel_cannot_overtake_queued_requests(tmp_path):
+    # Drain-before-stop FIFO: a stop sentinel that exists BEFORE the
+    # worker's first poll must not make it exit with requests still
+    # queued — the claim loop drains its inbox listing first, so every
+    # already-enqueued request is served exactly once, in slug order.
+    from qba_tpu.serve.transport import serve_file_queue
+
+    qdir = _queue_dirs(tmp_path)
+    for i in range(3):
+        req = _req(f"s{i}", trials=2, seed=i)
+        (qdir / "inbox" / f"s{i}.json").write_text(
+            json.dumps(req.to_json())
+        )
+    (qdir / "stop").touch()  # stop is already there at boot
+    stats = serve_file_queue(
+        QBAServer(chunk_trials=4), str(qdir), poll_s=0.01,
+    )
+    assert stats["completed"] == 3
+    for i in range(3):
+        res = EvalResult.from_json(
+            json.loads((qdir / "outbox" / f"s{i}.json").read_text())
+        )
+        assert res.error is None
+        assert (qdir / "done" / f"s{i}.json").exists()
+    assert list((qdir / "inbox").iterdir()) == []
+    assert list((qdir / "claimed").iterdir()) == []
+
+
 def test_reclaim_backoff_is_exponential(tmp_path):
     # k-th reclaim needs age >= timeout * 2**k: after one reclaim, a
     # claim of the same age is NOT immediately reclaimable again.
