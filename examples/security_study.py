@@ -13,9 +13,12 @@ Usage:
   python examples/security_study.py --quick         # CI-sized smoke
   python examples/security_study.py --json out.json # surface + manifests
   python examples/security_study.py --plot out.png  # per-strategy curves
+  python examples/security_study.py --atlas-store runs/atlas \
+      --seed 0 --target 'decide vs 1/3'  # serve cells from the atlas
 """
 
 import argparse
+import dataclasses
 import json
 import pathlib
 import sys
@@ -52,7 +55,20 @@ def main() -> None:
                     "rule fires (docs/STATS.md)")
     ap.add_argument("--budget-chunks", type=int, default=None,
                     help="total chunk budget across all cells in "
-                    "targeted mode (default: n_chunks x n_cells)")
+                    "targeted mode (default: n_chunks x n_cells); "
+                    "ignored with --atlas-store, where miss cells run "
+                    "one at a time with the per-cell n_chunks ceiling")
+    ap.add_argument("--atlas-store", default=None, metavar="DIR",
+                    help="serve surface cells from certified atlas "
+                    "records (qba-tpu atlas; docs/ATLAS.md): a cell "
+                    "whose exact config fingerprint has a certified "
+                    "record satisfying --target is a cache hit and is "
+                    "not re-run; misses run and are published back "
+                    "into the store; hit/miss counts are printed")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="config seed for every cell (a campaign "
+                    "stamps its spec seed on every cell, so match it "
+                    "for --atlas-store hits)")
     ap.add_argument("--json", default=None, help="write the surface (with "
                     "per-cell manifests) as JSON")
     ap.add_argument("--plot", default=None, help="PNG of per-strategy "
@@ -77,19 +93,76 @@ def main() -> None:
 
     cfg = QBAConfig(
         n_parties=args.n_parties, size_l=size_ls[0],
-        n_dishonest=args.dishonest, trials=trials, seed=7,
+        n_dishonest=args.dishonest, trials=trials, seed=args.seed,
     )
-    cells = run_surface(
-        cfg,
-        strategies=strategies,
-        noise_points=noise_points,
-        size_ls=size_ls,
-        n_chunks=args.n_chunks,
-        chunk_trials=trials,
-        checkpoint_dir=args.checkpoint_dir,
-        target=args.target,
-        budget_chunks=args.budget_chunks,
-    )
+
+    grid = [
+        (s, (p, q), L)
+        for s in strategies
+        for (p, q) in noise_points
+        for L in size_ls
+    ]
+    atlas_hits = []
+    if args.atlas_store:
+        from qba_tpu.atlas.store import AtlasStore
+
+        store = AtlasStore(args.atlas_store)
+        pending = []
+        for s, (p, q), L in grid:
+            cfg_cell = dataclasses.replace(
+                cfg, strategy=s, p_depolarize=p, p_measure_flip=q,
+                size_l=L,
+            )
+            fp = dataclasses.asdict(cfg_cell)
+            fp.pop("trials", None)
+            rec = store.lookup(fp, args.target)
+            if rec is not None:
+                atlas_hits.append(rec)
+            else:
+                pending.append((s, (p, q), L))
+        print(f"atlas store {args.atlas_store}: {len(atlas_hits)} "
+              f"hit(s), {len(pending)} miss(es)")
+        # Misses run one cell at a time (each publishing its record
+        # back into the store) so hits are never re-simulated; the
+        # cross-cell adaptive budget only applies to the no-store path.
+        cells = []
+        for s, pq, L in pending:
+            cells += run_surface(
+                cfg,
+                strategies=[s],
+                noise_points=[pq],
+                size_ls=[L],
+                n_chunks=args.n_chunks,
+                chunk_trials=trials,
+                checkpoint_dir=args.checkpoint_dir,
+                target=args.target,
+                store_dir=args.atlas_store,
+            )
+    else:
+        cells = run_surface(
+            cfg,
+            strategies=strategies,
+            noise_points=noise_points,
+            size_ls=size_ls,
+            n_chunks=args.n_chunks,
+            chunk_trials=trials,
+            checkpoint_dir=args.checkpoint_dir,
+            target=args.target,
+            budget_chunks=args.budget_chunks,
+        )
+
+    for rec in atlas_hits:
+        co = rec.get("coords") or {}
+        ci = rec.get("ci") or {}
+        print(
+            f"strategy={co.get('strategy', '?'):9s} "
+            f"p={co.get('p_depolarize', 0.0):.3f} "
+            f"q={co.get('p_measure_flip', 0.0):.3f} "
+            f"sizeL={co.get('size_l', 0):4d}: "
+            f"success_rate={ci.get('rate', float('nan')):.4f} "
+            f"(atlas hit {rec.get('cell_key')}, "
+            f"{rec.get('n_trials')} trials)"
+        )
     for c in cells:
         plan = (c.manifest or {}).get("plan", {})
         stop = ""
@@ -118,6 +191,22 @@ def main() -> None:
                 "manifest": c.manifest,
             }
             for c in cells
+        ]
+        payload += [
+            {
+                "strategy": (rec.get("coords") or {}).get("strategy"),
+                "p_depolarize": (rec.get("coords") or {}).get(
+                    "p_depolarize"),
+                "p_measure_flip": (rec.get("coords") or {}).get(
+                    "p_measure_flip"),
+                "size_l": (rec.get("coords") or {}).get("size_l"),
+                "trials": rec.get("n_trials"),
+                "success_rate": rec.get("ci"),
+                "stop": rec.get("stop"),
+                "from_atlas": True,
+                "cell_key": rec.get("cell_key"),
+            }
+            for rec in atlas_hits
         ]
         with open(args.json, "w") as f:
             json.dump(payload, f, indent=1, default=str)

@@ -17,6 +17,9 @@ Writes ``validity_study.json`` + a matplotlib figure to ``--out``.
 Usage:
   python examples/validity_threshold_study.py               # full grid (TPU, ~20 min)
   python examples/validity_threshold_study.py --quick       # CI-sized smoke
+  python examples/validity_threshold_study.py \
+      --atlas-store runs/atlas --seed 0 --target 'decide vs 1/3'
+                      # serve grid points from certified atlas cells
 """
 
 import argparse
@@ -100,6 +103,21 @@ def main() -> None:
         "then carry an anytime-valid CI and a stop record "
         "(docs/STATS.md)",
     )
+    ap.add_argument(
+        "--atlas-store", default=None, metavar="DIR",
+        help="serve grid points from certified atlas cells "
+        "(qba-tpu atlas; docs/ATLAS.md) instead of re-running them: a "
+        "point whose exact config fingerprint has a certified record "
+        "satisfying --target is a cache hit (overall rate + CI only — "
+        "the validity/profile breakdowns need trial arrays the store "
+        "does not keep); hit/miss counts are printed and recorded",
+    )
+    ap.add_argument(
+        "--seed", type=int, default=None,
+        help="fixed config seed for every grid point (default: the "
+        "per-point 17*d+L recipe); a campaign stamps its spec seed on "
+        "every cell, so pass that seed for --atlas-store hits",
+    )
     args = ap.parse_args()
 
     from qba_tpu.compile_cache import enable_compile_cache
@@ -116,16 +134,53 @@ def main() -> None:
         ls = [int(x) for x in args.size_l.split(",")]
         trials = args.trials
 
+    store = None
+    if args.atlas_store:
+        from qba_tpu.atlas.store import AtlasStore
+
+        store = AtlasStore(args.atlas_store)
+    hits = misses = 0
+
     points = []
     for d in ds:
         for L in ls:
             cfg = QBAConfig(
                 n_parties=n_p, size_l=L, n_dishonest=d,
-                trials=trials, seed=17 * d + L,
+                trials=trials,
+                seed=args.seed if args.seed is not None else 17 * d + L,
                 strategy=args.strategy,
                 p_depolarize=args.p_depolarize,
                 p_measure_flip=args.p_measure_flip,
             )
+            if store is not None:
+                fp = dataclasses.asdict(cfg)
+                fp.pop("trials", None)
+                rec = store.lookup(fp, args.target)
+                if rec is not None:
+                    hits += 1
+                    ci = rec.get("ci") or {}
+                    points.append({
+                        "overall": dict(ci),
+                        "validity": {"rate": None, "lo": None,
+                                     "hi": None, "n": 0},
+                        "n_parties": n_p, "n_dishonest": d, "size_l": L,
+                        "strategy": args.strategy,
+                        "p_depolarize": args.p_depolarize,
+                        "p_measure_flip": args.p_measure_flip,
+                        "trials": rec.get("n_trials"),
+                        "stop": rec.get("stop"),
+                        "from_atlas": True,
+                        "cell_key": rec.get("cell_key"),
+                    })
+                    print(
+                        f"d={d} L={L:4d}: overall {ci.get('rate'):.4f} "
+                        f"[{ci.get('lo'):.4f},{ci.get('hi'):.4f}]  "
+                        f"(atlas hit {rec.get('cell_key')}, "
+                        f"{rec.get('n_trials')} trials)",
+                        flush=True,
+                    )
+                    continue
+                misses += 1
             # Chunk by pool footprint: sizeL=1000 at 10k trials would
             # blow the single-batch HBM ceiling (KI-2).
             chunk = min(trials, 2000 if L <= 256 else 500)
@@ -175,6 +230,12 @@ def main() -> None:
     payload = {"n_parties": n_p, "points": points}
     if args.target:
         payload["target"] = args.target
+    if store is not None:
+        payload["atlas"] = {
+            "store": args.atlas_store, "hits": hits, "misses": misses,
+        }
+        print(f"atlas store {args.atlas_store}: "
+              f"{hits} hit(s), {misses} miss(es)")
     with open(json_path, "w") as f:
         json.dump(payload, f, indent=1)
     print("wrote", json_path)

@@ -29,6 +29,11 @@ mechanizes (``docs/KNOWN_ISSUES.md``):
   model check, an unregistered queue mutation in ``serve/``, or an
   admission-ledger purity break
   (:mod:`qba_tpu.analysis.protocol`).
+* ``KI-11`` — an incomplete atlas campaign: an enumerated cube cell
+  with neither a certified store record meeting its target nor an
+  explicit refusal/truncation finding, a record/ledger/content-address
+  disagreement, or a slice whose frontier CI widths exceed the
+  interior's (:mod:`qba_tpu.analysis.atlas`, docs/ATLAS.md).
 
 A *note* is an informational line the report carries alongside the
 findings (plan predictions, probe-counter reality checks) — notes
@@ -40,7 +45,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterable
 
-KI_TAGS = ("KI-1", "KI-2", "KI-3", "KI-5", "KI-6", "KI-8", "KI-10")
+KI_TAGS = ("KI-1", "KI-2", "KI-3", "KI-5", "KI-6", "KI-8", "KI-10", "KI-11")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,47 @@
+"""Fleet-scale atlas campaigns: the 4-D QBA validity phase diagram.
+
+The campaign driver (:mod:`~qba_tpu.atlas.campaign`) enumerates the
+(parties × dishonest × strategy × noise) cube
+(:mod:`~qba_tpu.atlas.cube`), prices every cell through the fleet
+admission controller, steers trial budget toward the validity
+threshold frontier (:mod:`~qba_tpu.atlas.steer`), and materializes a
+content-addressed store of certified per-cell records
+(:mod:`~qba_tpu.atlas.store`) that
+:func:`~qba_tpu.atlas.render.render_atlas` turns into the phase
+diagram — validity surfaces with CI bands per (strategy, noise) slice
+and the measured KI-7 noise-detectability fence.  docs/ATLAS.md is
+the operator guide; the KI-11 completeness lint lives in
+:mod:`qba_tpu.analysis.atlas`.
+"""
+
+from qba_tpu.atlas.campaign import (
+    CampaignDriver,
+    FleetExecutor,
+    LocalExecutor,
+)
+from qba_tpu.atlas.cube import AtlasCell, CampaignSpec, enumerate_cells
+from qba_tpu.atlas.render import plot_slices, render_atlas
+from qba_tpu.atlas.steer import frontier_plan, is_frontier
+from qba_tpu.atlas.store import (
+    AtlasStore,
+    cell_key,
+    cell_slug,
+    record_satisfies,
+)
+
+__all__ = [
+    "AtlasCell",
+    "AtlasStore",
+    "CampaignDriver",
+    "CampaignSpec",
+    "FleetExecutor",
+    "LocalExecutor",
+    "cell_key",
+    "cell_slug",
+    "enumerate_cells",
+    "frontier_plan",
+    "is_frontier",
+    "plot_slices",
+    "record_satisfies",
+    "render_atlas",
+]

@@ -333,6 +333,14 @@ def _parser() -> argparse.ArgumentParser:
         "(docs/ANALYSIS.md)",
     )
     lint.add_argument(
+        "--atlas", metavar="STORE_DIR", default=None, dest="atlas_store",
+        help="also run the KI-11 campaign-completeness gate over this "
+        "atlas store: every enumerated cube cell certified to its "
+        "target or explicitly refused, records content-addressed and "
+        "valid, frontier CI widths <= interior per slice "
+        "(docs/ATLAS.md)",
+    )
+    lint.add_argument(
         "--findings-json", metavar="PATH", default=None,
         help="write the full report (findings, notes, stats) as JSON "
         "to PATH — the CI lint job uploads this as an artifact",
@@ -563,6 +571,120 @@ def _parser() -> argparse.ArgumentParser:
         "--respawn-backoff-s", type=float,
         default=_timing.RESPAWN_BACKOFF_S,
         help="base exponential backoff between respawns of one slot",
+    )
+
+    atlas = sub.add_parser(
+        "atlas",
+        help="4-D validity-atlas campaign: enumerate the (parties x "
+        "dishonest x strategy x noise) cube, certify every cell to a "
+        "precision target through the fleet, and render the phase "
+        "diagram (docs/ATLAS.md)",
+    )
+    atlas.add_argument(
+        "--store", metavar="DIR", required=True,
+        help="atlas store directory (content-addressed cell records + "
+        "campaign ledger + rendered atlas.json); resumable — an "
+        "interrupted campaign restarts from the ledger here",
+    )
+    atlas.add_argument(
+        "--parties", type=int, nargs="+", required=True,
+        help="party counts, e.g. --parties 4 7 13 257",
+    )
+    atlas.add_argument(
+        "--dishonest", nargs="+", required=True,
+        help="traitor counts (integers) and/or fractions of n "
+        "('1/3', '0.4'), resolved per party count, e.g. "
+        "--dishonest 0 1 1/3",
+    )
+    atlas.add_argument(
+        "--strategies", nargs="+", default=["reference"],
+        help="adversary strategies (the zoo: reference collude "
+        "adaptive split)",
+    )
+    atlas.add_argument(
+        "--noise", nargs="+", default=["0:0"], metavar="P:Q",
+        help="noise points as p_depolarize:p_measure_flip pairs, e.g. "
+        "--noise 0:0 0.01:0 0:0.02",
+    )
+    atlas.add_argument("--size-l", type=int, default=4, help="protocol sizeL")
+    atlas.add_argument("--seed", type=int, default=0, help="campaign seed")
+    atlas.add_argument(
+        "--target", default="decide vs 1/3 @ 95%",
+        help="per-cell precision target (stats target grammar)",
+    )
+    atlas.add_argument(
+        "--budget-trials", type=int, default=1024,
+        help="wave-0 per-cell trial budget; unresolved cells escalate",
+    )
+    atlas.add_argument(
+        "--escalation", type=float, default=4.0,
+        help="budget multiplier per escalation wave (frontier cells "
+        "only — interior cells resolve on wave 0)",
+    )
+    atlas.add_argument(
+        "--max-escalations", type=int, default=2,
+        help="escalation waves before a cell is refused as truncated",
+    )
+    atlas.add_argument(
+        "--chunk-trials", type=int, default=64,
+        help="trials per device chunk (shared with admission pricing)",
+    )
+    atlas.add_argument(
+        "--engine", default="auto", help="round engine for every cell"
+    )
+    atlas.add_argument(
+        "--executor", choices=("local", "fleet"), default="local",
+        help="local = in-process server (tests/smoke); fleet = file-"
+        "queue replicas under this driver (needs --queue-dir)",
+    )
+    atlas.add_argument(
+        "--queue-dir", metavar="DIR", default=None,
+        help="fleet executor: shared queue directory",
+    )
+    atlas.add_argument(
+        "--replicas", type=int, default=2,
+        help="fleet executor: worker processes",
+    )
+    atlas.add_argument(
+        "--supervise", action="store_true",
+        help="fleet executor: run the self-healing supervisor "
+        "(watchdog, claim release, poison quarantine, respawn)",
+    )
+    atlas.add_argument(
+        "--platform", default=None,
+        help="fleet executor: jax platform for workers (cpu/tpu)",
+    )
+    atlas.add_argument(
+        "--cache-dir", metavar="DIR", default=None,
+        help="shared warm-start artifact directory",
+    )
+    atlas.add_argument(
+        "--telemetry", metavar="DIR", default=None,
+        help="per-request telemetry root",
+    )
+    atlas.add_argument(
+        "--capacity-trials", type=int, default=None,
+        help="admission window override (default: replicas * 8 chunks)",
+    )
+    atlas.add_argument(
+        "--window-chunks", type=int, default=8,
+        help="per-replica chunks of admission headroom",
+    )
+    atlas.add_argument(
+        "--chaos-kill", action="store_true",
+        help="fleet executor: SIGKILL one worker after the first "
+        "result lands (chaos drill — the supervisor + campaign ledger "
+        "must finish the cube anyway)",
+    )
+    atlas.add_argument(
+        "--max-results", type=int, default=None,
+        help="interrupt the driver after N processed results (exit 3; "
+        "re-run with the same spec to resume from the ledger)",
+    )
+    atlas.add_argument(
+        "--plot", metavar="DIR", default=None,
+        help="also render per-slice PNGs + the KI-7 fence figure into "
+        "DIR (requires matplotlib)",
     )
 
     study = sub.add_parser(
@@ -1132,6 +1254,10 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
         from qba_tpu.analysis.manifests import check_manifest_files
 
         report.extend(check_manifest_files(args.manifests))
+    if args.atlas_store:
+        from qba_tpu.analysis.atlas import check_atlas_store
+
+        report.extend(check_atlas_store(args.atlas_store))
     print(report.render(verbose=args.verbose), file=out)
     if args.findings_json:
         import dataclasses
@@ -1153,6 +1279,140 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
             json.dump(payload, fh, indent=2)
         print(f"findings json: {args.findings_json}", file=out)
     return 0 if report.ok else 1
+
+
+def _cmd_atlas(args: argparse.Namespace, out) -> int:
+    import json
+    import threading
+    import time
+
+    from qba_tpu.atlas import (
+        AtlasStore,
+        CampaignDriver,
+        CampaignSpec,
+        FleetExecutor,
+        LocalExecutor,
+    )
+    from qba_tpu.atlas.cube import parse_dishonest
+    from qba_tpu.serve.fleet import AdmissionController
+
+    noise: list[tuple[float, float]] = []
+    for tok in args.noise:
+        p, sep, q = tok.partition(":")
+        if not sep:
+            raise ValueError(f"--noise wants p_depolarize:p_measure_flip, got {tok!r}")
+        noise.append((float(p), float(q or 0)))
+    spec = CampaignSpec(
+        parties=tuple(args.parties),
+        dishonest=parse_dishonest(args.dishonest),
+        strategies=tuple(args.strategies),
+        noise_points=tuple(noise),
+        size_l=args.size_l,
+        seed=args.seed,
+        chunk_trials=args.chunk_trials,
+        budget_trials=args.budget_trials,
+        escalation=args.escalation,
+        max_escalations=args.max_escalations,
+        target=args.target,
+        round_engine=args.engine,
+    )
+    store = AtlasStore(args.store)
+    admission = AdmissionController(
+        chunk_trials=args.chunk_trials,
+        replicas=args.replicas if args.executor == "fleet" else 1,
+        capacity_trials=args.capacity_trials,
+        window_chunks=args.window_chunks,
+    )
+    pool = None
+    supervisor = None
+    sup_thread = None
+    sup_stop = threading.Event()
+    on_result = None
+    t0 = time.monotonic()
+    if args.executor == "fleet":
+        if not args.queue_dir:
+            raise ValueError("--executor fleet requires --queue-dir")
+        from qba_tpu.serve.fleet import FleetSupervisor, ReplicaPool
+
+        executor = FleetExecutor(args.queue_dir)
+        pool = ReplicaPool(
+            args.queue_dir,
+            replicas=args.replicas,
+            chunk_trials=args.chunk_trials,
+            cache_dir=args.cache_dir,
+            telemetry_dir=args.telemetry,
+            platform=args.platform,
+        )
+        if args.supervise:
+            supervisor = FleetSupervisor(pool, admission=admission)
+        if args.chaos_kill:
+            killed = []
+
+            def on_result(count: int, payload: dict) -> None:
+                # One SIGKILL, after the first result proves the fleet
+                # works — the supervisor + ledger must finish the cube.
+                if count == 1 and not killed:
+                    alive = pool.alive()
+                    if alive:
+                        victim = alive[-1]
+                        pid = pool.kill(victim)
+                        killed.append(victim)
+                        print(
+                            json.dumps(
+                                {"chaos": {"killed": victim, "pid": pid}}
+                            ),
+                            file=sys.stderr,
+                            flush=True,
+                        )
+
+        pool.start()
+        if supervisor is not None:
+            sup_thread = threading.Thread(
+                target=supervisor.run, args=(sup_stop,), daemon=True
+            )
+            sup_thread.start()
+    else:
+        executor = LocalExecutor(
+            chunk_trials=args.chunk_trials,
+            cache_dir=args.cache_dir,
+            telemetry_dir=args.telemetry,
+        )
+    driver = CampaignDriver(
+        store,
+        spec,
+        executor,
+        admission=admission,
+        log=lambda s: print(s, file=sys.stderr, flush=True),
+        max_results=args.max_results,
+        on_result=on_result,
+    )
+    try:
+        summary = driver.run()
+    finally:
+        # Stop supervising BEFORE stopping the pool (same ordering as
+        # `fleet`: a draining worker must not be watchdogged).
+        sup_stop.set()
+        if sup_thread is not None:
+            sup_thread.join(timeout=30)
+        if pool is not None:
+            pool.stop()
+    summary["elapsed_s"] = time.monotonic() - t0
+    if supervisor is not None:
+        summary["self_healing"] = supervisor.summary()
+    if args.plot:
+        from qba_tpu.atlas import plot_slices
+
+        written = plot_slices(store, args.plot)
+        if written:
+            summary["plots"] = written
+        else:
+            raise PlottingUnavailableError(
+                "--plot requires matplotlib, which is not importable"
+            )
+    print(json.dumps({"atlas": summary}, indent=1, default=str), file=out)
+    if summary.get("interrupted"):
+        return 3
+    return 0 if summary["open"] == 0 else 1
 
 
 def _cmd_serve(args: argparse.Namespace, out) -> int:
@@ -1378,6 +1638,8 @@ def main(argv: Sequence[str] | None = None, out=None) -> int:
             return _cmd_serve(args, out)
         if args.command == "fleet":
             return _cmd_fleet(args, out)
+        if args.command == "atlas":
+            return _cmd_atlas(args, out)
     except ValueError as e:  # config validation -> clean CLI failure
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -121,6 +121,10 @@ class AdmissionController:
         # keeps pricing against capacity that actually exists.
         self._base_capacity = self.capacity_trials
         self._benched: set[str] = set()
+        # Batch-hint state (campaign drivers): request ids whose first
+        # batch-mode DEFER is already on the ledger, so re-polls of the
+        # same still-deferred id stay silent until it resolves.
+        self._batch_deferred: set[str] = set()
 
     @property
     def outstanding_trials(self) -> int:
@@ -183,7 +187,9 @@ class AdmissionController:
         return self._ceilings[label]
 
     # ---- the decision ------------------------------------------------
-    def try_admit(self, req, *, record: bool = True) -> AdmissionDecision:
+    def try_admit(
+        self, req, *, record: bool = True, batch: bool = False
+    ) -> AdmissionDecision:
         """Price and decide one request.  ``admit`` records the price
         in the ledger (the caller MUST eventually :meth:`settle`);
         ``defer`` and ``reject`` leave the ledger untouched.
@@ -195,7 +201,40 @@ class AdmissionController:
         list stays a pure function of the request stream and settle
         points, not of settle *timing*.  A retry that resolves
         (admit/reject) is recorded by the caller via :meth:`record`.
+
+        ``batch=True`` is the campaign-driver hint (retry contract in
+        docs/SERVING.md "Batch admission"): a driver submitting
+        hundreds of cells re-offers every still-open cell each round,
+        so per-rid only the FIRST ``defer/window_full`` is recorded —
+        later re-offers of the same deferred id return the live
+        verdict without touching the decision list until the id
+        resolves (admit or reject), which is recorded and clears the
+        id.  The recorded ledger therefore stays a pure function of
+        the distinct request stream and settle points, however many
+        times the driver polls.
         """
+        rid = req.request_id
+        dec = self._evaluate(req)
+        if batch and record:
+            if dec.action == DEFER:
+                if rid in self._batch_deferred:
+                    record = False  # re-offer of a recorded defer: silent
+                else:
+                    self._batch_deferred.add(rid)
+            else:
+                # The deferred id resolved (admit or reject): record it
+                # and forget the defer so a future re-submission of the
+                # same id starts fresh.
+                self._batch_deferred.discard(rid)
+        if record:
+            self.decisions.append(dec)
+        return dec
+
+    def _evaluate(self, req) -> AdmissionDecision:
+        """Price and decide without touching the decision list (the
+        ledger of outstanding trials still mutates on admit) — the
+        single decision procedure behind plain, retry (``record=
+        False``), and batch admission."""
         from qba_tpu.serve.scheduler import bucket_config, bucket_label
 
         rid = req.request_id
@@ -205,7 +244,7 @@ class AdmissionController:
             priced, detail = self.price(req)
         except ValueError as e:
             return self._decide(
-                REJECT, "invalid_request", rid, detail=str(e), record=record
+                REJECT, "invalid_request", rid, detail=str(e), record=False
             )
         if ceiling < self.chunk_trials:
             where = (
@@ -221,7 +260,7 @@ class AdmissionController:
                     f"{self.chunk_trials}: one chunk of this shape "
                     f"exhausts HBM on {where}"
                 ),
-                record=record,
+                record=False,
             )
         if priced > self.capacity_trials:
             return self._decide(
@@ -230,7 +269,7 @@ class AdmissionController:
                     f"priced {priced} trials > fleet window "
                     f"{self.capacity_trials}: would wedge every other tenant"
                 ),
-                record=record,
+                record=False,
             )
         if self.outstanding_trials + priced > self.capacity_trials:
             return self._decide(
@@ -239,12 +278,12 @@ class AdmissionController:
                     f"{self.outstanding_trials} trials outstanding; retry "
                     "after a release"
                 ),
-                record=record,
+                record=False,
             )
         self._outstanding[rid] = priced
         return self._decide(
             ADMIT, "capacity_available", rid, bucket=label, priced=priced,
-            detail=detail, record=record,
+            detail=detail, record=False,
         )
 
     def record(self, decision: AdmissionDecision) -> None:

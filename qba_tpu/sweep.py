@@ -631,9 +631,31 @@ def _surface_grid(
                 ckpt = None
                 if checkpoint_dir:
                     os.makedirs(checkpoint_dir, exist_ok=True)
-                    ckpt = os.path.join(
+                    # Content-addressed cell filename (atlas store
+                    # discipline): derived from the config fingerprint
+                    # through the hardened injective slug, so cells
+                    # produced by independent runs/dirs merge without
+                    # renames and distinct configs can never collide.
+                    from qba_tpu.atlas.store import cell_slug
+
+                    addressed = os.path.join(
+                        checkpoint_dir,
+                        cell_slug(_config_fingerprint(cfg_cell)) + ".json",
+                    )
+                    # Compat shim: an existing pre-atlas layout keeps
+                    # resuming from its coordinate-named file until the
+                    # addressed one exists (load_checkpoint still
+                    # fingerprint-checks it, so a stale coordinate file
+                    # for a different config is rejected, not resumed).
+                    legacy = os.path.join(
                         checkpoint_dir,
                         f"surface_{strat}_p{p_dep}_q{p_mf}_L{size_l}.json",
+                    )
+                    ckpt = (
+                        legacy
+                        if os.path.exists(legacy)
+                        and not os.path.exists(addressed)
+                        else addressed
                     )
                 grid.append((strat, p_dep, p_mf, size_l, cfg_cell, ckpt))
     return grid
@@ -1102,6 +1124,7 @@ def run_surface(
     budget_chunks: int | None = None,
     resume_force: bool = False,
     dispatch: str = "host",
+    store_dir: str | None = None,
 ) -> list[SurfaceCell]:
     """The (strategy × noise × sizeL) adversary surface as ONE sharded
     Monte-Carlo: every cell is a :func:`run_sweep` over the same runner
@@ -1133,6 +1156,13 @@ def run_surface(
     contents and stop decisions match the host allocator's rules
     exactly; the *schedule* may reorder near-tied cells (float32 width
     ordering on device vs float64 on host).
+
+    ``store_dir`` additionally publishes every finished cell into a
+    content-addressed atlas store (:mod:`qba_tpu.atlas.store`) —
+    targeted cells land certified (or refused on budget exhaustion),
+    fixed-budget cells land as uncertified estimates; independently
+    produced surfaces merge into one store because the filenames are
+    config-fingerprint hashes, not coordinates.
     """
     from qba_tpu.diagnostics import record_decisions
     from qba_tpu.obs.manifest import collect_manifest
@@ -1158,7 +1188,7 @@ def run_surface(
             target = parse_target(target)
         n_cells = len(strategies) * len(noise_points) * len(size_ls)
         if dispatch == "device":
-            return _run_surface_targeted_device(
+            cells = _run_surface_targeted_device(
                 cfg,
                 strategies,
                 noise_points,
@@ -1173,20 +1203,24 @@ def run_surface(
                 with_manifest,
                 resume_force,
             )
-        return _run_surface_targeted(
-            cfg,
-            strategies,
-            noise_points,
-            size_ls,
-            target,
-            budget_chunks if budget_chunks is not None else n_chunks * n_cells,
-            chunk_trials,
-            checkpoint_dir,
-            log,
-            runner,
-            with_manifest,
-            resume_force,
-        )
+        else:
+            cells = _run_surface_targeted(
+                cfg,
+                strategies,
+                noise_points,
+                size_ls,
+                target,
+                budget_chunks
+                if budget_chunks is not None
+                else n_chunks * n_cells,
+                chunk_trials,
+                checkpoint_dir,
+                log,
+                runner,
+                with_manifest,
+                resume_force,
+            )
+        return _publish_surface_cells(cells, store_dir, target, chunk_trials)
 
     cells: list[SurfaceCell] = []
     grid = _surface_grid(cfg, strategies, noise_points, size_ls, checkpoint_dir)
@@ -1230,6 +1264,25 @@ def run_surface(
                 p_measure_flip=p_mf,
                 size_l=size_l,
                 success_rate=res.success_rate,
+            )
+    return _publish_surface_cells(cells, store_dir, None, chunk_trials)
+
+
+def _publish_surface_cells(
+    cells: list[SurfaceCell],
+    store_dir: str | None,
+    target: Target | None,
+    chunk_trials: int,
+) -> list[SurfaceCell]:
+    """Optionally publish surface cells into a content-addressed atlas
+    store (``run_surface(store_dir=...)``); always returns the cells."""
+    if store_dir:
+        from qba_tpu.atlas.store import AtlasStore, record_from_surface_cell
+
+        store = AtlasStore(store_dir)
+        for cell in cells:
+            store.write_cell(
+                record_from_surface_cell(cell, target, chunk_trials)
             )
     return cells
 

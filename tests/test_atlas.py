@@ -1,0 +1,471 @@
+"""Atlas-subsystem tests (docs/ATLAS.md).
+
+Five contracts:
+
+* **Cube determinism** — :func:`enumerate_cells` is a pure function of
+  the :class:`CampaignSpec`: same spec, same cells in the same order,
+  deduped, with content addresses derived from per-cell config
+  fingerprints (trials excluded — chunk sizing is not identity).
+* **Content addressing** — the store files every record under the hash
+  of its own config; dialect differences (``trials``/``derived``)
+  collapse to one key; a different config under the same filename is
+  an :class:`AtlasCollision`, never an overwrite; the store digest
+  covers exactly the identity view (manifests/provenance excluded).
+* **Cache reads** — :meth:`AtlasStore.lookup` answers a config+target
+  query from a certified record: a decided stop at the same threshold
+  certifies even when the conservative anytime CI straddles it
+  (e-value decisions fire first); weaker questions hit on stronger
+  certificates; everything else misses.
+* **Campaign determinism** — a driver kill (result-budget interrupt or
+  a fleet worker SIGKILL) followed by resume-from-ledger yields a
+  store digest bit-identical to the uninterrupted run: at-least-once
+  delivery + idempotent, content-addressed publication = exactly-once
+  effect.
+* **KI-11 completeness** (docs/KNOWN_ISSUES.md) — the lint re-derives
+  the cube from the ledger's spec and proves every cell terminal with
+  an honest record; tampered stores (deleted record, truncation
+  mis-marked as certified, config drift) produce findings.
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from qba_tpu.atlas.cube import (
+    CampaignSpec,
+    attempt_trials,
+    build_request,
+    enumerate_cells,
+    parse_dishonest,
+    request_id_for,
+    resolve_dishonest,
+)
+from qba_tpu.atlas.store import (
+    CELL_SCHEMA,
+    AtlasCollision,
+    AtlasStore,
+    cell_key,
+    cell_slug,
+    record_satisfies,
+    validate_cell_record,
+)
+
+def _spec(**kw):
+    kw.setdefault("parties", (4,))
+    kw.setdefault("dishonest", (1,))
+    kw.setdefault("chunk_trials", 32)
+    kw.setdefault("budget_trials", 64)
+    kw.setdefault("max_escalations", 1)
+    kw.setdefault("target", "decide vs 1/3 @ 95%")
+    return CampaignSpec(**kw)
+
+
+# ---- cube enumeration --------------------------------------------------
+
+
+def test_campaign_spec_roundtrips_and_keys_stably():
+    spec = _spec(parties=(4, 7), dishonest=(1, 1 / 3),
+                 noise_points=((0.0, 0.0), (0.05, 0.02)))
+    again = CampaignSpec.from_json(spec.to_json())
+    assert again == spec
+    assert again.campaign_key() == spec.campaign_key()
+    # key is a pure function of the spec content
+    assert _spec().campaign_key() != spec.campaign_key()
+
+
+def test_parse_and_resolve_dishonest():
+    assert parse_dishonest(["1", "1/3", "0.5"]) == (1.0, 1 / 3, 0.5)
+    # fractions floor per party count; duplicates collapse; counts
+    # exceeding n-1 are skipped for that n
+    assert resolve_dishonest(7, (1 / 3, 2.0)) == [2]
+    assert resolve_dishonest(4, (1 / 3, 1.0)) == [1]
+    assert resolve_dishonest(4, (9.0,)) == []
+
+
+def test_enumerate_cells_deterministic_deduped_content_addressed():
+    spec = _spec(parties=(4, 7), dishonest=(1, 1 / 4))
+    cells = enumerate_cells(spec)
+    again = enumerate_cells(spec)
+    assert [c.key for c in cells] == [c.key for c in again]
+    assert len({c.key for c in cells}) == len(cells)  # deduped
+    for c in cells:
+        # the address is the fingerprint hash, independent of trials
+        # (chunk sizing is execution policy, not identity)
+        assert c.key == cell_key(c.fingerprint)
+        fp_with_trials = dict(c.fingerprint)
+        fp_with_trials["trials"] = 999_999
+        assert cell_key(fp_with_trials) == c.key
+
+
+def test_attempt_trials_escalates_in_whole_chunks():
+    spec = _spec(chunk_trials=32, budget_trials=48, escalation=4.0)
+    assert attempt_trials(spec, 0) % 32 == 0
+    assert attempt_trials(spec, 0) >= 48
+    assert attempt_trials(spec, 1) >= 4 * 48
+    assert attempt_trials(spec, 1) % 32 == 0
+
+
+def test_build_request_carries_target_and_stable_ids():
+    spec = _spec()
+    (cell,) = enumerate_cells(spec)
+    req = build_request(cell, spec, 0)
+    assert req.request_id == request_id_for(cell.key, 0)
+    assert req.target == spec.target
+    assert req.trials == attempt_trials(spec, 0)
+    assert request_id_for(cell.key, 1) != req.request_id
+
+
+# ---- store addressing --------------------------------------------------
+
+
+def _fp(**kw):
+    fp = {"n_parties": 4, "size_l": 4, "n_dishonest": 1, "seed": 0,
+          "strategy": "reference", "p_depolarize": 0.0,
+          "p_measure_flip": 0.0}
+    fp.update(kw)
+    return fp
+
+
+def _record(fp, status="certified", stop_reason="decided_above",
+            lo=0.5, hi=0.9, **kw):
+    rec = {
+        "schema": CELL_SCHEMA,
+        "cell_key": cell_key(fp),
+        "coords": {k: fp.get(k) for k in (
+            "strategy", "p_depolarize", "p_measure_flip", "size_l",
+            "n_parties", "n_dishonest")},
+        "config": dict(fp),
+        "target": "decide vs 1/3 @ 95%",
+        "chunk_trials": 32,
+        "status": status,
+        "stop": {
+            "reason": stop_reason, "threshold": 1 / 3, "n_trials": 64,
+        } if stop_reason else None,
+        "ci": {"rate": (lo + hi) / 2, "lo": lo, "hi": hi,
+               "confidence": 0.95},
+        "successes": 40,
+        "n_trials": 64,
+        "attempts": 1,
+        "refusal": ({"reason": "budget_exhausted"}
+                    if status == "refused" else None),
+    }
+    rec.update(kw)
+    return rec
+
+
+def test_cell_key_collapses_fingerprint_dialects():
+    fp = _fp()
+    assert cell_key(fp) == cell_key({**fp, "trials": 123})
+    assert cell_key(fp) == cell_key({**fp, "derived": {"w": 9}})
+    assert cell_key(fp) != cell_key(_fp(seed=1))
+    assert cell_slug(fp) == f"cell-{cell_key(fp)}"
+
+
+def test_store_write_load_lookup_and_collision(tmp_path):
+    store = AtlasStore(str(tmp_path / "atlas"))
+    fp = _fp()
+    rec = _record(fp)
+    path = store.write_cell(rec)
+    assert os.path.basename(path) == cell_slug(fp) + ".json"
+    assert store.load_cell(rec["cell_key"]) == rec
+    # lookup: hit at the certified target, miss for a config not there
+    assert store.lookup(fp, "decide vs 1/3 @ 95%") == rec
+    assert store.lookup(fp) == rec
+    assert store.lookup(_fp(seed=5)) is None
+    # re-certifying the same config overwrites in place
+    store.write_cell(_record(fp, lo=0.6, hi=0.8))
+    assert store.load_cell(rec["cell_key"])["ci"]["lo"] == 0.6
+    # ... but a filename already holding a *different* config (e.g. a
+    # truncated-hash forgery or on-disk tampering) is refused loudly
+    tampered = json.load(open(store.cell_path(rec["cell_key"])))
+    tampered["config"]["seed"] = 77
+    with open(store.cell_path(rec["cell_key"]), "w") as f:
+        json.dump(tampered, f)
+    with pytest.raises(AtlasCollision):
+        store.write_cell(rec)
+
+
+def test_record_satisfies_stop_certificate_beats_straddling_ci():
+    fp = _fp()
+    # e-value rule decided above 1/3 but the conservative anytime CI
+    # still straddles the threshold: the decision is the certificate.
+    rec = _record(fp, lo=0.3329, hi=0.7230)
+    assert record_satisfies(rec, "decide vs 1/3 @ 95%")
+    # a different threshold falls back to the CI test: excluded by the
+    # CI answers anyway; inside the CI misses
+    assert record_satisfies(rec, "decide vs 3/4 @ 95%")
+    assert not record_satisfies(rec, "decide vs 1/2 @ 95%")
+    # width questions need the CI to actually be tight
+    assert not record_satisfies(rec, "ci_width<=0.05 @ 95%")
+    assert record_satisfies(_record(fp, lo=0.80, hi=0.84),
+                            "ci_width<=0.05 @ 95%")
+    # higher-confidence questions than the certificate answers: miss
+    assert not record_satisfies(
+        _record(fp, lo=0.5, hi=0.9), "decide vs 1/3 @ 99%")
+    assert not record_satisfies(_record(fp, status="refused"),
+                                "decide vs 1/3 @ 95%")
+
+
+def test_store_digest_covers_identity_not_provenance(tmp_path):
+    a = AtlasStore(str(tmp_path / "a"))
+    b = AtlasStore(str(tmp_path / "b"))
+    fp = _fp()
+    a.write_cell(_record(fp, manifest={"engine": "xla"},
+                         provenance={"replica_id": "r0"}))
+    b.write_cell(_record(fp, manifest={"engine": "pallas"},
+                         provenance={"replica_id": "r7"}))
+    assert a.digest() == b.digest()
+    b.write_cell(_record(_fp(seed=3)))
+    assert a.digest() != b.digest()
+
+
+def test_validate_cell_record_rejects_dishonest_certificates():
+    fp = _fp()
+    with pytest.raises(ValueError, match="schema"):
+        validate_cell_record({**_record(fp), "schema": "nope/v0"})
+    with pytest.raises(ValueError, match="content-address"):
+        validate_cell_record({**_record(fp), "cell_key": "f" * 16})
+    # a truncation mis-marked as certified: budget_exhausted cannot
+    # certify a target (the KI-11 negative fixture)
+    with pytest.raises(ValueError, match="budget_exhausted"):
+        validate_cell_record(
+            _record(fp, stop_reason="budget_exhausted"))
+    with pytest.raises(ValueError, match="refusal"):
+        validate_cell_record(
+            {**_record(fp, status="refused"), "refusal": None})
+    with pytest.raises(ValueError, match="lo/hi"):
+        validate_cell_record(
+            {**_record(fp), "ci": {"rate": 0.5}})
+
+
+# ---- local campaign end-to-end -----------------------------------------
+
+
+def _run_campaign(store_dir, spec, cache_dir, **driver_kw):
+    from qba_tpu.atlas.campaign import CampaignDriver, LocalExecutor
+
+    store = AtlasStore(store_dir)
+    driver = CampaignDriver(
+        store, spec,
+        LocalExecutor(chunk_trials=spec.chunk_trials,
+                      cache_dir=cache_dir),
+        **driver_kw,
+    )
+    return store, driver.run()
+
+
+def test_local_campaign_certifies_cube_and_passes_ki11(tmp_path):
+    from qba_tpu.analysis.atlas import check_atlas_store
+
+    spec = _spec(parties=(4, 5), dishonest=(0, 1))
+    store, summary = _run_campaign(
+        str(tmp_path / "atlas"), spec, str(tmp_path / "cache"))
+    assert summary["open"] == 0
+    assert summary["cells"] == 4
+    assert summary["certified"] + summary["refused"] == 4
+    assert not summary["interrupted"]
+    report = check_atlas_store(store.root)
+    assert report.ok, report.render()
+    assert report.stats["atlas_cells"] == 4
+    atlas = json.load(open(store.atlas_path))
+    assert atlas["schema"] == "qba-tpu/atlas/v1"
+    (sl,) = atlas["slices"]
+    assert len(sl["points"]) == 4
+    # the KI-7 fence is measured from the honest-baseline cells
+    assert atlas["ki7_fence"], "d=0 cells must produce a measured fence"
+    for curve in atlas["ki7_fence"]:
+        for pt in curve["points"]:
+            assert pt["lo"] is None or pt["lo"] <= pt["hi"]
+
+
+def test_campaign_resume_differential_is_bit_identical(tmp_path):
+    spec = _spec(parties=(4, 5), dishonest=(1,))
+    cache = str(tmp_path / "cache")
+    ref_store, ref = _run_campaign(str(tmp_path / "ref"), spec, cache)
+    assert ref["open"] == 0
+
+    # interrupt after one processed result (the driver-kill story: the
+    # ledger survives, in-flight work is re-admitted on resume)
+    store_b, first = _run_campaign(
+        str(tmp_path / "b"), spec, cache, max_results=1)
+    assert first["interrupted"]
+    assert first["open"] >= 1
+    store_b2, second = _run_campaign(str(tmp_path / "b"), spec, cache)
+    assert second["open"] == 0
+    assert not second["interrupted"]
+    assert second["store_digest"] == ref["store_digest"]
+
+
+def test_campaign_ledger_refuses_foreign_spec(tmp_path):
+    from qba_tpu.atlas.campaign import CampaignDriver, LocalExecutor
+
+    spec = _spec()
+    store, summary = _run_campaign(
+        str(tmp_path / "atlas"), spec, str(tmp_path / "cache"))
+    assert summary["open"] == 0
+    other = _spec(parties=(5,))
+    with pytest.raises(ValueError, match="campaign"):
+        CampaignDriver(store, other, LocalExecutor()).run()
+
+
+# ---- KI-11 tampering fixtures ------------------------------------------
+
+
+def test_ki11_catches_tampered_stores(tmp_path):
+    from qba_tpu.analysis.atlas import check_atlas_store
+
+    spec = _spec(parties=(4, 5), dishonest=(1,))
+    store, summary = _run_campaign(
+        str(tmp_path / "atlas"), spec, str(tmp_path / "cache"))
+    assert check_atlas_store(store.root).ok
+
+    # (a) delete a certified record: the ledger's claim is unbacked
+    victim = next(iter(json.load(open(store.ledger_path))["cells"]))
+    os.unlink(store.cell_path(victim))
+    rep = check_atlas_store(store.root)
+    assert any(f.check == "record-missing" for f in rep.findings)
+
+    # (b) mark an enumerated cell non-terminal: campaign incomplete
+    led = json.load(open(store.ledger_path))
+    led["cells"][victim]["status"] = "submitted"
+    with open(store.ledger_path, "w") as f:
+        json.dump(led, f)
+    rep = check_atlas_store(store.root)
+    assert any("neither certified" in f.message for f in rep.findings)
+
+    # (c) drift a surviving record's config: content-address violation
+    other = next(k for k in led["cells"] if k != victim)
+    rec = json.load(open(store.cell_path(other)))
+    rec["config"]["seed"] = 999
+    with open(store.cell_path(other), "w") as f:
+        json.dump(rec, f)
+    rep = check_atlas_store(store.root)
+    assert any(f.check in ("record-invalid", "content-address")
+               for f in rep.findings)
+
+
+def test_ki11_requires_a_ledger(tmp_path):
+    from qba_tpu.analysis.atlas import check_atlas_store
+
+    store = AtlasStore(str(tmp_path / "bare"))
+    store.write_cell(_record(_fp()))
+    rep = check_atlas_store(store.root)
+    assert any(f.check == "ledger-missing" for f in rep.findings)
+
+
+# ---- content-addressed surface checkpoints (compat shim) ---------------
+
+
+def test_surface_checkpoints_content_addressed_with_legacy_shim(tmp_path):
+    from qba_tpu.config import QBAConfig
+    from qba_tpu.sweep import _config_fingerprint, run_surface
+
+    cfg = QBAConfig(n_parties=4, size_l=4, n_dishonest=1, trials=16,
+                    seed=3)
+    ckdir = str(tmp_path / "ck")
+    kw = dict(strategies=["reference"], noise_points=[(0.0, 0.0)],
+              size_ls=[4], n_chunks=2, chunk_trials=16,
+              checkpoint_dir=ckdir)
+    (cell,) = run_surface(cfg, **kw)
+    cfg_cell = dataclasses.replace(cfg, strategy="reference",
+                                   p_depolarize=0.0,
+                                   p_measure_flip=0.0, size_l=4)
+    addressed = os.path.join(
+        ckdir, cell_slug(_config_fingerprint(cfg_cell)) + ".json")
+    assert os.path.exists(addressed)
+
+    # resume from the addressed file
+    (resumed,) = run_surface(cfg, **kw)
+    assert resumed.result.resumed_chunks == 2
+    assert resumed.result.success_rate == cell.result.success_rate
+
+    # a pre-atlas checkpoint dir keeps resuming via its legacy name
+    legacy = os.path.join(ckdir, "surface_reference_p0.0_q0.0_L4.json")
+    os.replace(addressed, legacy)
+    (shimmed,) = run_surface(cfg, **kw)
+    assert shimmed.result.resumed_chunks == 2
+    assert shimmed.result.success_rate == cell.result.success_rate
+
+
+def test_run_surface_publishes_atlas_records(tmp_path):
+    from qba_tpu.analysis.atlas import check_atlas_store
+    from qba_tpu.config import QBAConfig
+    from qba_tpu.sweep import _config_fingerprint, run_surface
+
+    cfg = QBAConfig(n_parties=4, size_l=4, n_dishonest=1, trials=32,
+                    seed=3)
+    sdir = str(tmp_path / "atlas")
+    run_surface(cfg, strategies=["reference"],
+                noise_points=[(0.0, 0.0)], size_ls=[4], n_chunks=2,
+                chunk_trials=32, target="decide vs 1/3 @ 95%",
+                store_dir=sdir)
+    store = AtlasStore(sdir)
+    cfg_cell = dataclasses.replace(cfg, strategy="reference",
+                                   p_depolarize=0.0,
+                                   p_measure_flip=0.0, size_l=4)
+    rec = store.load_cell(cell_key(_config_fingerprint(cfg_cell)))
+    assert rec is not None
+    validate_cell_record(rec)
+    assert rec["status"] in ("certified", "refused")
+    assert rec["provenance"]["producer"] == "run_surface"
+    # no-target runs publish uncertified estimates (KI-8: never a bare
+    # rate) — and a ledgerless store is a collection, not an atlas
+    run_surface(cfg, strategies=["reference"],
+                noise_points=[(0.0, 0.0)], size_ls=[4], n_chunks=1,
+                chunk_trials=32, store_dir=str(tmp_path / "untgt"))
+    (name, urec), = AtlasStore(str(tmp_path / "untgt")).iter_cells()
+    assert urec["status"] == "uncertified"
+    assert not check_atlas_store(str(tmp_path / "untgt")).ok
+
+
+# ---- fleet campaign: worker SIGKILL ------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_campaign_survives_worker_sigkill(tmp_path):
+    """The acceptance story in miniature: a 2-replica supervised fleet
+    runs the campaign, one worker is SIGKILLed mid-stream, and the
+    campaign still certifies the whole cube with a store digest equal
+    to a clean local run (zero lost, zero duplicated cells)."""
+    import threading
+
+    from qba_tpu.analysis.atlas import check_atlas_store
+    from qba_tpu.atlas.campaign import CampaignDriver, FleetExecutor
+    from qba_tpu.serve.fleet import AdmissionController, ReplicaPool
+
+    spec = _spec(parties=(4, 5), dishonest=(0, 1))
+    ref_store, ref = _run_campaign(
+        str(tmp_path / "ref"), spec, str(tmp_path / "cache"))
+    assert ref["open"] == 0
+
+    qdir = str(tmp_path / "q")
+    pool = ReplicaPool(qdir, replicas=2, chunk_trials=spec.chunk_trials,
+                       reclaim_timeout_s=20.0, poll_s=0.02,
+                       cache_dir=str(tmp_path / "cache"))
+    pool.start()
+    killed = {}
+
+    def chaos(i, payload):
+        if not killed:
+            alive = pool.alive()
+            if alive:
+                killed["pid"] = pool.kill(alive[-1])
+
+    store = AtlasStore(str(tmp_path / "fleet"))
+    driver = CampaignDriver(
+        store, spec, FleetExecutor(qdir),
+        admission=AdmissionController(chunk_trials=spec.chunk_trials,
+                                      replicas=2),
+        on_result=chaos, idle_timeout_s=240.0,
+    )
+    try:
+        summary = driver.run()
+    finally:
+        pool.stop()
+    assert killed, "chaos hook never fired"
+    assert summary["open"] == 0
+    assert summary["store_digest"] == ref["store_digest"]
+    report = check_atlas_store(store.root)
+    assert report.ok, report.render()

@@ -219,6 +219,39 @@ def test_retry_defers_do_not_inflate_decision_ledger():
     assert ac.outstanding_trials == 8  # record=False still prices admits
 
 
+def test_batch_admission_records_one_defer_per_wait():
+    # The atlas campaign driver re-offers its whole ranked frontier
+    # queue on every loop sweep (``batch=True``): a request deferred N
+    # times across N sweeps lands in the ledger exactly once per
+    # *wait*, so the decision list stays a pure function of the
+    # request stream + settle points — not of the driver's poll
+    # cadence (docs/SERVING.md "Batch admission").
+    def stream(ac):
+        ac.try_admit(_req("A", trials=16), batch=True)
+        for _ in range(5):  # five sweeps re-offer B: one recorded DEFER
+            assert ac.try_admit(
+                _req("B", trials=8), batch=True).action == DEFER
+        assert [d.action for d in ac.decisions] == [ADMIT, DEFER]
+        ac.settle("A")
+        assert ac.try_admit(
+            _req("B", trials=8), batch=True).action == ADMIT
+        # a defer AFTER an admit opens a new wait: recorded again
+        for _ in range(3):
+            assert ac.try_admit(
+                _req("C", trials=16), batch=True).action == DEFER
+        ac.settle("B")
+        assert ac.try_admit(
+            _req("C", trials=16), batch=True).action == ADMIT
+        return [(d.action, d.request_id) for d in ac.decisions]
+
+    first = stream(_controller())  # capacity 16
+    assert first == [
+        (ADMIT, "A"), (DEFER, "B"), (ADMIT, "B"), (DEFER, "C"),
+        (ADMIT, "C"),
+    ]
+    assert first == stream(_controller())  # replay: bit-identical
+
+
 def test_admission_settle_is_idempotent_and_releases():
     ac = _controller()
     ac.try_admit(_req("A", trials=16))
