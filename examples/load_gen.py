@@ -300,6 +300,14 @@ def run_socket(args, stream):
                 if args.chaos_flap and pool.benched:
                     break
                 time.sleep(0.2)
+        # Final metrics scrape: the same text ``GET /metrics`` serves
+        # (one render path), taken before teardown so the report embeds
+        # the end-of-stream counter state.  Collectors still see the
+        # live queue dir here.
+        from qba_tpu.obs.metrics import validate_exposition
+
+        args._metrics_text = frontend.metrics.render()
+        args._metrics_errors = validate_exposition(args._metrics_text)
     finally:
         os.environ.pop(CRASH_HOOK_ENV, None)
         frontend.stop_in_thread()
@@ -362,7 +370,9 @@ def main(argv=None):
                     "compiles!) or live claims get double-served")
     ap.add_argument("--report-json", default=None,
                     help="write {rpm, p50_s, p99_s, results, replicas} "
-                    "to this file (CI compares 1- vs 2-replica rates)")
+                    "to this file (CI compares 1- vs 2-replica rates); "
+                    "socket transport also embeds the final /metrics "
+                    "scrape and the stitched-trace summary")
     ap.add_argument("--queue-dir", default=None)
     ap.add_argument("--telemetry", default=None,
                     help="per-request manifest/trace directory")
@@ -474,6 +484,37 @@ def main(argv=None):
         if admitted:
             print(f"admission:       {len(admitted)}/{len(results)} "
                   "results carry a typed admission decision")
+
+        # Metrics plane: the final scrape must be valid Prometheus
+        # text exposition — an invalid page means every dashboard on
+        # it silently flatlines, so fail the run here.
+        exposition_errors = getattr(args, "_metrics_errors", None)
+        if exposition_errors:
+            raise SystemExit(
+                f"/metrics exposition invalid: {exposition_errors[:3]}"
+            )
+        if getattr(args, "_metrics_text", None):
+            n_samples = sum(
+                1 for line in args._metrics_text.splitlines()
+                if line and not line.startswith("#")
+            )
+            print(f"metrics:         {n_samples} samples, "
+                  "exposition valid")
+
+        # Tracing plane: every request resolved one stitched trace,
+        # and no worker span is orphaned from its intake.
+        traces = (getattr(args, "_fleet_summary", None) or {}).get("traces")
+        if traces:
+            if traces["orphan_spans"]:
+                raise SystemExit(
+                    f"{traces['orphan_spans']} orphan worker span(s): "
+                    "trace context was dropped between intake and worker"
+                )
+            cov = traces.get("coverage") or {}
+            print(f"traces:          {traces['count']} stitched "
+                  f"({traces['closed']} closed, 0 orphan spans"
+                  + (f", coverage p50 {cov['p50']:.0%}" if cov else "")
+                  + ")")
 
         # Chaos postconditions: bounded blast radius, proven from the
         # fleet summary + the crash reports on the wire (KI-9).
@@ -606,6 +647,16 @@ def main(argv=None):
                     "served_by": sorted(
                         {str(r.get("replica_id")) for r in results}
                     ),
+                    # Final /metrics scrape (socket transport): the
+                    # Prometheus page as served, plus any exposition
+                    # errors (empty list = valid page).
+                    "metrics": getattr(args, "_metrics_text", None),
+                    "metrics_exposition_errors": getattr(
+                        args, "_metrics_errors", None
+                    ),
+                    "traces": (
+                        getattr(args, "_fleet_summary", None) or {}
+                    ).get("traces"),
                 },
                 f,
                 indent=1,

@@ -34,6 +34,12 @@ mechanizes (``docs/KNOWN_ISSUES.md``):
   explicit refusal/truncation finding, a record/ledger/content-address
   disagreement, or a slice whose frontier CI widths exceed the
   interior's (:mod:`qba_tpu.analysis.atlas`, docs/ATLAS.md).
+* ``KI-12`` — dark time in the observability plane: a trace id minted
+  outside the registered frontend mint sites (a mid-request re-mint
+  orphans every span under it), an emission of an unregistered metric
+  name, a queue hop that drops trace context, or request span coverage
+  below the floor (:mod:`qba_tpu.analysis.obs`,
+  docs/OBSERVABILITY.md).
 
 A *note* is an informational line the report carries alongside the
 findings (plan predictions, probe-counter reality checks) — notes
@@ -45,7 +51,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterable
 
-KI_TAGS = ("KI-1", "KI-2", "KI-3", "KI-5", "KI-6", "KI-8", "KI-10", "KI-11")
+KI_TAGS = (
+    "KI-1", "KI-2", "KI-3", "KI-5", "KI-6", "KI-8", "KI-10", "KI-11",
+    "KI-12",
+)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -35,6 +35,7 @@ enumerated cell must end certified or refused.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 from typing import Any, Callable
@@ -53,9 +54,25 @@ from qba_tpu.atlas.store import (
     AtlasStore,
     record_satisfies,
 )
+from qba_tpu.obs.metrics import MetricsRegistry
+from qba_tpu.obs.tracing import mint_span_id, mint_trace_id
 from qba_tpu.serve.fleet.admission import ADMIT, DEFER, AdmissionController
 from qba_tpu.serve.queuefs import drop_request, queue_paths, request_slug
 from qba_tpu.serve.request import EvalRequest, EvalResult
+
+
+def _stamp_trace(req: EvalRequest) -> EvalRequest:
+    """Mint trace context for one atlas cell request.
+
+    The campaign driver is this request's frontend — no fleet intake
+    ever sees it before the queue file — so the trace id is born here
+    and only *adopted* downstream (KI-12 registered mint site; see
+    qba_tpu/analysis/obs.py MINT_SITES)."""
+    if req.trace_id:
+        return req
+    return dataclasses.replace(
+        req, trace_id=mint_trace_id(), parent_span_id=mint_span_id()
+    )
 
 
 class LocalExecutor:
@@ -191,10 +208,14 @@ class CampaignDriver:
         idle_timeout_s: float = 180.0,
         max_results: int | None = None,
         on_result: Callable[[int, dict[str, Any]], None] | None = None,
+        metrics: MetricsRegistry | None = None,
     ) -> None:
         self.store = store
         self.spec = spec
         self.executor = executor
+        # Driver-owned metrics plane: campaign outcomes and budget spend
+        # land in the same registered-name table the fleet exposes.
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.admission = admission or AdmissionController(
             chunk_trials=spec.chunk_trials
         )
@@ -333,6 +354,12 @@ class CampaignDriver:
             self.executor.ack(rid)  # duplicate delivery or stale attempt
             return False
         self.admission.settle(rid, res.n_trials)
+        if res.n_trials:
+            self.metrics.inc(
+                "qba_atlas_budget_trials_total",
+                float(res.n_trials),
+                exemplar=res.trace_id,
+            )
         if res.error:
             refusal = {
                 "reason": (
@@ -353,6 +380,11 @@ class CampaignDriver:
                 entry["attempt"] += 1
                 entry["status"] = "pending"
                 entry["request_id"] = None
+                self.metrics.inc(
+                    "qba_atlas_cells_total",
+                    labels={"status": "escalated"},
+                    exemplar=res.trace_id,
+                )
                 self.log(
                     f"atlas: {key} unresolved at {res.n_trials} trials; "
                     f"escalating to wave {entry['attempt']}"
@@ -420,6 +452,11 @@ class CampaignDriver:
         entry["refusal"] = refusal
         entry["successes"] = res.successes
         entry["n_trials"] = res.n_trials
+        self.metrics.inc(
+            "qba_atlas_cells_total",
+            labels={"status": status},
+            exemplar=res.trace_id,
+        )
 
     def _refuse_admission(
         self, ledger: dict[str, Any], key: str, decision
@@ -457,6 +494,9 @@ class CampaignDriver:
         self.store.write_cell(record)
         entry["status"] = "refused"
         entry["refusal"] = refusal
+        self.metrics.inc(
+            "qba_atlas_cells_total", labels={"status": "refused"}
+        )
 
     def _save(self, ledger: dict[str, Any]) -> None:
         self.store.save_ledger(ledger)
@@ -494,9 +534,9 @@ class CampaignDriver:
                 ledger["steering"] = plan
                 for key in ranked:
                     entry = ledger["cells"][key]
-                    req = build_request(
+                    req = _stamp_trace(build_request(
                         self.cells[key], self.spec, entry["attempt"]
-                    )
+                    ))
                     dec = self.admission.try_admit(req, batch=True)
                     entry["admission"] = dec.to_json()
                     if dec.action == ADMIT:
@@ -574,4 +614,12 @@ class CampaignDriver:
             "results_processed": self.results_processed,
             "admission": self.admission.summary(),
             "store_digest": self.store.digest(),
+            "metrics": {
+                "escalated": self.metrics.counter_value(
+                    "qba_atlas_cells_total", {"status": "escalated"}
+                ),
+                "budget_trials": self.metrics.counter_value(
+                    "qba_atlas_budget_trials_total"
+                ),
+            },
         }

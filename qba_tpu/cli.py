@@ -341,6 +341,29 @@ def _parser() -> argparse.ArgumentParser:
         "(docs/ATLAS.md)",
     )
     lint.add_argument(
+        "--obs", action="store_true",
+        help="also run the KI-12 observability-plane audit: mint-site "
+        "closure (trace ids born only at the registered request "
+        "origins), metric-name registration against the one METRICS "
+        "table, trace-context propagation through every queue hop, "
+        "and the engine's span wall-clock anchoring "
+        "(docs/OBSERVABILITY.md)",
+    )
+    lint.add_argument(
+        "--obs-queue-dir", metavar="DIR", default=None,
+        help="KI-12 dynamic half: stitch this fleet queue dir's traces "
+        "and fail on orphan spans or closed traces below the span-"
+        "coverage floor",
+    )
+    lint.add_argument(
+        "--obs-telemetry", metavar="DIR", default=None,
+        help="telemetry root for --obs-queue-dir (worker span files)",
+    )
+    lint.add_argument(
+        "--obs-coverage-floor", type=float, default=None,
+        help="span-coverage floor for --obs-queue-dir (default 0.8)",
+    )
+    lint.add_argument(
         "--findings-json", metavar="PATH", default=None,
         help="write the full report (findings, notes, stats) as JSON "
         "to PATH — the CI lint job uploads this as an artifact",
@@ -685,6 +708,32 @@ def _parser() -> argparse.ArgumentParser:
         "--plot", metavar="DIR", default=None,
         help="also render per-slice PNGs + the KI-7 fence figure into "
         "DIR (requires matplotlib)",
+    )
+
+    trace = sub.add_parser(
+        "trace",
+        help="stitch one fleet run's lifecycle events + worker span "
+        "files into causal per-request traces; print the summary or "
+        "export Perfetto-loadable trace JSON (docs/OBSERVABILITY.md)",
+    )
+    trace.add_argument(
+        "trace_id", nargs="?", default=None,
+        help="a trace id (or request id) to select; omitted = all "
+        "stitched traces",
+    )
+    trace.add_argument(
+        "--queue-dir", metavar="DIR", required=True,
+        help="the fleet queue directory (holds trace-events.jsonl)",
+    )
+    trace.add_argument(
+        "--telemetry", metavar="DIR", default=None,
+        help="per-request telemetry root with the worker span files; "
+        "without it traces stitch from lifecycle events alone",
+    )
+    trace.add_argument(
+        "--out", metavar="PATH", default=None,
+        help="write Chrome/Perfetto trace-event JSON here instead of "
+        "printing the stitched summary",
     )
 
     study = sub.add_parser(
@@ -1258,6 +1307,24 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
         from qba_tpu.analysis.atlas import check_atlas_store
 
         report.extend(check_atlas_store(args.atlas_store))
+    if args.obs:
+        from qba_tpu.analysis.obs import check_obs
+
+        report.extend(check_obs())
+    if args.obs_queue_dir:
+        from qba_tpu.analysis.obs import COVERAGE_FLOOR, check_span_coverage
+
+        report.extend(
+            check_span_coverage(
+                args.obs_queue_dir,
+                telemetry_dir=args.obs_telemetry,
+                floor=(
+                    args.obs_coverage_floor
+                    if args.obs_coverage_floor is not None
+                    else COVERAGE_FLOOR
+                ),
+            )
+        )
     print(report.render(verbose=args.verbose), file=out)
     if args.findings_json:
         import dataclasses
@@ -1268,6 +1335,7 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
             "ok": report.ok,
             "effects": bool(args.effects),
             "protocol": bool(args.protocol),
+            "obs": bool(args.obs),
             "findings": [dataclasses.asdict(f) for f in report.findings],
             "notes": report.notes,
             "stats": {
@@ -1279,6 +1347,66 @@ def _cmd_lint(args: argparse.Namespace, out) -> int:
             json.dump(payload, fh, indent=2)
         print(f"findings json: {args.findings_json}", file=out)
     return 0 if report.ok else 1
+
+
+def _cmd_trace(args: argparse.Namespace, out) -> int:
+    import json
+
+    from qba_tpu.obs.tracing import (
+        stitch_traces,
+        stitched_chrome_trace,
+        trace_summary,
+    )
+
+    stitched = stitch_traces(args.queue_dir, telemetry_dir=args.telemetry)
+    traces = stitched["traces"]
+    selected = sorted(traces)
+    if args.trace_id is not None:
+        selected = [
+            tid for tid, t in traces.items()
+            if tid == args.trace_id
+            or tid.startswith(args.trace_id)
+            or t.get("request_id") == args.trace_id
+        ]
+        if not selected:
+            print(
+                f"error: no stitched trace matches {args.trace_id!r} "
+                f"({len(traces)} trace(s) in {args.queue_dir})",
+                file=sys.stderr,
+            )
+            return 1
+    if args.out:
+        chrome = stitched_chrome_trace(stitched, trace_ids=selected)
+        with open(args.out, "w") as fh:
+            json.dump(chrome, fh, indent=1)
+        print(
+            json.dumps(
+                {
+                    "trace_json": args.out,
+                    "traces": len(selected),
+                    "events": len(chrome["traceEvents"]),
+                }
+            ),
+            file=out,
+        )
+        return 0
+    payload = {
+        "summary": trace_summary(stitched),
+        "traces": [
+            {
+                "trace_id": tid,
+                "request_id": traces[tid].get("request_id"),
+                "closed": traces[tid]["closed"],
+                "dur_s": round(traces[tid]["dur"], 6),
+                "coverage": traces[tid]["coverage"],
+                "segments": traces[tid]["segments"],
+                "events": [e["event"] for e in traces[tid]["events"]],
+            }
+            for tid in selected
+        ],
+    }
+    print(json.dumps(payload, indent=1, default=str), file=out)
+    return 0
 
 
 def _cmd_atlas(args: argparse.Namespace, out) -> int:
@@ -1640,6 +1768,8 @@ def main(argv: Sequence[str] | None = None, out=None) -> int:
             return _cmd_fleet(args, out)
         if args.command == "atlas":
             return _cmd_atlas(args, out)
+        if args.command == "trace":
+            return _cmd_trace(args, out)
     except ValueError as e:  # config validation -> clean CLI failure
         print(f"error: {e}", file=sys.stderr)
         return 2

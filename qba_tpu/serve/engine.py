@@ -141,6 +141,10 @@ class QBAServer:
         # lifecycle phase (compile/dispatch/readback here; idle/claim in
         # the transport loop) for the supervisor's watchdog.
         self.heartbeat = None
+        # Also transport-set: a queuefs.FlightRecorder ring, flushed
+        # atomically beside the heartbeat on every note — the crash
+        # evidence the supervisor embeds in KI-9 crash reports.
+        self.flight = None
         self.telemetry_dir = telemetry_dir
         self.cache_dir = cache_dir
         self.recorder = SpanRecorder()  # server-level chunk spans
@@ -196,7 +200,18 @@ class QBAServer:
             request_id=req.request_id,
             bucket=bucket_label(bucket),
             trials=cfg.trials,
+            # Wall-clock anchor: SpanRecorder time is perf_counter
+            # seconds, meaningless across processes.  The stitcher
+            # (qba_tpu.obs.tracing) shifts this file's spans onto the
+            # epoch axis by t0_epoch - root.t0.
+            t0_epoch=time.time(),
         )
+        if req.trace_id is not None:
+            # Adopt — never re-mint (KI-12) — the trace id that rode
+            # the queue file from the frontend/campaign minting site.
+            span_args["trace_id"] = req.trace_id
+        if req.parent_span_id is not None:
+            span_args["parent_span_id"] = req.parent_span_id
         if self.replica_id is not None:
             span_args["replica_id"] = self.replica_id
         if queue_wait_s is not None:
@@ -242,6 +257,12 @@ class QBAServer:
             dispatch="device" if device_mode else "host",
             key_data=key_data if device_mode else None,
         )
+        if self.flight is not None:
+            self.flight.note(
+                "submit", request_id=req.request_id,
+                trace_id=req.trace_id, bucket=span_args["bucket"],
+                trials=cfg.trials,
+            )
 
     # ---- dispatch / drain --------------------------------------------
     def pump(self) -> list[EvalResult]:
@@ -336,6 +357,7 @@ class QBAServer:
         res.manifest = manifest
         res.replica_id = self.replica_id
         res.queue_wait_s = ar.queue_wait_s
+        res.trace_id = ar.req.trace_id
         if ar.rule is not None and ar.filled:
             # Partial-progress estimate for a timed-out targeted
             # request: anytime-valid over the prefix it did complete.
@@ -502,6 +524,14 @@ class QBAServer:
                 else "dispatch",
                 sorted({seg.request_id for seg in chunk.segments}),
             )
+        if self.flight is not None:
+            self.flight.note(
+                "compile"
+                if chunk.bucket not in self._bucket_decisions
+                else "dispatch",
+                bucket=label, chunk=chunk.index,
+                request_ids=sorted({seg.request_id for seg in chunk.segments}),
+            )
         if chunk.bucket not in self._bucket_decisions:
             # First dispatch of this bucket: capture the live resolver
             # decisions so every request served from it can carry them
@@ -527,6 +557,11 @@ class QBAServer:
         if self.heartbeat is not None:
             self.heartbeat.beat(
                 "readback", sorted({seg.request_id for seg in chunk.segments})
+            )
+        if self.flight is not None:
+            self.flight.note(
+                "readback", bucket=label, chunk=chunk.index,
+                request_ids=sorted({seg.request_id for seg in chunk.segments}),
             )
         with self.recorder.span(
             "serve.readback", cat="serve", bucket=label, chunk=chunk.index
@@ -636,6 +671,11 @@ class QBAServer:
         )
         if self.telemetry_dir is not None:
             self._write_telemetry(ar, manifest)
+        if self.flight is not None:
+            self.flight.note(
+                "finish", request_id=ar.req.request_id,
+                trace_id=ar.req.trace_id, latency_s=latency,
+            )
         # The device loop reduces on device and never materializes
         # per-trial decisions — its eligibility gate already excluded
         # return_decisions requests.
@@ -665,6 +705,7 @@ class QBAServer:
             ),
             replica_id=self.replica_id,
             queue_wait_s=ar.queue_wait_s,
+            trace_id=ar.req.trace_id,
         )
 
     def _write_telemetry(self, ar: _Active, manifest: dict) -> None:

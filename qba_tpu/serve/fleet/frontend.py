@@ -30,8 +30,15 @@ import threading
 from collections import deque
 from typing import Any
 
+from qba_tpu.obs.metrics import MetricsRegistry
+from qba_tpu.obs.tracing import TraceEventLog, mint_span_id, mint_trace_id
 from qba_tpu.serve.fleet.admission import ADMIT, DEFER, AdmissionController
-from qba_tpu.serve.queuefs import drop_request, queue_paths, result_path
+from qba_tpu.serve.queuefs import (
+    drop_request,
+    heartbeat_ages,
+    queue_paths,
+    result_path,
+)
 from qba_tpu.serve.request import EvalRequest, EvalResult
 from qba_tpu.serve.timing import FRONTEND_POLL_S
 
@@ -50,6 +57,7 @@ class FleetFrontend:
         request_prefix: str = "fl",
         max_requests: int | None = None,
         health_provider=None,
+        metrics: MetricsRegistry | None = None,
     ) -> None:
         self.queue_dir = queue_dir
         self.paths = queue_paths(queue_dir)
@@ -67,6 +75,16 @@ class FleetFrontend:
         # grow a pool/process dependency — and check_fleet keeps
         # proving it device-free either way.
         self.health_provider = health_provider
+        # Live metrics plane (docs/OBSERVABILITY.md): push counters at
+        # the decision points below, pull point-in-time gauges from the
+        # queue dir at scrape time.  ``GET /metrics`` renders this.
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.metrics.add_collector(self._collect_queue_metrics)
+        # Lifecycle event log: the frontend is the minting site for
+        # trace ids (KI-12 registered site) and stamps intake /
+        # admission / settle onto each trace's timeline.
+        self.trace_log = TraceEventLog(queue_dir)
+        self._trace_ids: dict[str, str] = {}  # rid -> trace_id
         self._ids = itertools.count()
         self._prefix = request_prefix
         self._futures: dict[str, asyncio.Future] = {}
@@ -175,54 +193,81 @@ class FleetFrontend:
         immediately)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         rid = str(payload.get("request_id") or self._assign_id())
-        payload = {**payload, "request_id": rid}
+        # Trace context is minted HERE (the one registered fleet-side
+        # minting site — KI-12 proves there are no others) before any
+        # refusal branch, so even a rejected request gets a closed
+        # trace.  A client-supplied trace id is adopted, not replaced:
+        # identity travels with the trace.
+        trace_id = str(payload.get("trace_id") or mint_trace_id())
+        intake_span = str(payload.get("parent_span_id") or mint_span_id())
+        payload = {**payload, "request_id": rid, "trace_id": trace_id,
+                   "parent_span_id": intake_span}
+        self.metrics.inc("qba_intake_requests_total", exemplar=trace_id)
+        self.trace_log.emit("intake", trace_id, rid, span_id=intake_span)
+
+        def _refuse(error: str, reason: str,
+                    decision_json: dict[str, Any] | None = None) -> None:
+            res = EvalResult.failure(rid, error)
+            res.trace_id = trace_id
+            res.admission = decision_json
+            self.trace_log.emit("reject", trace_id, rid, reason=reason)
+            self.trace_log.emit("settle", trace_id, rid, outcome="rejected")
+            fut.set_result(res.to_json())
+
         if rid in self._futures:
-            fut.set_result(
-                EvalResult.failure(
-                    rid, f"request id already pending: {rid!r}"
-                ).to_json()
-            )
+            _refuse(f"request id already pending: {rid!r}", "duplicate_id")
             return rid, fut
         if os.path.exists(result_path(self.paths["outbox"], rid)):
             # A leftover result under this id (client id reuse, or a
             # previous fleet run over the same queue dir) would resolve
             # this request instantly with the stale payload while the
             # fresh one still executes — refuse instead.
-            fut.set_result(
-                EvalResult.failure(
-                    rid,
-                    f"request id {rid!r} already has a result in the "
-                    "outbox (id reuse over a live queue dir); pick a "
-                    "fresh id",
-                ).to_json()
+            _refuse(
+                f"request id {rid!r} already has a result in the "
+                "outbox (id reuse over a live queue dir); pick a "
+                "fresh id",
+                "stale_result",
             )
             return rid, fut
         try:
             req = EvalRequest.from_json(payload)
         except (ValueError, TypeError) as e:
-            fut.set_result(EvalResult.failure(rid, str(e)).to_json())
+            _refuse(str(e), "undecodable")
             return rid, fut
         self.requests_seen += 1
         if self.admission is None:
             self._futures[rid] = fut
+            self._trace_ids[rid] = trace_id
+            self.trace_log.emit("admit", trace_id, rid)
             drop_request(self.paths["inbox"], req.to_json(), rid)
             self._maybe_close_intake()
             return rid, fut
         decision = self.admission.try_admit(req)
+        self.metrics.inc(
+            "qba_admission_decisions_total",
+            labels={"action": str(decision.action),
+                    "reason": str(decision.reason or "ok")},
+            exemplar=trace_id,
+        )
         if decision.action == ADMIT:
             self._futures[rid] = fut
             self._admitted[rid] = decision.to_json()
+            self._trace_ids[rid] = trace_id
+            self.trace_log.emit("admit", trace_id, rid,
+                                reason=decision.reason)
             drop_request(self.paths["inbox"], req.to_json(), rid)
         elif decision.action == DEFER:
             self._futures[rid] = fut
             self._admitted[rid] = decision.to_json()
+            self._trace_ids[rid] = trace_id
+            self.trace_log.emit("defer", trace_id, rid,
+                                reason=decision.reason)
             self._deferred.append(req)
         else:
-            res = EvalResult.failure(
-                rid, f"rejected: {decision.reason} ({decision.detail})"
+            _refuse(
+                f"rejected: {decision.reason} ({decision.detail})",
+                str(decision.reason), decision.to_json(),
             )
-            res.admission = decision.to_json()
-            fut.set_result(res.to_json())
         self._maybe_close_intake()
         return rid, fut
 
@@ -260,6 +305,23 @@ class FleetFrontend:
                     self.admission.settle(rid, payload.get("n_trials"))
                     self._release.set()
                 self.results_forwarded += 1
+                trace_id = self._trace_ids.pop(rid, None) or payload.get(
+                    "trace_id"
+                )
+                outcome = "error" if payload.get("error") else "ok"
+                self.metrics.inc("qba_results_forwarded_total",
+                                 labels={"outcome": outcome},
+                                 exemplar=trace_id)
+                for metric, key in (
+                    ("qba_request_latency_seconds", "latency_s"),
+                    ("qba_request_queue_wait_seconds", "queue_wait_s"),
+                ):
+                    value = payload.get(key)
+                    if isinstance(value, (int, float)):
+                        self.metrics.observe(metric, float(value),
+                                             exemplar=trace_id)
+                self.trace_log.emit("settle", trace_id, rid,
+                                    outcome=outcome)
                 fut.set_result(payload)
                 try:
                     # Consume the result file: a forwarded result left
@@ -303,16 +365,32 @@ class FleetFrontend:
                 self._deferred.popleft()
                 rid = req.request_id
                 self._admitted[rid] = decision.to_json()
+                trace_id = self._trace_ids.get(rid) or req.trace_id
+                self.metrics.inc(
+                    "qba_admission_decisions_total",
+                    labels={"action": str(decision.action),
+                            "reason": str(decision.reason or "ok")},
+                    exemplar=trace_id,
+                )
                 if decision.action == ADMIT:
+                    self.trace_log.emit("admit", trace_id, rid,
+                                        reason=decision.reason,
+                                        deferred=True)
                     drop_request(self.paths["inbox"], req.to_json(), rid)
                 else:  # became unservable — resolve the waiting future
                     fut = self._futures.pop(rid, None)
                     self._admitted.pop(rid, None)
+                    self._trace_ids.pop(rid, None)
+                    self.trace_log.emit("reject", trace_id, rid,
+                                        reason=decision.reason)
+                    self.trace_log.emit("settle", trace_id, rid,
+                                        outcome="rejected")
                     if fut is not None and not fut.done():
                         res = EvalResult.failure(
                             rid,
                             f"rejected: {decision.reason} ({decision.detail})",
                         )
+                        res.trace_id = trace_id
                         res.admission = decision.to_json()
                         fut.set_result(res.to_json())
             self._maybe_close_intake()
@@ -379,9 +457,13 @@ class FleetFrontend:
             await asyncio.gather(*pending)
 
     async def _handle_http(self, request_line: str, reader, writer) -> None:
-        """Minimal HTTP: ``GET`` -> status JSON; ``POST`` (JSONL body)
+        """Minimal HTTP: ``GET /metrics`` -> Prometheus text,
+        ``GET`` anything else -> status JSON; ``POST`` (JSONL body)
         -> 200 with one result line per request."""
-        method = request_line.split(" ", 1)[0]
+        parts = request_line.split(" ")
+        method = parts[0]
+        path = parts[1] if len(parts) > 1 else "/"
+        content_type = b"application/json"
         length = 0
         while True:
             line = await reader.readline()
@@ -393,7 +475,10 @@ class FleetFrontend:
                     length = int(value.strip())
                 except ValueError:
                     pass
-        if method == "GET":
+        if method == "GET" and path.split("?", 1)[0] == "/metrics":
+            content_type = b"text/plain; version=0.0.4; charset=utf-8"
+            body = self.metrics.render().encode()
+        elif method == "GET":
             body = json.dumps(self.status(), default=str).encode()
         else:
             raw = await reader.readexactly(length) if length else b""
@@ -419,7 +504,7 @@ class FleetFrontend:
             body = "".join(json.dumps(r) + "\n" for r in results).encode()
         writer.write(
             b"HTTP/1.1 200 OK\r\n"
-            b"Content-Type: application/json\r\n"
+            b"Content-Type: " + content_type + b"\r\n"
             b"Content-Length: " + str(len(body)).encode() + b"\r\n"
             b"Connection: close\r\n\r\n" + body
         )
@@ -436,9 +521,89 @@ class FleetFrontend:
                 self.admission.summary() if self.admission is not None else None
             ),
         }
+        # Per-replica heartbeat staleness in seconds (monotonic now
+        # minus last stamp) — reported whether or not a supervisor is
+        # attached; with one, the health class rides along.
+        ages = heartbeat_ages(self.queue_dir)
         if self.health_provider is not None:
             try:
-                out["replicas"] = self.health_provider()
+                replicas = self.health_provider()
+                for rid, verdict in replicas.items():
+                    if isinstance(verdict, dict):
+                        verdict["staleness_s"] = (
+                            verdict.get("beat_age_s")
+                            if verdict.get("beat_age_s") is not None
+                            else ages.get(rid)
+                        )
+                out["replicas"] = replicas
             except Exception as e:  # status must never take the socket down
                 out["replicas"] = {"error": str(e)}
+        elif ages:
+            out["replicas"] = {
+                rid: {"staleness_s": age} for rid, age in sorted(ages.items())
+            }
         return out
+
+    # ---- metrics collection ------------------------------------------
+    def _collect_queue_metrics(self, reg: MetricsRegistry) -> None:
+        """Scrape-time gauges from the queue dir: depth per box,
+        dead letters, reclaims, heartbeat staleness, health classes,
+        crash-ledger totals.  Read-only — workers publish through the
+        files they already write, never a new socket."""
+        for box in ("inbox", "claimed", "outbox", "dead", "consumed",
+                    "done"):
+            try:
+                depth = len(os.listdir(self.paths[box]))
+            except OSError:
+                depth = 0
+            reg.set_gauge("qba_queue_files", depth, labels={"box": box})
+            if box == "dead":
+                reg.set_gauge("qba_queue_dead_letters", depth)
+        for rid, age in heartbeat_ages(self.queue_dir).items():
+            reg.set_gauge("qba_replica_heartbeat_staleness_seconds",
+                          age, labels={"replica": rid})
+        reclaims = 0
+        try:
+            names = os.listdir(self.queue_dir)
+        except OSError:
+            names = []
+        for name in names:
+            if name.startswith("summary-") and name.endswith(".json"):
+                try:
+                    with open(os.path.join(self.queue_dir, name)) as f:
+                        reclaims += int(json.load(f).get("reclaimed", 0))
+                except (OSError, ValueError, TypeError):
+                    pass
+        try:
+            with open(self.paths["crash_ledger"]) as f:
+                ledger = json.load(f)
+        except (OSError, ValueError):
+            ledger = None
+        if isinstance(ledger, dict):
+            blame = ledger.get("blame", {})
+            if isinstance(blame, dict):
+                reclaims += sum(
+                    int(e.get("releases", 0)) for e in blame.values()
+                    if isinstance(e, dict)
+                )
+                reg.set_gauge(
+                    "qba_supervisor_quarantined",
+                    sum(1 for e in blame.values()
+                        if isinstance(e, dict) and e.get("quarantined")),
+                )
+            deaths = ledger.get("deaths")
+            if isinstance(deaths, list):
+                reg.set_gauge("qba_supervisor_deaths", len(deaths))
+        reg.set_gauge("qba_queue_reclaims", reclaims)
+        if self.health_provider is not None:
+            try:
+                states: dict[str, int] = {}
+                for verdict in self.health_provider().values():
+                    if isinstance(verdict, dict):
+                        state = str(verdict.get("state", "unknown"))
+                        states[state] = states.get(state, 0) + 1
+                for state, count in states.items():
+                    reg.set_gauge("qba_fleet_replicas", count,
+                                  labels={"state": state})
+            except Exception:
+                pass

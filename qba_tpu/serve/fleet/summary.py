@@ -26,6 +26,7 @@ import os
 from typing import Any
 
 from qba_tpu.obs.telemetry import span_latency_summary, spans_from_jsonl
+from qba_tpu.obs.tracing import stitch_traces, trace_summary
 from qba_tpu.serve.queuefs import queue_paths, write_json_atomic
 
 FLEET_SUMMARY_SCHEMA = "qba-tpu/fleet-summary/v1"
@@ -216,6 +217,12 @@ def fleet_summary(
             "count": len(merged),
             "request": span_latency_summary(merged, "request"),
         }
+    # Stitched-trace satellite: one causally-ordered trace per request
+    # (intake -> settle) with an orphan-span count that a healthy run
+    # must hold at zero, plus span-coverage percentiles.
+    stitched = stitch_traces(queue_dir, telemetry_dir=telemetry_dir)
+    if stitched["traces"] or stitched["orphan_spans"]:
+        summary["traces"] = trace_summary(stitched)
     return summary
 
 

@@ -47,8 +47,10 @@ import threading
 import time
 from typing import Any
 
+from qba_tpu.obs.tracing import TraceEventLog
 from qba_tpu.serve.queuefs import (
     queue_paths,
+    read_flight_recorder,
     read_heartbeat,
     request_slug,
     result_path,
@@ -124,6 +126,14 @@ class FleetSupervisor:
             else BOOT_GRACE_SCALE * watchdog_s
         )
         self._clock = clock
+        # Lifecycle trace events (docs/OBSERVABILITY.md): kill /
+        # death / release / quarantine stamps carrying the blamed
+        # request's trace id, so a stitched trace shows the
+        # supervisor's interventions on the request's own timeline.
+        self.trace_log = TraceEventLog(self.queue_dir)
+        #: Tail length of the dead worker's flight recorder embedded in
+        #: death events and KI-9 crash reports.
+        self.flight_tail = 16
         self._first_seen: dict[tuple[str, int], float] = {}
         self._handled_deaths: set[tuple[str, int]] = set()
         self._death_events: list[dict[str, Any]] = []
@@ -206,6 +216,16 @@ class FleetSupervisor:
             }
             self.hung_killed.append(event)
             killed.append(rid)
+            for req_id in v.get("request_ids") or [None]:
+                trace_id, request_id = (
+                    self._trace_of(request_slug(req_id))
+                    if req_id is not None else (None, None)
+                )
+                self.trace_log.emit(
+                    "kill", trace_id, request_id or req_id,
+                    replica_id=rid, pid=v["pid"], phase=v.get("phase"),
+                    beat_age_s=v.get("beat_age_s"),
+                )
         deaths = self._handle_deaths()
         benched = self._trip_breaker()
         respawned = self.pool.respawn_dead()
@@ -258,8 +278,25 @@ class FleetSupervisor:
                 "at": self._clock(),
                 "wall": time.time(),
             }
+            # Capture the flight-recorder tail NOW: a respawn of this
+            # slot will overwrite flight-<slug>.json, but the death
+            # event (and any crash report built from it) must keep the
+            # dead incarnation's last moments.
+            event["flight_recorder"] = read_flight_recorder(
+                self.queue_dir, r.replica_id, tail=self.flight_tail
+            )
             self._death_events.append(event)
             new.append(event)
+            for rid in rids or [None]:
+                trace_id, request_id = (
+                    self._trace_of(request_slug(rid))
+                    if rid is not None else (None, None)
+                )
+                self.trace_log.emit(
+                    "death", trace_id, request_id or rid,
+                    replica_id=r.replica_id, pid=r.proc.pid,
+                    exit_code=exit_code, phase=phase,
+                )
             if phase in _BLAMABLE_PHASES:
                 for rid in rids:
                     self._blame(request_slug(rid), event)
@@ -278,6 +315,7 @@ class FleetSupervisor:
                 "pid": death["pid"],
                 "phase": death["phase"],
                 "exit_code": death["exit_code"],
+                "flight_recorder": death.get("flight_recorder"),
             }
         )
         if entry["quarantined"]:
@@ -286,6 +324,22 @@ class FleetSupervisor:
             self._quarantine(slug, entry)
         elif self._release_claim(slug):
             entry["releases"] += 1
+
+    def _trace_of(self, slug: str) -> tuple[str | None, str | None]:
+        """(trace_id, request_id) from wherever the request's queue
+        file currently sits — the trace context rides the file JSON, so
+        supervisor events can stamp the same id the worker adopted."""
+        for key in ("claimed", "inbox", "dead"):
+            path = os.path.join(self.paths[key], f"{slug}.json")
+            try:
+                with open(path) as f:
+                    payload = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if isinstance(payload, dict):
+                return (payload.get("trace_id"),
+                        payload.get("request_id", slug))
+        return None, slug
 
     def _claim_file(self, slug: str) -> tuple[str, str] | None:
         """Where the blamed request's file currently sits: the dead
@@ -304,6 +358,7 @@ class FleetSupervisor:
         loc = self._claim_file(slug)
         if loc is None or loc[0] != "claimed":
             return False
+        trace_id, request_id = self._trace_of(slug)
         try:
             # qba-protocol: release
             os.replace(
@@ -311,17 +366,21 @@ class FleetSupervisor:
             )
         except OSError:
             return False
+        self.trace_log.emit("release", trace_id, request_id, slug=slug)
         return True
 
     def _quarantine(self, slug: str, entry: dict[str, Any]) -> None:
         """Dead-letter a poison request NOW with its crash report —
         wherever its file sits, it must never reach another worker."""
         request_id = slug
+        trace_id = None
         loc = self._claim_file(slug)
         if loc is not None:
             try:
                 with open(loc[1]) as f:
-                    request_id = str(json.loads(f.read()).get("request_id", slug))
+                    payload = json.loads(f.read())
+                request_id = str(payload.get("request_id", slug))
+                trace_id = payload.get("trace_id")
             except (OSError, json.JSONDecodeError, AttributeError):
                 pass
             try:
@@ -333,20 +392,35 @@ class FleetSupervisor:
             except OSError:
                 pass  # raced away; the crash-report result still wins
         deaths = entry["deaths"]
+        # The last blamed worker's flight-recorder tail (captured at
+        # death time, before any respawn overwrote the file): the
+        # crash report shows what the worker was doing when it died.
+        flight = next(
+            (d["flight_recorder"] for d in reversed(deaths)
+             if d.get("flight_recorder")),
+            None,
+        ) or {"replica_id": deaths[-1]["replica_id"] if deaths else None,
+              "events": []}
         report = {
             "blamed_replicas": [d["replica_id"] for d in deaths],
             "phases": [d["phase"] for d in deaths],
             "exit_codes": [d["exit_code"] for d in deaths],
             "reclaim_count": entry["releases"],
+            "flight_recorder": flight,
         }
         entry["quarantined"] = True
         self.quarantined[slug] = {"request_id": request_id, **report}
+        self.trace_log.emit("quarantine", trace_id, request_id,
+                            slug=slug, deaths=len(deaths))
+        self.trace_log.emit("settle", trace_id, request_id,
+                            outcome="quarantined")
         res = EvalResult.failure(
             request_id,
             f"quarantined as poison: blamed for {len(deaths)} worker "
             f"death(s) (replicas {report['blamed_replicas']}, phases "
             f"{report['phases']}) — dead-lettered without further retries",
         )
+        res.trace_id = trace_id
         res.crash_report = report
         try:
             write_json_atomic(

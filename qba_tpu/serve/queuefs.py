@@ -194,3 +194,120 @@ def read_heartbeat(queue_dir: str, replica_id: str) -> dict[str, Any] | None:
     except (OSError, json.JSONDecodeError):
         return None
     return payload if isinstance(payload, dict) else None
+
+
+def heartbeat_ages(queue_dir: str) -> dict[str, float]:
+    """Per-replica heartbeat staleness in seconds: monotonic now minus
+    the replica's last stamp.  Scans ``heartbeat-*.json`` so the
+    frontend can report staleness with no supervisor attached (the
+    ``GET /status`` satellite) and the metrics collector can gauge it
+    at scrape time — both read-only, no new sockets."""
+    ages: dict[str, float] = {}
+    now = time.monotonic()
+    try:
+        names = os.listdir(queue_dir)
+    except OSError:
+        return ages
+    for name in names:
+        if not (name.startswith("heartbeat-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(queue_dir, name)) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(payload, dict):
+            continue
+        rid = payload.get("replica_id")
+        stamp = payload.get("monotonic")
+        if isinstance(rid, str) and isinstance(stamp, (int, float)):
+            ages[rid] = max(0.0, now - float(stamp))
+    return ages
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (KI-9's execution-history channel, docs/OBSERVABILITY.md).
+
+FLIGHT_SCHEMA = "qba-tpu/flight-recorder/v1"
+
+#: Ring capacity: enough to hold a full request's lifecycle transitions
+#: several times over, small enough that every flush is one tiny atomic
+#: rename beside the heartbeat.
+FLIGHT_CAPACITY = 64
+
+
+def flight_path(queue_dir: str, replica_id: str) -> str:
+    return os.path.join(
+        queue_dir, f"flight-{request_slug(replica_id)}.json"
+    )
+
+
+class FlightRecorder:
+    """Bounded ring of recent structured worker events, flushed
+    atomically beside the heartbeat on every note.
+
+    Same write discipline as the heartbeat — worker side only, atomic
+    rename, jax-free, and a missing queue dir never kills the worker.
+    The flush-per-note policy is the point: the recorder exists for the
+    moment the worker dies *without warning* (SIGKILL, poison
+    ``os._exit``), so the on-disk tail must always be current.  The
+    supervisor embeds the tail into KI-9 ``crash_report``s, showing
+    what the worker was doing when it died.
+    """
+
+    def __init__(
+        self,
+        queue_dir: str,
+        replica_id: str,
+        *,
+        capacity: int = FLIGHT_CAPACITY,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.path = flight_path(queue_dir, replica_id)
+        self.replica_id = replica_id
+        self.capacity = capacity
+        self.events: list[dict[str, Any]] = []
+        self.seq = 0
+
+    def note(self, event: str, **fields: Any) -> dict[str, Any]:
+        """Append one event (wall + monotonic stamped) and flush."""
+        self.seq += 1
+        rec = {
+            "seq": self.seq,
+            "event": event,
+            "monotonic": time.monotonic(),
+            "stamp": time.time(),
+            **fields,
+        }
+        self.events.append(rec)
+        if len(self.events) > self.capacity:
+            del self.events[: len(self.events) - self.capacity]
+        try:
+            write_json_atomic(self.path, {
+                "schema": FLIGHT_SCHEMA,
+                "replica_id": self.replica_id,
+                "pid": os.getpid(),
+                "capacity": self.capacity,
+                "events": self.events,
+            })
+        except OSError:
+            pass  # same contract as the heartbeat writer
+        return rec
+
+
+def read_flight_recorder(
+    queue_dir: str, replica_id: str, *, tail: int | None = None
+) -> dict[str, Any] | None:
+    """The replica's flight-recorder file (optionally truncated to the
+    last ``tail`` events), or None if it never recorded."""
+    try:
+        with open(flight_path(queue_dir, replica_id)) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if tail is not None and isinstance(payload.get("events"), list):
+        payload = {**payload, "events": payload["events"][-tail:]}
+    return payload

@@ -65,6 +65,15 @@ class EvalRequest:
     # Per-trial decisions are O(trials * n_parties) ints on the wire;
     # callers that only want the rate leave this off.
     return_decisions: bool = False
+    # Trace context (docs/OBSERVABILITY.md, schema
+    # qba-tpu/trace-context/v1): minted once at the request's origin
+    # (fleet frontend intake, or the atlas campaign driver) and adopted
+    # — never re-minted — by every hop downstream.  It rides the
+    # queue-file JSON so the worker's root span, the supervisor's
+    # lifecycle events, and the settle all stitch into one causal
+    # trace.  ``parent_span_id`` is the origin's intake span.
+    trace_id: str | None = None
+    parent_span_id: str | None = None
 
     def config(self) -> QBAConfig:
         """The request as a validated config — raises ``ValueError``
@@ -161,6 +170,10 @@ class EvalResult:
     # reclaim_count}`` — so the caller learns *why* it will never be
     # retried, not just that it failed.
     crash_report: dict[str, Any] | None = None
+    # The request's trace id, echoed back so the caller (and the
+    # frontend's settle event) can resolve the stitched trace without
+    # a side lookup.
+    trace_id: str | None = None
 
     def to_json(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)

@@ -32,6 +32,7 @@ from typing import IO, Any, Iterable
 
 from qba_tpu.serve.engine import QBAServer
 from qba_tpu.serve.queuefs import (
+    FlightRecorder,
     HeartbeatWriter,
     queue_paths,
     request_slug,
@@ -208,10 +209,19 @@ def serve_file_queue(
     # "Self-healing").  The writer lives in jax-free queuefs and also
     # rides along on the server for the dispatch/readback phases.
     hb = None
+    flight = None
     if server.replica_id is not None:
         hb = HeartbeatWriter(queue_dir, server.replica_id)
         server.heartbeat = hb
         hb.beat("idle")
+        # The flight recorder rides beside the heartbeat: a bounded
+        # ring of recent lifecycle events, flushed atomically on every
+        # note, so a worker that dies without warning (SIGKILL, poison
+        # os._exit) leaves its last moments on disk for the
+        # supervisor's KI-9 crash report.
+        flight = FlightRecorder(queue_dir, server.replica_id)
+        server.flight = flight
+        flight.note("boot", queue_dir=queue_dir)
     crash_token = os.environ.get(CRASH_HOOK_ENV)
 
     def settle(name: str) -> None:
@@ -227,6 +237,12 @@ def serve_file_queue(
     def emit(results: Iterable[EvalResult]) -> None:
         for res in results:
             _write_json(_result_path(paths["outbox"], res.request_id), res.to_json())
+            if flight is not None:
+                flight.note(
+                    "emit", request_id=res.request_id,
+                    trace_id=res.trace_id,
+                    outcome="error" if res.error else "ok",
+                )
             name = claim_of.pop(res.request_id, None)
             if name is not None:
                 settle(name)
@@ -235,10 +251,13 @@ def serve_file_queue(
     try:
         while True:
             if reclaim_timeout_s is not None:
-                reclaimed_total += _reclaim_stale(
+                round_reclaimed = _reclaim_stale(
                     paths, reclaim_attempts, set(claim_of.values()),
                     reclaim_timeout_s, max_reclaims, emit,
                 )
+                reclaimed_total += round_reclaimed
+                if round_reclaimed and flight is not None:
+                    flight.note("reclaim", count=round_reclaimed)
             names = sorted(
                 n for n in os.listdir(paths["inbox"]) if n.endswith(".json")
             )
@@ -287,6 +306,11 @@ def serve_file_queue(
                 # which request to blame.
                 if hb is not None:
                     hb.beat("claim", [os.path.splitext(name)[0]])
+                if flight is not None:
+                    flight.note(
+                        "claim", request_slug=os.path.splitext(name)[0],
+                        queue_wait_s=queue_wait,
+                    )
                 try:
                     with open(claimed) as f:
                         req = decode_request_line(f.read())

@@ -1,0 +1,25 @@
+"""Seeded KI-12 violation: a fresh trace id minted mid-request.
+
+``settle_with_fresh_trace`` plays a worker-side settle hook that,
+instead of adopting the ``trace_id`` riding the claimed queue file,
+mints a brand-new one for the result and the telemetry root span.
+Everything recorded under the new id — the worker's compile/dispatch/
+readback spans, the settle event — can never stitch back to the
+intake that created the request: the spans become orphans and the
+client-visible trace ends at "admit", dark from claim to settle.
+
+The KI-12 mint-site audit must flag this call site: ``mint_trace_id``
+is only legal at the registered request origins (the frontend's
+``_intake``, the campaign's ``_stamp_trace``), and this function is
+neither.
+"""
+
+from qba_tpu.obs.tracing import mint_trace_id
+
+
+def settle_with_fresh_trace(payload: dict) -> dict:
+    """KI-12 mint-site finding: re-mints instead of adopting."""
+    # BUG: the request's own trace_id is sitting right there in the
+    # payload; minting a new one orphans every span downstream.
+    payload["trace_id"] = mint_trace_id()
+    return payload

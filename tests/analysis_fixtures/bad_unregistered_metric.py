@@ -1,0 +1,15 @@
+"""Seeded KI-12 violation: an unregistered metric name at an emitter.
+
+``count_retry`` increments ``qba_frontend_retries_total`` — a
+plausible-looking name that is NOT a row of
+:data:`qba_tpu.obs.metrics.METRICS`.  At runtime the registry would
+raise; statically, the KI-12 metric-name audit must flag the call so
+the fork of the one name table is caught before any process runs.
+"""
+
+from qba_tpu.obs.metrics import MetricsRegistry
+
+
+def count_retry(reg: MetricsRegistry) -> None:
+    """KI-12 metric-name finding: the name table has no such row."""
+    reg.inc("qba_frontend_retries_total")

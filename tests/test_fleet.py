@@ -855,6 +855,7 @@ def test_supervisor_quarantines_poison_with_crash_report(tmp_path):
     report = res["crash_report"]
     assert set(report) == {
         "blamed_replicas", "phases", "exit_codes", "reclaim_count",
+        "flight_recorder",
     }
     assert report["blamed_replicas"] == ["r0", "r1"]
     assert report["phases"] == ["dispatch", "claim"]

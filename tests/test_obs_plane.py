@@ -1,0 +1,538 @@
+"""Observability-plane tests (docs/OBSERVABILITY.md "Fleet tracing
+and metrics", docs/KNOWN_ISSUES.md KI-12).
+
+Five contracts:
+
+* **One name table** — every metric the fleet emits is a row of
+  :data:`qba_tpu.obs.metrics.METRICS`; an unregistered name or a
+  mismatched label set raises at the emitter, and the rendered page is
+  valid Prometheus text exposition (0.0.4) with exemplars.
+* **One stitched trace per request** — a request served through the
+  socket frontend + admission + two file-queue workers resolves to a
+  single closed trace: intake -> admission -> queue.wait (whose
+  duration IS the wire ``queue_wait_s``) -> worker spans -> settle,
+  with zero orphan spans and span coverage above the KI-12 floor.
+* **Crash-path closure** — a worker killed mid-request still closes
+  the trace: the supervisor stamps kill/death/release/quarantine/
+  settle under the request's trace id and embeds the dead worker's
+  flight-recorder tail in the crash report.
+* **Flight recorder** — a bounded ring flushed atomically beside the
+  heartbeat; capacity trims oldest-first and the tail read is cheap.
+* **KI-12 lint** — ``check_obs`` passes on the shipped tree, flags
+  both seeded fixtures (a mid-request mint, an unregistered metric
+  name), and ``check_span_coverage`` bites on dark time and orphans.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from qba_tpu.obs.metrics import (
+    METRICS,
+    MetricsRegistry,
+    default_buckets,
+    validate_exposition,
+)
+from qba_tpu.obs.tracing import (
+    TRACE_CONTEXT_SCHEMA,
+    TraceEventLog,
+    mint_span_id,
+    mint_trace_id,
+    read_trace_events,
+    stitch_traces,
+    stitched_chrome_trace,
+    trace_summary,
+)
+from qba_tpu.serve import EvalRequest, QBAServer
+from qba_tpu.serve.fleet import (
+    AdmissionController,
+    FleetFrontend,
+    fleet_summary,
+)
+from qba_tpu.serve.queuefs import (
+    FLIGHT_CAPACITY,
+    FlightRecorder,
+    flight_path,
+    heartbeat_ages,
+    read_flight_recorder,
+)
+from qba_tpu.serve.transport import serve_file_queue
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+
+def _req(rid, n=4, L=4, d=0, trials=4, seed=0, **kw):
+    return EvalRequest(
+        request_id=rid, n_parties=n, size_l=L, n_dishonest=d,
+        trials=trials, seed=seed, **kw,
+    )
+
+
+def _queue_dirs(tmp_path):
+    qdir = tmp_path / "q"
+    for d in ("inbox", "claimed", "done", "dead", "outbox"):
+        os.makedirs(qdir / d)
+    return qdir
+
+
+# ---- metrics registry --------------------------------------------------
+
+
+def test_registry_renders_valid_exposition_with_exemplars():
+    reg = MetricsRegistry()
+    reg.inc("qba_intake_requests_total", exemplar="abc123")
+    reg.inc("qba_admission_decisions_total",
+            labels={"action": "admit", "reason": "capacity_available"})
+    reg.set_gauge("qba_queue_files", 3, labels={"box": "inbox"})
+    reg.observe("qba_request_latency_seconds", 0.25)
+    text = reg.render()
+    assert validate_exposition(text) == []
+    assert "# TYPE qba_intake_requests_total counter" in text
+    assert 'qba_intake_requests_total 1 # {trace_id="abc123"} 1' in text
+    assert ('qba_admission_decisions_total'
+            '{action="admit",reason="capacity_available"} 1') in text
+    assert 'qba_queue_files{box="inbox"} 3' in text
+    # Histogram: one cumulative bucket row per default bound, +Inf,
+    # then _sum and _count.
+    for le in ("0.25", "+Inf"):
+        assert f'qba_request_latency_seconds_bucket{{le="{le}"}} 1' in text
+    assert 'qba_request_latency_seconds_bucket{le="0.1"} 0' in text
+    assert "qba_request_latency_seconds_sum 0.25" in text
+    assert "qba_request_latency_seconds_count 1" in text
+    assert len(default_buckets()) >= 8
+    assert reg.counter_value("qba_intake_requests_total") == 1.0
+
+
+def test_registry_refuses_forked_names_and_label_sets():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError, match="unregistered metric name"):
+        reg.inc("qba_frontend_retries_total")
+    with pytest.raises(ValueError, match="unregistered metric name"):
+        reg.set_gauge("qba_queue_depth", 1)
+    # Labelled metric without its labels, and with a foreign key.
+    with pytest.raises(ValueError):
+        reg.inc("qba_admission_decisions_total")
+    with pytest.raises(ValueError):
+        reg.set_gauge("qba_queue_files", 1, labels={"bin": "inbox"})
+    # Every registered row declares kind/help/labels.
+    for name, (kind, help_text, label_keys) in METRICS.items():
+        assert name.startswith("qba_")
+        assert kind in ("counter", "gauge", "histogram")
+        assert help_text
+        assert isinstance(label_keys, tuple)
+
+
+def test_registry_collectors_run_at_render_and_never_raise():
+    reg = MetricsRegistry()
+    calls = []
+
+    def fill(r):
+        calls.append(1)
+        r.set_gauge("qba_fleet_replicas", 2, labels={"state": "healthy"})
+
+    def boom(r):
+        raise RuntimeError("scrape-time collectors must be fenced")
+
+    reg.add_collector(fill)
+    reg.add_collector(boom)
+    text = reg.render()
+    assert calls == [1]
+    assert 'qba_fleet_replicas{state="healthy"} 2' in text
+
+
+# ---- heartbeat staleness + flight recorder -----------------------------
+
+
+def test_heartbeat_ages_reads_every_replica(tmp_path):
+    from qba_tpu.serve.queuefs import heartbeat_path, write_json_atomic
+
+    qdir = str(_queue_dirs(tmp_path))
+    now = time.monotonic()
+    for rid, age in (("r0", 0.5), ("r1", 7.0)):
+        write_json_atomic(heartbeat_path(qdir, rid), {
+            "schema": "qba-tpu/heartbeat/v1", "replica_id": rid,
+            "pid": 1, "seq": 1, "phase": "idle", "request_ids": [],
+            "monotonic": now - age, "stamp": 0.0,
+        })
+    ages = heartbeat_ages(qdir)
+    assert set(ages) == {"r0", "r1"}
+    assert 0.4 <= ages["r0"] < 5.0
+    assert ages["r1"] >= 6.9
+    assert heartbeat_ages(str(tmp_path / "nope")) == {}
+
+
+def test_flight_recorder_ring_trims_and_tail_reads(tmp_path):
+    qdir = str(_queue_dirs(tmp_path))
+    with pytest.raises(ValueError):
+        FlightRecorder(qdir, "r0", capacity=0)
+    fr = FlightRecorder(qdir, "r0", capacity=4)
+    for i in range(7):
+        fr.note("step", i=i)
+    path = flight_path(qdir, "r0")
+    assert os.path.exists(path)
+    payload = json.loads(open(path).read())
+    assert payload["schema"] == "qba-tpu/flight-recorder/v1"
+    events = read_flight_recorder(qdir, "r0")["events"]
+    # Ring semantics: capacity 4 keeps the newest 4, oldest first.
+    assert [e["i"] for e in events] == [3, 4, 5, 6]
+    tail = read_flight_recorder(qdir, "r0", tail=2)["events"]
+    assert [e["i"] for e in tail] == [5, 6]
+    assert read_flight_recorder(qdir, "never-flew") is None
+    assert FLIGHT_CAPACITY >= 16
+    # A missing queue dir degrades the note, never the worker.
+    gone = FlightRecorder(str(tmp_path / "nope" / "q"), "r9")
+    gone.note("boot")  # must not raise
+
+
+# ---- trace event log ---------------------------------------------------
+
+
+def test_trace_event_log_round_trips_and_skips_junk(tmp_path):
+    qdir = str(_queue_dirs(tmp_path))
+    log = TraceEventLog(qdir)
+    tid = mint_trace_id()
+    rec = log.emit("intake", tid, "rq1", t=100.0)
+    assert rec["schema"] == TRACE_CONTEXT_SCHEMA
+    log.emit("settle", tid, "rq1", t=101.0, outcome="ok")
+    with open(log.path, "a") as fh:
+        fh.write("not json\n")
+    events = read_trace_events(qdir)
+    assert [e["event"] for e in events] == ["intake", "settle"]
+    assert events[1]["outcome"] == "ok"
+    assert len(tid) == 32 and len(mint_span_id()) == 16
+    assert read_trace_events(str(tmp_path / "empty")) == []
+
+
+# ---- end-to-end: two replicas, stitched traces, /metrics ---------------
+
+
+def _worker(qdir, tel, n_requests, replica_id):
+    server = QBAServer(chunk_trials=4, replica_id=replica_id,
+                       telemetry_dir=str(tel))
+    serve_file_queue(server, str(qdir), poll_s=0.01,
+                     max_requests=n_requests)
+
+
+def test_fleet_resolves_one_closed_trace_per_request(tmp_path):
+    qdir = tmp_path / "q"
+    tel = tmp_path / "tel"
+    ac = AdmissionController(chunk_trials=4, replicas=2, window_chunks=64)
+    fe = FleetFrontend(str(qdir), ac, poll_s=0.01)  # unbounded: /metrics
+    workers = [
+        threading.Thread(target=_worker, args=(qdir, tel, 2, "r0"),
+                         daemon=True),
+        threading.Thread(target=_worker, args=(qdir, tel, 1, "r1"),
+                         daemon=True),
+    ]
+    for w in workers:
+        w.start()
+    port = fe.start_in_thread()
+    conn = socket.create_connection(("127.0.0.1", port), timeout=120)
+    wire = conn.makefile("rw")
+    for rid in ("t1", "t2", "t3"):
+        wire.write(json.dumps(_req(rid, trials=3, seed=7).to_json()) + "\n")
+    wire.flush()
+    results = [json.loads(wire.readline()) for _ in range(3)]
+    for w in workers:
+        w.join(timeout=120)
+
+    def _http(raw: bytes) -> tuple[int, bytes, bytes]:
+        c = socket.create_connection(("127.0.0.1", port), timeout=120)
+        c.sendall(raw)
+        buf = b""
+        while True:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        c.close()
+        head, _, body = buf.partition(b"\r\n\r\n")
+        return int(head.split(b" ")[1]), head, body
+
+    # Live metrics plane: valid exposition under load, typed content.
+    code, head, body = _http(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+    assert code == 200
+    assert b"text/plain; version=0.0.4" in head
+    text = body.decode()
+    assert validate_exposition(text) == []
+    assert "qba_intake_requests_total 3" in text
+    assert 'qba_results_forwarded_total{outcome="ok"} 3' in text
+    assert "qba_request_latency_seconds_count 3" in text
+    assert 'qba_replica_heartbeat_staleness_seconds{replica="r0"}' in text
+
+    # /status carries per-replica heartbeat staleness.
+    code, _, body = _http(b"GET /status HTTP/1.1\r\nHost: x\r\n\r\n")
+    assert code == 200
+    status = json.loads(body)
+    for rid in ("r0", "r1"):
+        assert status["replicas"][rid]["staleness_s"] >= 0.0
+    conn.close()
+    fe.stop_in_thread()
+
+    # Every wire result carries the trace id minted at intake.
+    by_id = {r["request_id"]: r for r in results}
+    assert all(r["error"] is None for r in results)
+    tids = {r["trace_id"] for r in results}
+    assert len(tids) == 3 and None not in tids
+
+    # One stitched trace per request, zero orphans, closed, covered.
+    stitched = stitch_traces(str(qdir), telemetry_dir=str(tel))
+    assert stitched["orphan_spans"] == 0
+    assert set(stitched["traces"]) == tids
+    for rid, res in by_id.items():
+        tr = stitched["traces"][res["trace_id"]]
+        assert tr["request_id"] == rid
+        assert tr["closed"]
+        assert tr["segments"] == 1
+        assert tr["coverage"] >= 0.8  # the KI-12 floor
+        names = [s["name"] for s in tr["spans"]]
+        assert "request" in names and "frontend.admission" in names
+        # The synthesized queue-wait span IS the wire queue_wait_s.
+        (qw,) = [s for s in tr["spans"] if s["name"] == "queue.wait"]
+        assert qw["dur"] == pytest.approx(res["queue_wait_s"], abs=1e-6)
+        assert {e["event"] for e in tr["events"]} >= {
+            "intake", "admit", "settle"}
+
+    summary = trace_summary(stitched)
+    assert summary["count"] == 3 and summary["closed"] == 3
+    assert summary["orphan_spans"] == 0
+    assert summary["coverage"]["min"] >= 0.8
+
+    # The same block rides the fleet summary, and the Chrome export is
+    # one renderable JSON with every span and lifecycle instant.
+    fs = fleet_summary(str(qdir), telemetry_dir=str(tel))
+    assert fs["traces"]["count"] == 3
+    assert fs["traces"]["orphan_spans"] == 0
+    chrome = stitched_chrome_trace(stitched)
+    assert chrome["traceEvents"]
+    assert {e["ph"] for e in chrome["traceEvents"]} >= {"X", "M", "i"}
+
+    # Flight recorders flushed beside the heartbeats.
+    for rid in ("r0", "r1"):
+        flight = read_flight_recorder(str(qdir), rid)["events"]
+        assert flight and flight[0]["event"] == "boot"
+        assert any(e["event"] == "claim" for e in flight)
+
+
+# ---- crash path: kill mid-request still closes the trace ---------------
+
+
+def _write_hb(qdir, rid, pid, phase, monotonic, request_ids=()):
+    from qba_tpu.serve.queuefs import heartbeat_path, write_json_atomic
+
+    write_json_atomic(heartbeat_path(str(qdir), rid), {
+        "schema": "qba-tpu/heartbeat/v1", "replica_id": rid, "pid": pid,
+        "seq": 1, "phase": phase, "request_ids": list(request_ids),
+        "monotonic": monotonic, "stamp": 0.0,
+    })
+
+
+class _FakeProc:
+    def __init__(self, pid, returncode=None):
+        self.pid = pid
+        self.returncode = returncode
+
+    def poll(self):
+        return self.returncode
+
+
+class _StubReplica:
+    def __init__(self, rid, pid, returncode=None):
+        self.replica_id = rid
+        self.proc = _FakeProc(pid, returncode)
+        self.env = {}
+        self.returncode = returncode
+
+    @property
+    def alive(self):
+        return self.proc.returncode is None
+
+
+class _StubPool:
+    def __init__(self, queue_dir, replicas):
+        self.queue_dir = str(queue_dir)
+        self.replicas = replicas
+        self.benched = set()
+        self.killed = []
+
+    def kill(self, rid):
+        for r in self.replicas:
+            if r.replica_id == rid and r.alive:
+                self.killed.append(rid)
+                r.proc.returncode = -9
+                return r.proc.pid
+        raise ValueError(rid)
+
+    def bench(self, rid):
+        if rid in self.benched:
+            return False
+        self.benched.add(rid)
+        return True
+
+    def respawn_dead(self):
+        return []
+
+
+def test_killed_worker_closes_trace_with_flight_tail(tmp_path):
+    from qba_tpu.serve.fleet import FleetSupervisor
+
+    qdir = _queue_dirs(tmp_path)
+    tid = mint_trace_id()
+    req = _req("p1", trials=3, trace_id=tid, parent_span_id=mint_span_id())
+    (qdir / "claimed" / "p1.json").write_text(json.dumps(req.to_json()))
+    # The frontend's half of the lifecycle, as it would already be on
+    # disk when the supervisor notices the wedge.
+    log = TraceEventLog(str(qdir))
+    log.emit("intake", tid, "p1")
+    log.emit("admit", tid, "p1", reason="capacity_available")
+    # The doomed worker's flight recorder: the last thing it did.
+    fr = FlightRecorder(str(qdir), "r0")
+    fr.note("claim", request_id="p1")
+    fr.note("dispatch", request_id="p1", chunk=0)
+
+    r0 = _StubReplica("r0", 100)
+    r1 = _StubReplica("r1", 101)
+    pool = _StubPool(qdir, [r0, r1])
+    now = [1000.0]
+    sup = FleetSupervisor(pool, watchdog_s=5.0, poison_threshold=2,
+                          clock=lambda: now[0])
+    _write_hb(qdir, "r0", 100, "dispatch", 1000.0, ["p1"])
+    _write_hb(qdir, "r1", 101, "idle", 1000.0)
+    now[0] = 1006.0  # r0 wedged mid-dispatch; SIGKILL path
+    _write_hb(qdir, "r1", 101, "idle", 1005.5)
+    step = sup.poll()
+    assert step["hung_killed"] == ["r0"]
+    # The release went back to the inbox under the SAME trace id.
+    assert (qdir / "inbox" / "p1.json").exists()
+    # Second blamed death reaches the poison threshold: quarantine.
+    r1.proc.returncode = 113
+    _write_hb(qdir, "r1", 101, "claim", 1006.0, ["p1"])
+    now[0] = 1007.0
+    sup.poll()
+
+    res = json.loads((qdir / "outbox" / "p1.json").read_text())
+    assert "quarantined as poison" in res["error"]
+    assert res["trace_id"] == tid
+    # The crash report embeds the blamed worker's flight-recorder tail
+    # captured at death time (r1 never flew, so r0's tail survives).
+    flight = res["crash_report"]["flight_recorder"]["events"]
+    assert [e["event"] for e in flight] == ["claim", "dispatch"]
+    assert flight[-1]["request_id"] == "p1"
+
+    # The trace is CLOSED despite no worker result: kill, both deaths,
+    # the release, the quarantine, and a settle — all under one id.
+    stitched = stitch_traces(str(qdir))
+    tr = stitched["traces"][tid]
+    assert tr["closed"] and tr["request_id"] == "p1"
+    kinds = [e["event"] for e in tr["events"]]
+    for kind in ("intake", "admit", "kill", "death", "release",
+                 "quarantine", "settle"):
+        assert kind in kinds, kinds
+    assert kinds.count("death") == 2
+    assert stitched["orphan_spans"] == 0
+    assert trace_summary(stitched)["closed"] == 1
+
+
+# ---- KI-12 lint --------------------------------------------------------
+
+
+def test_check_obs_passes_on_the_shipped_tree():
+    from qba_tpu.analysis.obs import check_obs
+
+    report = check_obs()
+    assert report.ok, report.render()
+    assert report.stats["obs_modules_scanned"] > 50
+    assert report.stats["obs_emitter_calls_audited"] > 5
+    assert report.stats["obs_mint_sites_bound"] == 2
+
+
+@pytest.mark.parametrize("fixture,check", [
+    ("bad_orphan_span.py", "mint-site"),
+    ("bad_unregistered_metric.py", "metric-name"),
+])
+def test_check_obs_fixture_catches_seeded_violation(fixture, check):
+    from qba_tpu.analysis.obs import check_obs_fixture
+
+    report = check_obs_fixture(os.path.join(FIXTURES, fixture))
+    assert not report.ok
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert f.ki == "KI-12" and f.check == check
+    assert fixture in f.path
+
+
+def test_check_span_coverage_bites_on_dark_time_and_orphans(tmp_path):
+    from qba_tpu.analysis.obs import COVERAGE_FLOOR, check_span_coverage
+    from qba_tpu.obs.telemetry import SpanRecorder
+
+    qdir = str(_queue_dirs(tmp_path))
+    log = TraceEventLog(qdir)
+    tid = mint_trace_id()
+    # 10 s of request lifetime, 0.1 s of admission span: dark time.
+    log.emit("intake", tid, "dk1", t=100.0)
+    log.emit("admit", tid, "dk1", t=100.1, reason="capacity_available")
+    log.emit("settle", tid, "dk1", t=110.0)
+    # An unanchored worker export: spans that stitch to no trace.
+    rec = SpanRecorder()
+    with rec.span("request", request_id="lost"):
+        pass
+    os.makedirs(os.path.join(str(tmp_path), "tel", "lost"))
+    rec.write_jsonl(
+        os.path.join(str(tmp_path), "tel", "lost", "spans.jsonl"))
+
+    report = check_span_coverage(
+        qdir, telemetry_dir=os.path.join(str(tmp_path), "tel"))
+    assert not report.ok
+    checks = [f.check for f in report.findings]
+    assert checks.count("span-coverage") == 2  # orphans + dark trace
+    messages = " ".join(f.message for f in report.findings)
+    assert "orphan" in messages
+    assert f"floor {COVERAGE_FLOOR:.0%}" in messages
+    # A generous floor accepts the same data minus the orphans.
+    clean = check_span_coverage(qdir, floor=0.005)
+    assert clean.ok, clean.render()
+
+
+# ---- atlas campaign: trace stamping + budget metrics -------------------
+
+
+def test_campaign_stamps_traces_and_counts_budget(tmp_path):
+    from qba_tpu.atlas.campaign import (
+        CampaignDriver,
+        LocalExecutor,
+        _stamp_trace,
+    )
+    from qba_tpu.atlas.cube import CampaignSpec
+    from qba_tpu.atlas.store import AtlasStore
+
+    # _stamp_trace mints exactly once and adopts an existing context.
+    stamped = _stamp_trace(_req("c0"))
+    assert stamped.trace_id and stamped.parent_span_id
+    assert _stamp_trace(stamped) is stamped
+
+    spec = CampaignSpec(
+        parties=(4,), dishonest=(0, 1), chunk_trials=32,
+        budget_trials=64, max_escalations=1,
+        target="decide vs 1/3 @ 95%",
+    )
+    store = AtlasStore(str(tmp_path / "atlas"))
+    driver = CampaignDriver(
+        store, spec,
+        LocalExecutor(chunk_trials=spec.chunk_trials,
+                      cache_dir=str(tmp_path / "cache")),
+    )
+    summary = driver.run()
+    assert summary["open"] == 0
+    m = summary["metrics"]
+    assert m["budget_trials"] > 0
+    certified = driver.metrics.counter_value(
+        "qba_atlas_cells_total", {"status": "certified"})
+    refused = driver.metrics.counter_value(
+        "qba_atlas_cells_total", {"status": "refused"})
+    assert certified + refused == summary["cells"]
+    # The per-campaign registry still renders valid exposition.
+    assert validate_exposition(driver.metrics.render()) == []
